@@ -1,0 +1,70 @@
+"""moolib_tpu — a TPU-native framework for distributed (RL) training.
+
+Brand-new design with the capabilities of facebookresearch/moolib
+(``py/moolib/__init__.py:2-22`` export list): general-purpose RPC with
+pytree/array payloads and automatic transport selection, elastic peer groups
+coordinated by a Broker, tree allreduce, an asynchronous gradient Accumulator
+(leader election, virtual batch sizes, model/state sync), a multi-process
+shared-memory EnvPool, and Batcher utilities — plus TPU-first additions the
+reference lacks: a jax/XLA collective data plane over ICI (``parallel``),
+mesh sharding (dp/tp/sp/ep), ring-attention sequence parallelism, and
+flax/optax model + ops libraries (``models``, ``ops``).
+"""
+
+from . import utils  # noqa: F401
+from .utils import create_uid, set_log_level, set_logging, set_max_threads  # noqa: F401
+from .rpc import Future, Queue, Rpc, RpcDeferredReturn, RpcError  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Accumulator",
+    "AllReduce",
+    "Batcher",
+    "Broker",
+    "EnvPool",
+    "EnvRunner",
+    "EnvStepper",
+    "EnvStepperFuture",
+    "Future",
+    "Group",
+    "Queue",
+    "Rpc",
+    "RpcDeferredReturn",
+    "RpcError",
+    "create_uid",
+    "set_log_level",
+    "set_logging",
+    "set_max_threads",
+    "utils",
+]
+
+
+_LAZY = {
+    "Broker": "broker",
+    "Group": "group",
+    "AllReduce": "group",
+    "Accumulator": "accumulator",
+    "Batcher": "batcher",
+    "EnvPool": "envpool",
+    "EnvRunner": "envpool",
+    "EnvStepper": "envpool",
+    "EnvStepperFuture": "envpool",
+}
+
+
+def __getattr__(name):  # lazy imports keep `import moolib_tpu` light
+    mod_name = _LAZY.get(name)
+    if mod_name is None:
+        raise AttributeError(f"module 'moolib_tpu' has no attribute {name!r}")
+    import importlib
+
+    try:
+        mod = importlib.import_module(f".{mod_name}", __name__)
+    except ModuleNotFoundError as e:
+        raise AttributeError(
+            f"moolib_tpu.{name} is not available yet ({e})"
+        ) from e
+    value = getattr(mod, name)
+    globals()[name] = value
+    return value

@@ -1,0 +1,4 @@
+"""RPC subsystem: serialization, transports, the Rpc engine."""
+
+from . import serialization  # noqa: F401
+from .core import Future, Queue, Rpc, RpcDeferredReturn, RpcError, parse_address  # noqa: F401

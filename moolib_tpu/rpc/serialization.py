@@ -1,0 +1,217 @@
+"""RPC payload serialization with out-of-band array buffers.
+
+Counterpart of the reference's serializer stack (``src/serialization.h:1-461``,
+``src/pythonserialization.h:43-423``, ``src/tensor.h:152-165``): python objects
+are encoded with a tag-based fast path falling back to pickle, and tensors ride
+*out of band* — only dtype/shape metadata goes in the payload stream while the
+raw bytes are appended as separate buffers (the reference's
+``x.addTensor(v, x.tell())`` side channel), so the transport can scatter-gather
+them without copies.
+
+The TPU-native twist: leaves may be ``jax.Array``. On the wire they stage
+through host memory (``np.asarray``) — the analogue of the reference's
+pinned-CPU staging for CUDA tensors (``src/accumulator.cc:859-873``) — and are
+tagged so the receiver rematerializes a ``jax.Array`` (committed to the default
+device) rather than a numpy array.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from dataclasses import dataclass, field
+from typing import Any, List, Sequence
+
+import numpy as np
+
+try:  # bfloat16 & friends come from ml_dtypes (always present with jax)
+    import ml_dtypes  # noqa: F401
+except ImportError:  # pragma: no cover
+    ml_dtypes = None
+
+_JAX = None
+
+
+def _jax():
+    global _JAX
+    if _JAX is None:
+        import jax
+
+        _JAX = jax
+    return _JAX
+
+
+def _is_jax_array(x) -> bool:
+    jax = _jax()
+    return isinstance(x, jax.Array)
+
+
+@dataclass
+class ArrayRef:
+    """Metadata for one out-of-band array buffer."""
+
+    dtype: str
+    shape: tuple
+    kind: str  # "np" | "jax"
+    data: Any = None  # bytes-like (only set on the wire side)
+
+
+@dataclass
+class SerializedPayload:
+    payload: bytes = b""
+    arrays: List[ArrayRef] = field(default_factory=list)
+
+    def nbytes(self) -> int:
+        return len(self.payload) + sum(
+            a.data.nbytes if isinstance(a.data, memoryview) else len(a.data)
+            for a in self.arrays
+        )
+
+
+class _Pickler(pickle.Pickler):
+    """Pickler that diverts array leaves into the out-of-band table."""
+
+    def __init__(self, file, arrays: List[ArrayRef]):
+        super().__init__(file, protocol=pickle.HIGHEST_PROTOCOL)
+        self._arrays = arrays
+
+    def persistent_id(self, obj):
+        if isinstance(obj, np.ndarray) and obj.dtype != object:
+            arr = np.ascontiguousarray(obj)
+            self._arrays.append(ArrayRef(arr.dtype.name, arr.shape, "np", _raw_data(arr)))
+            return ("__array__", len(self._arrays) - 1)
+        if _is_jax_array(obj):
+            host = np.ascontiguousarray(np.asarray(obj))
+            self._arrays.append(ArrayRef(host.dtype.name, host.shape, "jax", _raw_data(host)))
+            return ("__array__", len(self._arrays) - 1)
+        if isinstance(obj, (np.generic,)):
+            # 0-dim numpy scalars pickle fine inline; keep them in-band.
+            return None
+        return None
+
+
+class _Unpickler(pickle.Unpickler):
+    def __init__(self, file, arrays: Sequence[ArrayRef]):
+        super().__init__(file)
+        self._arrays = arrays
+
+    def persistent_load(self, pid):
+        tag, idx = pid
+        if tag != "__array__":
+            raise pickle.UnpicklingError(f"unknown persistent id {pid!r}")
+        ref = self._arrays[idx]
+        arr = _materialize(ref)
+        return arr
+
+
+def _raw_data(arr: np.ndarray):
+    """Contiguous raw bytes of an array; extension dtypes (bfloat16, fp8 from
+    ml_dtypes) don't implement the buffer protocol, so view through uint8."""
+    try:
+        return arr.data
+    except (ValueError, BufferError):
+        return arr.view(np.uint8).data
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        if ml_dtypes is not None:
+            return np.dtype(getattr(ml_dtypes, name))
+        raise
+
+
+def _materialize(ref: ArrayRef):
+    arr = np.frombuffer(ref.data, dtype=_np_dtype(ref.dtype)).reshape(ref.shape)
+    if ref.kind == "jax":
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+    # np.frombuffer gives a read-only view over the receive buffer; copy so
+    # callers can mutate (the receive buffer is also about to be recycled).
+    return arr.copy()
+
+
+def serialize(obj: Any) -> SerializedPayload:
+    """Serialize an arbitrary python object, extracting arrays out of band."""
+    arrays: List[ArrayRef] = []
+    bio = io.BytesIO()
+    _Pickler(bio, arrays).dump(obj)
+    return SerializedPayload(bio.getvalue(), arrays)
+
+
+def deserialize(sp: SerializedPayload) -> Any:
+    return _Unpickler(io.BytesIO(sp.payload), sp.arrays).load()
+
+
+# ---------------------------------------------------------------------------
+# Wire packing.  Body layout (all little-endian):
+#   u32 payload_len | payload bytes
+#   u16 n_arrays
+#   per array: u8 kind | u16 dtype_len | dtype utf8 | u8 ndim | u64*ndim shape
+#              | u64 data_len | data bytes
+# The reference's equivalent is the iovec construction in
+# ``src/transports/ipc.cc:61-98`` (header + payload + one iovec per tensor).
+# ---------------------------------------------------------------------------
+
+_KINDS = {"np": 0, "jax": 1}
+_KINDS_INV = {v: k for k, v in _KINDS.items()}
+
+
+def pack(sp: SerializedPayload) -> List[bytes]:
+    """Return a list of byte chunks (iovec-style) encoding the payload."""
+    chunks: List[bytes] = []
+    chunks.append(struct.pack("<I", len(sp.payload)))
+    chunks.append(sp.payload)
+    chunks.append(struct.pack("<H", len(sp.arrays)))
+    for a in sp.arrays:
+        dt = a.dtype.encode()
+        hdr = struct.pack("<BH", _KINDS[a.kind], len(dt)) + dt
+        hdr += struct.pack("<B", len(a.shape)) + struct.pack(f"<{len(a.shape)}Q", *a.shape)
+        hdr += struct.pack("<Q", len(a.data) if not isinstance(a.data, memoryview) else a.data.nbytes)
+        chunks.append(hdr)
+        chunks.append(a.data)
+    return chunks
+
+
+def pack_bytes(sp: SerializedPayload) -> bytes:
+    return b"".join(bytes(c) for c in pack(sp))
+
+
+def unpack(buf, offset: int = 0) -> SerializedPayload:
+    """Parse a packed body from ``buf`` (bytes/memoryview) starting at offset."""
+    mv = memoryview(buf)
+    (plen,) = struct.unpack_from("<I", mv, offset)
+    offset += 4
+    payload = bytes(mv[offset : offset + plen])
+    offset += plen
+    (narr,) = struct.unpack_from("<H", mv, offset)
+    offset += 2
+    arrays: List[ArrayRef] = []
+    for _ in range(narr):
+        kind, dlen = struct.unpack_from("<BH", mv, offset)
+        offset += 3
+        dtype = bytes(mv[offset : offset + dlen]).decode()
+        offset += dlen
+        (ndim,) = struct.unpack_from("<B", mv, offset)
+        offset += 1
+        shape = struct.unpack_from(f"<{ndim}Q", mv, offset) if ndim else ()
+        offset += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", mv, offset)
+        offset += 8
+        data = mv[offset : offset + nbytes]
+        offset += nbytes
+        arrays.append(ArrayRef(dtype, tuple(shape), _KINDS_INV[kind], data))
+    return SerializedPayload(payload, arrays)
+
+
+def dumps(obj: Any) -> bytes:
+    """One-shot: object → single bytes blob (payload + arrays)."""
+    return pack_bytes(serialize(obj))
+
+
+def loads(buf) -> Any:
+    """One-shot inverse of :func:`dumps`."""
+    return deserialize(unpack(buf))

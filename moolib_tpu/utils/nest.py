@@ -1,0 +1,135 @@
+"""Minimal pytree ("nest") helpers over dict / list / tuple / leaf structures.
+
+TPU-native counterpart of the reference's ``examples/common/nest.py:4-41`` and
+the C++ ``utils::stackFields/unstackFields`` family (``src/batch_utils.h:21-27``).
+Unlike the reference we are jax-first, so leaves are anything jax can treat as
+an array (jax.Array, numpy, python scalars) and the heavy lifting is done by
+``jax.tree_util`` where possible.  These helpers intentionally support only
+dict/list/tuple containers — matching the wire format of the RPC layer — so a
+nest serialized on one peer reassembles identically on another.
+"""
+
+from __future__ import annotations
+
+from builtins import zip as _zip
+from typing import Any, Callable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Nest = Any  # dict / list / tuple / leaf
+
+
+def map(f: Callable, n: Nest) -> Nest:  # noqa: A001 - mirrors reference API name
+    """Apply ``f`` to every leaf of ``n``, preserving structure."""
+    if isinstance(n, dict):
+        return {k: map(f, v) for k, v in n.items()}
+    if isinstance(n, tuple):
+        return tuple(map(f, v) for v in n)
+    if isinstance(n, list):
+        return [map(f, v) for v in n]
+    return f(n)
+
+
+def map_many(f: Callable, *nests: Nest) -> Nest:
+    """Apply ``f`` over corresponding leaves of several same-structure nests."""
+    n0 = nests[0]
+    if isinstance(n0, dict):
+        return {k: map_many(f, *(n[k] for n in nests)) for k in n0}
+    if isinstance(n0, tuple):
+        return tuple(map_many(f, *vs) for vs in _zip(*nests))
+    if isinstance(n0, list):
+        return [map_many(f, *vs) for vs in _zip(*nests)]
+    return f(*nests)
+
+
+def flatten(n: Nest) -> Iterator[Any]:
+    """Yield leaves of ``n`` in deterministic (insertion/index) order."""
+    if isinstance(n, dict):
+        for v in n.values():
+            yield from flatten(v)
+    elif isinstance(n, (list, tuple)):
+        for v in n:
+            yield from flatten(v)
+    else:
+        yield n
+
+
+def zip(*nests: Nest):  # noqa: A001 - mirrors reference API name
+    """Zip leaves of same-structure nests into tuples (structure preserved)."""
+    return map_many(lambda *xs: tuple(xs), *nests)
+
+
+def pack_as(template: Nest, flat: Sequence[Any]) -> Nest:
+    """Inverse of :func:`flatten` given a structure template."""
+    it = iter(flat)
+
+    def _take(_):
+        return next(it)
+
+    out = map(_take, template)
+    rest = list(it)
+    if rest:
+        raise ValueError(f"pack_as: {len(rest)} leaves left over")
+    return out
+
+
+def _stack_leaves(xs, dim):
+    try:
+        return jnp.stack(xs, axis=dim)
+    except (TypeError, ValueError):
+        # Non-array leaves (strings, objects) batch as a 1-D object array —
+        # still a *leaf* (lists/tuples would read as nest containers).
+        out = np.empty(len(xs), dtype=object)
+        for i, x in enumerate(xs):
+            out[i] = x
+        return out
+
+
+def stack(nests: Sequence[Nest], dim: int = 0) -> Nest:
+    """Stack corresponding leaves of ``nests`` along a new axis ``dim``.
+
+    Non-array leaves are collected into lists instead (the RPC queue batching
+    path sends opaque "info" objects alongside tensors).
+    """
+    return map_many(lambda *xs: _stack_leaves(xs, dim), *nests)
+
+
+def cat(nests: Sequence[Nest], dim: int = 0) -> Nest:
+    """Concatenate corresponding leaves of ``nests`` along axis ``dim``."""
+    return map_many(lambda *xs: jnp.concatenate(xs, axis=dim), *nests)
+
+
+def unstack(n: Nest, dim: int = 0) -> list:
+    """Split every leaf along ``dim`` and return a list of nests."""
+    leaves = list(flatten(n))
+    if not leaves:
+        return []
+    first = leaves[0]
+    size = np.shape(first)[0 if _is_object_array(first) else dim]
+    parts = [
+        map(lambda x: _index_axis(x, dim, i), n)  # noqa: B023
+        for i in range(size)
+    ]
+    return parts
+
+
+def _is_object_array(x) -> bool:
+    return isinstance(x, np.ndarray) and x.dtype == object
+
+
+def _index_axis(x, dim, i):
+    if _is_object_array(x):  # non-array leaves batched by _stack_leaves
+        return x[i]
+    idx = [slice(None)] * np.ndim(x)
+    idx[dim] = i
+    return x[tuple(idx)]
+
+
+def device_put(n: Nest, device=None, sharding=None) -> Nest:
+    """Move every leaf onto a device / sharding (jax.device_put per leaf)."""
+    target = sharding if sharding is not None else device
+    if target is None:
+        return map(jnp.asarray, n)
+    return map(lambda x: jax.device_put(x, target), n)

@@ -1,0 +1,51 @@
+import jax.numpy as jnp
+import numpy as np
+
+from moolib_tpu.utils import nest
+
+
+def test_map_and_flatten():
+    n = {"a": 1, "b": [2, (3, 4)], "c": {"d": 5}}
+    doubled = nest.map(lambda x: x * 2, n)
+    assert doubled == {"a": 2, "b": [4, (6, 8)], "c": {"d": 10}}
+    assert list(nest.flatten(n)) == [1, 2, 3, 4, 5]
+
+
+def test_pack_as_roundtrip():
+    n = {"a": 1, "b": [2, (3, 4)]}
+    flat = list(nest.flatten(n))
+    assert nest.pack_as(n, flat) == n
+
+
+def test_stack_unstack():
+    a = {"x": jnp.ones((2, 3)), "y": [jnp.zeros((4,))]}
+    b = {"x": jnp.zeros((2, 3)), "y": [jnp.ones((4,))]}
+    s = nest.stack([a, b])
+    assert s["x"].shape == (2, 2, 3)
+    parts = nest.unstack(s)
+    assert len(parts) == 2
+    np.testing.assert_array_equal(np.asarray(parts[0]["x"]), np.ones((2, 3)))
+    np.testing.assert_array_equal(np.asarray(parts[1]["y"][0]), np.ones((4,)))
+
+
+def test_stack_dim1_and_cat():
+    a = jnp.ones((2, 3))
+    b = jnp.zeros((2, 3))
+    assert nest.stack([a, b], dim=1).shape == (2, 2, 3)
+    assert nest.cat([a, b], dim=0).shape == (4, 3)
+
+
+def test_stack_non_array_leaves():
+    a = {"t": jnp.ones(2), "info": "hello"}
+    b = {"t": jnp.zeros(2), "info": "world"}
+    s = nest.stack([a, b])
+    assert list(s["info"]) == ["hello", "world"]
+    parts = nest.unstack(s)
+    assert parts[0]["info"] == "hello" and parts[1]["info"] == "world"
+
+
+def test_map_many_zip():
+    a = {"x": 1}
+    b = {"x": 10}
+    assert nest.map_many(lambda p, q: p + q, a, b) == {"x": 11}
+    assert nest.zip(a, b) == {"x": (1, 10)}

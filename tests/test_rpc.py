@@ -1,0 +1,267 @@
+"""RPC engine tests — ports of the reference's test strategy (SURVEY.md §4):
+loopback multi-peer in one process, error propagation, tensors over the wire,
+queues/batching, asyncio interop, and throughput canaries."""
+
+import asyncio
+import re
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import moolib_tpu
+from moolib_tpu import Rpc, RpcError
+
+
+@pytest.fixture
+def pair(free_port):
+    host, client = Rpc(), Rpc()
+    host.set_name("host")
+    client.set_name("client")
+    host.listen(f"127.0.0.1:{free_port}")
+    client.connect(f"127.0.0.1:{free_port}")
+    yield host, client
+    host.close()
+    client.close()
+
+
+def test_call_async_and_sync(pair):
+    host, client = pair
+    client.set_timeout(5)
+    num_calls = 0
+
+    def hello(message):
+        nonlocal num_calls
+        num_calls += 1
+        return "this is a response to message '" + message + "'"
+
+    host.define("hello", hello)
+    message = "this is a message from client"
+    future = client.async_("host", "hello", message)
+    response = future.result()
+    assert num_calls == 1
+    assert response == "this is a response to message '" + message + "'"
+    assert client.sync("host", "hello", "sync test") == (
+        "this is a response to message 'sync test'"
+    )
+
+
+def test_async_callback_and_unknown_peer(pair):
+    host, client = pair
+    client.set_timeout(1)
+
+    def hello(message):
+        return "response %s" % repr(message)
+
+    host.define("hello", hello)
+    done = []
+
+    def cb(response, error):
+        done.append((response, error))
+
+    client.async_callback("host", "hello", cb, "msg")
+    t0 = time.time()
+    while not done and time.time() - t0 < 5:
+        time.sleep(0.01)
+    assert done and done[0][0] == "response 'msg'" and done[0][1] is None
+
+    future = client.async_("nowhere", "hello", "into the void")
+    with pytest.raises(RuntimeError, match=re.escape("Call (nowhere::hello) timed out")):
+        future.result()
+
+
+def test_remote_exception(pair):
+    host, client = pair
+    client.set_timeout(5)
+
+    def boom():
+        raise ValueError("boom!")
+
+    host.define("boom", boom)
+    with pytest.raises(RpcError, match="boom!"):
+        client.sync("host", "boom")
+
+
+def test_undefined_function(pair):
+    host, client = pair
+    client.set_timeout(5)
+    with pytest.raises(RpcError, match="not defined"):
+        client.sync("host", "nothing_here")
+
+
+def test_tensors_roundtrip(pair):
+    host, client = pair
+    client.set_timeout(10)
+
+    def process(d):
+        return {"sum": np.asarray(d["a"]).sum() + np.asarray(d["b"]).sum(), "echo": d["a"]}
+
+    host.define("process", process)
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = jnp.ones((2, 2))
+    out = client.sync("host", "process", {"a": a, "b": b})
+    assert float(out["sum"]) == float(a.sum() + 4)
+    np.testing.assert_array_equal(out["echo"], a)
+
+
+def test_bidirectional_calls(pair):
+    host, client = pair
+    client.set_timeout(5)
+    host.set_timeout(5)
+    client.define("client_fn", lambda x: x * 2)
+    host.define("host_fn", lambda x: x + 1)
+    assert client.sync("host", "host_fn", 1) == 2
+    # host learned "client"'s name from the greeting; call back
+    assert host.sync("client", "client_fn", 21) == 42
+
+
+def test_kwargs(pair):
+    host, client = pair
+    client.set_timeout(5)
+    host.define("f", lambda a, b=0, c=0: a + 10 * b + 100 * c)
+    assert client.sync("host", "f", 1, c=3) == 301
+    assert client.sync("host", "f", 1, b=2, c=3) == 321
+
+
+def test_deferred(pair):
+    host, client = pair
+    client.set_timeout(5)
+
+    def hello_deferred(callback, message):
+        callback("deferred response to " + message)
+
+    host.define_deferred("hello deferred", hello_deferred)
+    assert client.sync("host", "hello deferred", "x") == "deferred response to x"
+
+
+def test_batched_define(pair):
+    host, client = pair
+    client.set_timeout(10)
+    seen_batches = []
+
+    def f(x):
+        seen_batches.append(np.asarray(x).shape)
+        return x * 2
+
+    host.define("f", f, batch_size=4)
+    futures = [client.async_("host", "f", np.full((3,), i, np.float32)) for i in range(4)]
+    results = [fu.result() for fu in futures]
+    assert seen_batches == [(4, 3)]
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(np.asarray(r), np.full((3,), 2 * i, np.float32))
+
+
+def test_queue_plain(pair):
+    host, client = pair
+    client.set_timeout(10)
+    queue = host.define_queue("work")
+
+    async def serve_one():
+        ret_cb, args, kwargs = await queue
+        ret_cb(args[0] + 1)
+
+    fut = client.async_("host", "work", 41)
+    asyncio.run(asyncio.wait_for(serve_one(), 10))
+    assert fut.result() == 42
+
+
+def test_queue_dynamic_batching(pair):
+    host, client = pair
+    client.set_timeout(10)
+    queue = host.define_queue("linear", batch_size=8, dynamic_batching=True)
+    futures = [client.async_("host", "linear", np.full((2,), i, np.float32)) for i in range(6)]
+
+    async def serve():
+        served = 0
+        while served < 6:
+            ret_cb, args, kwargs = await queue
+            x = np.asarray(args[0])
+            batch = x.shape[0] if x.ndim == 2 else 1
+            served += batch
+            ret_cb(x * 10)
+
+    asyncio.run(asyncio.wait_for(serve(), 15))
+    for i, fu in enumerate(futures):
+        np.testing.assert_allclose(np.asarray(fu.result()), np.full((2,), i * 10, np.float32))
+
+
+def test_future_await(pair):
+    host, client = pair
+    client.set_timeout(5)
+    host.define("add", lambda a, b: a + b)
+
+    async def main():
+        return await client.async_("host", "add", 20, 22)
+
+    assert asyncio.run(main()) == 42
+
+
+def test_ipc_transport(tmp_path):
+    host, client = Rpc(), Rpc()
+    try:
+        host.set_name("host")
+        client.set_name("client")
+        client.set_timeout(5)
+        path = str(tmp_path / "sock")
+        host.listen(f"ipc://{path}")
+        client.connect(f"ipc://{path}")
+        host.define("f", lambda x: x * 3)
+        assert client.sync("host", "f", 14) == 42
+    finally:
+        host.close()
+        client.close()
+
+
+def test_sync_throughput_canary(pair):
+    """Reference floor: warn if <1000 sync no-op calls/s (test_tensors.py:46-66)."""
+    host, client = pair
+    client.set_timeout(30)
+    host.define("noop", lambda: None)
+    client.sync("host", "noop")  # warm up
+    n = 128
+    t0 = time.time()
+    for _ in range(n):
+        client.sync("host", "noop")
+    rate = n / (time.time() - t0)
+    print(f"sync noop rate: {rate:.0f}/s")
+    assert rate > 300, f"sync call rate very low: {rate:.0f}/s"
+
+
+def test_async_throughput_canary(pair):
+    """Reference floor: warn if <500 async no-op calls/s over a 2000-call pipeline."""
+    host, client = pair
+    client.set_timeout(60)
+    host.define("noop", lambda: None)
+    client.sync("host", "noop")
+    n = 2000
+    t0 = time.time()
+    futures = [client.async_("host", "noop") for _ in range(n)]
+    for f in futures:
+        f.result()
+    rate = n / (time.time() - t0)
+    print(f"async noop rate: {rate:.0f}/s")
+    assert rate > 500, f"async call rate very low: {rate:.0f}/s"
+
+
+def test_debug_info(pair):
+    host, client = pair
+    client.set_timeout(5)
+    host.define("noop", lambda: None)
+    client.sync("host", "noop")
+    info = client.debug_info()
+    assert "host" in info and "outstanding" in info
+
+
+def test_define_collision(pair):
+    host, _ = pair
+    host.define("dup", lambda: 1)
+    with pytest.raises(RpcError):
+        host.define("dup", lambda: 2)
+    host.undefine("dup")
+    host.define("dup", lambda: 3)
+
+
+def test_create_uid():
+    uid = moolib_tpu.create_uid()
+    assert len(uid) == 16 and uid != moolib_tpu.create_uid()

@@ -1,0 +1,89 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moolib_tpu.rpc import serialization as ser
+
+
+class Custom:
+    __slots__ = ["a", "b"]
+
+    def __init__(self, a, b):
+        self.a = a
+        self.b = b
+
+    def __getstate__(self):
+        return (self.a, self.b)
+
+    def __setstate__(self, s):
+        self.a, self.b = s
+
+    def __eq__(self, other):
+        return (self.a, self.b) == (other.a, other.b)
+
+
+@pytest.mark.parametrize(
+    "obj",
+    [
+        None,
+        True,
+        42,
+        3.14,
+        "hello",
+        b"bytes",
+        [1, 2, 3],
+        (4, 5),
+        {"k": [1, {"n": None}]},
+        Custom(1, "x"),
+    ],
+)
+def test_roundtrip_plain(obj):
+    assert ser.loads(ser.dumps(obj)) == obj
+
+
+def test_roundtrip_numpy():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    y = ser.loads(ser.dumps({"x": x}))["x"]
+    assert isinstance(y, np.ndarray)
+    np.testing.assert_array_equal(x, y)
+    y[0, 0, 0] = 99  # must be writable (copied out of the wire buffer)
+
+
+def test_roundtrip_jax_array():
+    x = jnp.linspace(0, 1, 16).reshape(4, 4)
+    out = ser.loads(ser.dumps([x, "tag"]))
+    y = out[0]
+    assert isinstance(y, jax.Array)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+    assert out[1] == "tag"
+
+
+def test_roundtrip_bfloat16():
+    x = jnp.ones((8, 128), dtype=jnp.bfloat16) * 1.5
+    y = ser.loads(ser.dumps(x))
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(x, np.float32), np.asarray(y, np.float32))
+
+
+def test_arrays_out_of_band():
+    x = np.zeros(1000, dtype=np.float64)
+    sp = ser.serialize({"x": x, "n": 3})
+    # The 8000-byte payload must be out of band, not in the pickle stream.
+    assert len(sp.payload) < 500
+    assert len(sp.arrays) == 1
+    assert sp.arrays[0].shape == (1000,)
+
+
+def test_noncontiguous_numpy():
+    x = np.arange(20, dtype=np.int64).reshape(4, 5)[:, ::2]
+    y = ser.loads(ser.dumps(x))
+    np.testing.assert_array_equal(x, y)
+
+
+def test_nested_args_kwargs_shape():
+    args = (np.ones(3), {"deep": [jnp.zeros(2)]})
+    kwargs = {"key": np.int32(7)}
+    a2, k2 = ser.loads(ser.dumps((args, kwargs)))
+    np.testing.assert_array_equal(a2[0], np.ones(3))
+    assert k2["key"] == 7

@@ -1,0 +1,150 @@
+"""Broker: central (but stateless-restartable) membership registry.
+
+Counterpart of the reference's ``BrokerService`` (``src/broker.h:99-237``) and
+broker CLI (``py/moolib/broker.py:21-40``).  Peers ping the broker with their
+group name; the broker evicts peers whose pings stop, and on any membership
+change bumps the group's epoch (``sync_id``) and pushes the new sorted member
+list to every member.  Allreduce epochs are keyed by ``sync_id``, which is
+what makes the whole stack elastic: a pushed update cancels in-flight
+reductions on the clients (see ``moolib_tpu.group``).
+
+Run standalone with ``python -m moolib_tpu.broker``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from . import utils
+from .rpc import Rpc
+
+
+class _BrokerGroup:
+    __slots__ = ("name", "members", "sync_id", "active_members", "needs_update", "last_update")
+
+    def __init__(self, name: str):
+        self.name = name
+        # peer name -> {"last_ping": t, "sort_order": int}
+        self.members: Dict[str, dict] = {}
+        self.sync_id = int(time.time() * 1000) % (1 << 40)
+        self.active_members: list = []
+        self.needs_update = False
+        self.last_update = 0.0
+
+
+class Broker:
+    """Coordinates a cohort during training (same API as the reference)."""
+
+    def __init__(self, rpc: Optional[Rpc] = None):
+        self._rpc = rpc if rpc is not None else Rpc()
+        self._groups: Dict[str, _BrokerGroup] = {}
+        self._timeout = 10.0
+        self._rpc.define("__broker_ping", self._on_ping)
+        self._rpc.define("__broker_resync", self._on_resync)
+
+    # transparent passthroughs ------------------------------------------------
+    def set_name(self, name: str) -> None:
+        self._rpc.set_name(name)
+
+    def listen(self, address: str) -> None:
+        self._rpc.listen(address)
+
+    def set_timeout(self, seconds: float) -> None:
+        self._timeout = float(seconds)
+
+    @property
+    def rpc(self) -> Rpc:
+        return self._rpc
+
+    # service -----------------------------------------------------------------
+    def _on_ping(self, group_name: str, peer_name: str, sort_order: int, client_sync_id):
+        g = self._groups.setdefault(group_name, _BrokerGroup(group_name))
+        m = g.members.get(peer_name)
+        if m is None:
+            g.members[peer_name] = {"last_ping": time.monotonic(), "sort_order": sort_order}
+            g.needs_update = True
+        else:
+            m["last_ping"] = time.monotonic()
+            m["sort_order"] = sort_order
+        return {"sync_id": g.sync_id, "timeout": self._timeout}
+
+    def _on_resync(self, group_name: str, peer_name: str):
+        """A client whose sync_id went stale asks for the member list again."""
+        g = self._groups.get(group_name)
+        if g is None:
+            return None
+        self._push_to(g, peer_name)
+        return {"sync_id": g.sync_id}
+
+    # pump --------------------------------------------------------------------
+    def update(self) -> None:
+        """Evict silent peers and push membership epochs. Call regularly
+        (~0.25 s cadence, reference ``py/moolib/broker.py:31-36``)."""
+        now = time.monotonic()
+        for g in self._groups.values():
+            evicted = [
+                name
+                for name, m in g.members.items()
+                if now - m["last_ping"] > self._timeout
+            ]
+            for name in evicted:
+                del g.members[name]
+                g.needs_update = True
+            # Rate-limit epoch bumps (reference: 2 s; we use 0.5 s so tests
+            # with churn settle fast).
+            if g.needs_update and now - g.last_update > 0.5:
+                g.needs_update = False
+                g.last_update = now
+                g.sync_id += 1
+                g.active_members = sorted(
+                    g.members, key=lambda n: (g.members[n]["sort_order"], n)
+                )
+                utils.log_info(
+                    "broker: group %s sync_id=%d members=%s",
+                    g.name,
+                    g.sync_id,
+                    g.active_members,
+                )
+                for name in g.active_members:
+                    self._push_to(g, name)
+
+    def _push_to(self, g: _BrokerGroup, peer_name: str) -> None:
+        def _ignore(result, error):
+            if error is not None:
+                utils.log_verbose("broker: push to %s failed: %s", peer_name, error)
+
+        self._rpc.async_callback(
+            peer_name, "__group_update", _ignore, g.name, g.sync_id, list(g.active_members)
+        )
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="moolib_tpu broker")
+    parser.add_argument("--address", default="0.0.0.0:4431")
+    parser.add_argument("--name", default="broker")
+    parser.add_argument("--interval", type=float, default=0.25)
+    args = parser.parse_args(argv)
+
+    rpc = Rpc()
+    broker = Broker(rpc)
+    broker.set_name(args.name)
+    broker.listen(args.address)
+    print(f"Broker {args.name!r} listening on {args.address}")
+    try:
+        while True:
+            broker.update()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        broker.close()
+
+
+if __name__ == "__main__":
+    main()

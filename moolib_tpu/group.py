@@ -1,0 +1,319 @@
+"""Elastic peer groups and binary-tree allreduce over RPC.
+
+Counterpart of the reference's ``GroupService``/``AllReduceService``/``Group``
+(``src/group.{h,cc}``): clients ping the broker, receive membership epochs
+(``sync_id``), and run allreduce over a binary tree laid out by member index —
+leaf→root reduction, then the result is shared back down the same tree.
+Out-of-order contributions (a peer that learned the new epoch before us) are
+parked and consumed when the local operation starts (reference retry queue,
+``src/group.h:662-679``).  A membership change cancels every in-flight
+reduction with a "group changed" error — elasticity comes from the epoch key,
+not from any attempt to patch a running reduction.
+
+TPU note: this RPC tree is the *control/elastic* data plane (DCN-class).  For
+a static cohort that forms a jax device mesh, gradient reduction should ride
+XLA collectives over ICI instead — see ``moolib_tpu.parallel`` and the
+Accumulator's mesh backend.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import utils
+from .utils import nest
+from .rpc import Future, Rpc, RpcError
+
+_OPS: Dict[str, Callable] = {
+    "sum": lambda a, b: a + b,
+    "product": lambda a, b: a * b,
+    "min": lambda a, b: np.minimum(a, b) if _is_arr(a) else min(a, b),
+    "max": lambda a, b: np.maximum(a, b) if _is_arr(a) else max(a, b),
+}
+
+
+def _is_arr(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _reduce_values(op: Callable, a, b):
+    """Element-wise reduce two pytrees (or opaque leaves) with ``op``."""
+    return nest.map_many(op, a, b)
+
+
+class AllReduce(Future):
+    """A future result of an AllReduce operation (same API as reference)."""
+
+
+class _Op:
+    __slots__ = ("key", "value", "op", "future", "contribs", "sent_up", "started_at")
+
+    def __init__(self, key, value, op, future):
+        self.key = key
+        self.value = value
+        self.op = op
+        self.future = future
+        self.contribs: List[Any] = []
+        self.sent_up = False
+        self.started_at = time.monotonic()
+
+
+class Group:
+    """A group of Rpc peers allowing coordinated AllReduce (reference API:
+    update/set_broker_name/set_timeout/set_sort_order/members/sync_id/name/
+    active/all_reduce)."""
+
+    def __init__(self, rpc: Rpc, name: str):
+        self._rpc = rpc
+        self._name = name
+        self._broker_name = "broker"
+        self._timeout = 60.0
+        self._sort_order = 0
+        self._lock = threading.RLock()
+        self._sync_id: Optional[int] = None
+        self._members: List[str] = []
+        self._last_ping = 0.0
+        self._ping_interval = 1.0
+        self._ping_inflight = False
+        self._stale_since: Optional[float] = None
+        self._ops: Dict[Tuple, _Op] = {}
+        self._parked: Dict[Tuple, List[Any]] = {}
+        self._seq: Dict[Tuple, int] = {}  # (sync_id, op name) -> next seq
+        self._recv_seq: Dict[Tuple, int] = {}
+        self._on_change_callbacks: List[Callable] = []
+        self._register_handlers()
+
+    # ------------------------------------------------------------------ setup
+    def _register_handlers(self):
+        # Several Groups can share one Rpc; handlers are defined once and
+        # dispatch on the group name (first argument).
+        registry = getattr(self._rpc, "_moolib_groups", None)
+        if registry is None:
+            registry = {}
+            self._rpc._moolib_groups = registry
+            rpc = self._rpc
+
+            def dispatch(method):
+                def handler(group_name, *args):
+                    g = registry.get(group_name)
+                    if g is None:
+                        return None
+                    return method(g, *args)
+
+                return handler
+
+            rpc.define("__group_update", dispatch(Group._on_update))
+            rpc.define("__group_reduce", dispatch(Group._on_reduce))
+            rpc.define("__group_share", dispatch(Group._on_share))
+        if self._name in registry:
+            raise RpcError(f"group {self._name!r} already exists on this Rpc")
+        registry[self._name] = self
+
+    # ------------------------------------------------------------------- api
+    def set_broker_name(self, name: str) -> None:
+        self._broker_name = name
+
+    def set_timeout(self, seconds: float) -> None:
+        self._timeout = float(seconds)
+
+    def set_sort_order(self, order: int) -> None:
+        self._sort_order = int(order)
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return list(self._members)
+
+    def sync_id(self):
+        return self._sync_id
+
+    def name(self) -> str:
+        return self._name
+
+    def active(self) -> bool:
+        with self._lock:
+            return self._sync_id is not None and self._rpc.get_name() in self._members
+
+    def add_change_callback(self, cb: Callable) -> None:
+        """Extension over the reference: observe membership epoch changes."""
+        self._on_change_callbacks.append(cb)
+
+    def update(self) -> None:
+        """Pump: ping the broker, request resync when stale, sweep op timeouts.
+
+        Mirrors the reference's ping-driven ``GroupService::update``
+        (``src/group.h:394-490``); call it regularly from the train loop.
+        """
+        now = time.monotonic()
+        if now - self._last_ping >= self._ping_interval and not self._ping_inflight:
+            self._last_ping = now
+            self._ping_inflight = True
+            self._rpc.async_callback(
+                self._broker_name,
+                "__broker_ping",
+                self._on_ping_reply,
+                self._name,
+                self._rpc.get_name(),
+                self._sort_order,
+                self._sync_id,
+            )
+        with self._lock:
+            expired = [
+                op for op in self._ops.values() if now - op.started_at > self._timeout
+            ]
+            for op in expired:
+                del self._ops[op.key]
+            for op in expired:
+                op.future.set_exception(RpcError(f"allreduce {op.key} timed out"))
+
+    def _on_ping_reply(self, result, error):
+        self._ping_inflight = False
+        if error is not None:
+            utils.log_verbose("group %s: broker ping failed: %s", self._name, error)
+            return
+        remote_sync = result["sync_id"]
+        with self._lock:
+            stale = remote_sync != self._sync_id
+            if not stale:
+                self._stale_since = None
+                return
+            # The broker pushes updates on change; if we stay stale for more
+            # than a couple of pings we likely missed the push — ask again.
+            now = time.monotonic()
+            if self._stale_since is None:
+                self._stale_since = now
+                return
+            want_resync = now - self._stale_since > 2 * self._ping_interval
+        if want_resync:
+            self._stale_since = None
+            self._rpc.async_callback(
+                self._broker_name,
+                "__broker_resync",
+                lambda r, e: None,
+                self._name,
+                self._rpc.get_name(),
+            )
+
+    # ------------------------------------------------------------ membership
+    def _on_update(self, sync_id: int, members: List[str]):
+        with self._lock:
+            if self._sync_id is not None and sync_id <= self._sync_id:
+                return None  # stale push
+            self._sync_id = sync_id
+            self._members = list(members)
+            self._stale_since = None
+            # Cancel everything in flight: the tree changed under it
+            # (reference cancels with "group change", src/group.h:453-460).
+            ops, self._ops = list(self._ops.values()), {}
+            self._parked.clear()
+            self._seq.clear()
+            self._recv_seq.clear()
+        for op in ops:
+            op.future.set_exception(RpcError("group changed"))
+        for cb in self._on_change_callbacks:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                utils.log_error("group change callback failed")
+        utils.log_verbose(
+            "group %s: sync_id=%s members=%s", self._name, sync_id, members
+        )
+        return None
+
+    # -------------------------------------------------------------- topology
+    def _tree(self) -> Tuple[int, Optional[int], List[int]]:
+        """(my_index, parent_index, child_indices) in the current epoch."""
+        me = self._rpc.get_name()
+        idx = self._members.index(me)
+        parent = None if idx == 0 else (idx - 1) // 2
+        n = len(self._members)
+        children = [c for c in (2 * idx + 1, 2 * idx + 2) if c < n]
+        return idx, parent, children
+
+    # -------------------------------------------------------------- allreduce
+    def all_reduce(self, name: str, value, op="sum") -> AllReduce:
+        """Start an allreduce of ``value`` under ``name``; all active members
+        must call with the same name (and call order per name)."""
+        future = AllReduce()
+        reduce_fn = _OPS[op] if isinstance(op, str) else op
+        with self._lock:
+            if self._sync_id is None or self._rpc.get_name() not in self._members:
+                future.set_exception(RpcError("group not active"))
+                return future
+            seq_key = (self._sync_id, name)
+            seq = self._seq.get(seq_key, 0)
+            self._seq[seq_key] = seq + 1
+            key = (self._sync_id, name, seq)
+            if len(self._members) == 1:
+                future.set_result(value)
+                return future
+            opstate = _Op(key, value, reduce_fn, future)
+            self._ops[key] = opstate
+            parked = self._parked.pop(key, [])
+            opstate.contribs.extend(parked)
+            self._check_op_locked(opstate)
+        return future
+
+    def _on_reduce(self, key, value):
+        key = tuple(key) if isinstance(key, list) else key
+        with self._lock:
+            if self._sync_id is None or key[0] != self._sync_id:
+                return None  # contribution from a dead epoch
+            op = self._ops.get(key)
+            if op is None:
+                self._parked.setdefault(key, []).append(value)
+                return None
+            op.contribs.append(value)
+            self._check_op_locked(op)
+        return None
+
+    def _check_op_locked(self, op: _Op):
+        idx, parent, children = self._tree()
+        if op.sent_up or len(op.contribs) < len(children):
+            return
+        total = op.value
+        for c in op.contribs[: len(children)]:
+            total = _reduce_values(op.op, total, c)
+        op.sent_up = True
+        if parent is None:
+            # Root: reduction complete — share down the tree.
+            del self._ops[op.key]
+            self._share_down(op.key, total, idx)
+            op.future.set_result(total)
+        else:
+            parent_name = self._members[parent]
+
+            def _sent(result, error, op=op):
+                if error is not None:
+                    with self._lock:
+                        self._ops.pop(op.key, None)
+                    op.future.set_exception(RpcError(f"allreduce send failed: {error}"))
+
+            self._rpc.async_callback(
+                parent_name, "__group_reduce", _sent, self._name, op.key, total
+            )
+
+    def _on_share(self, key, result):
+        key = tuple(key) if isinstance(key, list) else key
+        with self._lock:
+            if self._sync_id is None or key[0] != self._sync_id:
+                return None
+            op = self._ops.pop(key, None)
+            if op is None:
+                return None
+            idx, _, _ = self._tree()
+        self._share_down(key, result, idx)
+        op.future.set_result(result)
+        return None
+
+    def _share_down(self, key, result, idx: int):
+        n = len(self._members)
+        for c in (2 * idx + 1, 2 * idx + 2):
+            if c < n:
+                child = self._members[c]
+                self._rpc.async_callback(
+                    child, "__group_share", lambda r, e: None, self._name, key, result
+                )

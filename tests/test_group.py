@@ -1,0 +1,200 @@
+"""Broker/Group/AllReduce tests — the reference's multi-node-without-a-cluster
+pattern (test/test_group.py, test/test_reduce.py): N real peers + a broker in
+ONE process over loopback, driven by explicit update() pumping."""
+
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu import Broker, Group, Rpc, RpcError
+
+
+def make_cohort(free_port, n, group_name="g", timeout=5.0):
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.set_timeout(timeout)
+    broker.listen(addr)
+    peers = []
+    for i in range(n):
+        rpc = Rpc()
+        rpc.set_name(f"peer{i}")
+        rpc.set_timeout(10)
+        rpc.listen("127.0.0.1:0")
+        rpc.connect(addr)
+        g = Group(rpc, group_name)
+        g.set_timeout(timeout)
+        peers.append((rpc, g))
+    return broker, peers
+
+
+def pump(broker, groups, seconds, until=None):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        broker.update()
+        for g in groups:
+            g.update()
+        if until is not None and until():
+            return True
+        time.sleep(0.02)
+    return until() if until is not None else None
+
+
+def close_all(broker, peers):
+    for rpc, _ in peers:
+        rpc.close()
+    broker.close()
+
+
+def test_group_membership(free_port):
+    broker, peers = make_cohort(free_port, 4)
+    try:
+        groups = [g for _, g in peers]
+        ok = pump(
+            broker,
+            groups,
+            15,
+            until=lambda: all(len(g.members()) == 4 and g.active() for g in groups),
+        )
+        assert ok, f"membership never converged: {[g.members() for g in groups]}"
+        ms = groups[0].members()
+        assert ms == sorted(ms)
+        assert all(g.members() == ms for g in groups)
+        assert all(g.sync_id() == groups[0].sync_id() for g in groups)
+    finally:
+        close_all(broker, peers)
+
+
+def test_allreduce_sum_scalar_and_tree(free_port):
+    broker, peers = make_cohort(free_port, 5)
+    try:
+        groups = [g for _, g in peers]
+        assert pump(broker, groups, 15, until=lambda: all(g.active() for g in groups))
+        futures = [g.all_reduce("x", i + 1) for i, g in enumerate(groups)]
+        pump(broker, groups, 5, until=lambda: all(f.done() for f in futures))
+        results = [f.result(5) for f in futures]
+        assert results == [15] * 5  # 1+2+3+4+5
+    finally:
+        close_all(broker, peers)
+
+
+def test_allreduce_arrays_and_ops(free_port):
+    broker, peers = make_cohort(free_port, 4)
+    try:
+        groups = [g for _, g in peers]
+        assert pump(broker, groups, 15, until=lambda: all(g.active() for g in groups))
+        # sum of pytrees of arrays
+        futs = [
+            g.all_reduce("grads", {"w": np.full((2, 3), float(i)), "b": np.ones(2)})
+            for i, g in enumerate(groups)
+        ]
+        pump(broker, groups, 5, until=lambda: all(f.done() for f in futs))
+        for f in futs:
+            out = f.result(5)
+            np.testing.assert_allclose(out["w"], np.full((2, 3), 6.0))
+            np.testing.assert_allclose(out["b"], 4 * np.ones(2))
+        # max op
+        futs = [g.all_reduce("m", float(i), op="max") for i, g in enumerate(groups)]
+        pump(broker, groups, 5, until=lambda: all(f.done() for f in futs))
+        assert all(f.result(5) == 3.0 for f in futs)
+    finally:
+        close_all(broker, peers)
+
+
+def test_allreduce_repeated(free_port):
+    broker, peers = make_cohort(free_port, 3)
+    try:
+        groups = [g for _, g in peers]
+        assert pump(broker, groups, 15, until=lambda: all(g.active() for g in groups))
+        for round_i in range(5):
+            futs = [g.all_reduce("it", i + round_i) for i, g in enumerate(groups)]
+            pump(broker, groups, 5, until=lambda: all(f.done() for f in futs))
+            expected = sum(i + round_i for i in range(3))
+            assert all(f.result(5) == expected for f in futs)
+    finally:
+        close_all(broker, peers)
+
+
+def test_churn_join_and_leave(free_port):
+    broker, peers = make_cohort(free_port, 3, timeout=2.0)
+    try:
+        groups = [g for _, g in peers]
+        assert pump(broker, groups, 15, until=lambda: all(g.active() for g in groups))
+        first_sync = groups[0].sync_id()
+
+        # A peer leaves (stops pinging): broker evicts it, epoch bumps.
+        gone_rpc, _ = peers.pop()
+        groups.pop()
+        gone_rpc.close()
+        ok = pump(
+            broker,
+            groups,
+            20,
+            until=lambda: all(
+                len(g.members()) == 2 and g.sync_id() != first_sync for g in groups
+            ),
+        )
+        assert ok, f"eviction never happened: {[g.members() for g in groups]}"
+
+        # Reduction still works with the survivors.
+        futs = [g.all_reduce("after", 10 * (i + 1)) for i, g in enumerate(groups)]
+        pump(broker, groups, 5, until=lambda: all(f.done() for f in futs))
+        assert all(f.result(5) == 30 for f in futs)
+
+        # A new peer joins mid-training.
+        addr = f"127.0.0.1:{free_port}"
+        rpc = Rpc()
+        rpc.set_name("latecomer")
+        rpc.set_timeout(10)
+        rpc.listen("127.0.0.1:0")
+        rpc.connect(addr)
+        g_new = Group(rpc, "g")
+        g_new.set_timeout(2.0)
+        peers.append((rpc, g_new))
+        groups.append(g_new)
+        ok = pump(
+            broker,
+            groups,
+            20,
+            until=lambda: all(len(g.members()) == 3 and g.active() for g in groups),
+        )
+        assert ok, f"join never converged: {[g.members() for g in groups]}"
+        futs = [g.all_reduce("with_new", 1) for g in groups]
+        pump(broker, groups, 5, until=lambda: all(f.done() for f in futs))
+        assert all(f.result(5) == 3 for f in futs)
+    finally:
+        close_all(broker, peers)
+
+
+def test_inflight_cancelled_on_group_change(free_port):
+    broker, peers = make_cohort(free_port, 3, timeout=2.0)
+    try:
+        groups = [g for _, g in peers]
+        assert pump(broker, groups, 15, until=lambda: all(g.active() for g in groups))
+        # Only 2 of 3 members contribute; then a member dies -> epoch change
+        # must cancel the stuck reduction with an error.
+        f0 = groups[0].all_reduce("stuck", 1.0)
+        f1 = groups[1].all_reduce("stuck", 2.0)
+        victim_rpc, _ = peers.pop()
+        groups_alive = groups[:2]
+        groups.pop()
+        victim_rpc.close()
+        pump(broker, groups_alive, 20, until=lambda: f0.done() and f1.done())
+        for f in (f0, f1):
+            assert f.done()
+            with pytest.raises(RpcError):
+                f.result(1)
+    finally:
+        close_all(broker, peers)
+
+
+def test_single_member_group(free_port):
+    broker, peers = make_cohort(free_port, 1)
+    try:
+        groups = [g for _, g in peers]
+        assert pump(broker, groups, 15, until=lambda: groups[0].active())
+        f = groups[0].all_reduce("solo", 42)
+        assert f.result(5) == 42
+    finally:
+        close_all(broker, peers)

@@ -1,0 +1,470 @@
+"""Accumulator: the asynchronous data-parallel gradient/state sync machine.
+
+Counterpart of the reference's ``Accumulator`` (``src/accumulator.{h,cc}``,
+bindings ``src/moolib.cc:1645-1872``): elastic data parallelism where peers
+join/leave freely.  On every membership epoch the cohort elects a leader by
+allreducing ``max(model_version, name)`` (``src/accumulator.cc:581-625``);
+non-leaders request the model (+ user state: optimizer etc.) from the leader;
+gradients are averaged cohort-wide with *virtual batch sizes* — a reduction
+only "fires" once the summed batch size reaches ``virtual_batch_size``, so
+the effective batch is stable no matter how many peers are alive
+(``src/accumulator.cc:880-1078``; semantics ``examples/README.md:89-115``).
+
+The user-facing wants/has protocol is identical to the reference::
+
+    accumulator.update()                  # pump, every iteration
+    if accumulator.wants_state():         # leader: someone needs user state
+        accumulator.set_state({...})
+    if accumulator.has_new_state():       # non-leader: got model + user state
+        ... = accumulator.state()
+    if accumulator.has_gradients():       # reduction finished
+        grads = accumulator.gradients()   # averaged pytree  (jax adaptation)
+        params = optimizer_step(params, grads)
+        accumulator.set_parameters(params)
+        accumulator.zero_gradients()
+    elif accumulator.wants_gradients():
+        accumulator.reduce_gradients(batch_size, grads)   # or skip_gradients()
+
+jax adaptation: the reference mutates ``param.grad`` in place; jax arrays are
+immutable, so gradients are *passed* to ``reduce_gradients`` and fetched with
+``gradients()``, and the model is an explicit pytree handed back with
+``set_parameters`` after the optimizer step.  Reduction rides the Group's
+binary-tree RPC allreduce (elastic, works across hosts over DCN); for a
+static in-mesh cohort use ``moolib_tpu.parallel`` psum over ICI inside the
+jitted train step instead — same math, collective data plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from . import utils
+from .utils import nest
+from .group import Group
+from .rpc import Rpc, RpcError
+
+_MODEL_PUSH_INTERVAL = 600.0  # reference: regular model broadcast every 600 s
+_BUFFERS_PUSH_INTERVAL = 12.0  # reference: buffers broadcast every 12 s
+_MODEL_REQUEST_RETRY = 2.0
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
+def _tree_zeros_like(t):
+    return jax.tree_util.tree_map(lambda x: np.zeros_like(np.asarray(x)), t)
+
+
+class Accumulator:
+    """See module docstring. API mirrors the reference's pybind surface."""
+
+    def __init__(
+        self,
+        name: str,
+        parameters,
+        buffers=None,
+        group: Optional[Group] = None,
+        rpc: Optional[Rpc] = None,
+    ):
+        self._name = name
+        self._params = parameters
+        self._buffers = buffers
+        self._lock = threading.RLock()
+
+        self._standalone = group is None
+        if group is None:
+            self._rpc = rpc if rpc is not None else Rpc()
+            self._group = Group(self._rpc, name)
+        else:
+            self._group = group
+            self._rpc = group._rpc
+        self._group.add_change_callback(self._on_group_change)
+
+        # model / election state
+        self._model_version = 0
+        self._leader: Optional[str] = None
+        self._is_leader = False
+        self._election_future = None
+        self._epoch_synced = False  # got (or am serving) the model this epoch
+        self._staged_model = None  # incoming model update awaiting commit
+        self._last_model_request = 0.0
+        self._last_model_push = 0.0
+        self._last_buffers_push = 0.0
+
+        # state (user blob) machinery
+        self._state_requesters: List[str] = []
+        self._received_state = None
+        self._has_new_state = False
+
+        # gradient machinery
+        self._virtual_batch_size: Optional[int] = None
+        self._parallel_gradients = 1
+        self._reduction_inflight = False
+        self._accum_grads = None
+        self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+        self._has_gradients = False
+        self._result_grads = None
+        self._result_stats: Dict[str, int] = {}
+
+        self._register_service()
+
+    # ----------------------------------------------------------------- setup
+    def _register_service(self):
+        registry = getattr(self._rpc, "_moolib_accums", None)
+        if registry is None:
+            registry = {}
+            self._rpc._moolib_accums = registry
+            rpc = self._rpc
+
+            def dispatch(method_name):
+                def handler(accum_name, *args):
+                    a = registry.get(accum_name)
+                    if a is None:
+                        raise RpcError(f"no accumulator {accum_name!r} on this peer")
+                    return getattr(a, method_name)(*args)
+
+                return handler
+
+            rpc.define("__accum_request_model", dispatch("_on_request_model"))
+            rpc.define("__accum_model_update", dispatch("_on_model_update"))
+            rpc.define("__accum_buffers_update", dispatch("_on_buffers_update"))
+        if self._name in registry:
+            raise RpcError(f"accumulator {self._name!r} already exists on this Rpc")
+        registry[self._name] = self
+
+    def connect(self, address: str) -> None:
+        """Connect to the broker coordinating this cohort."""
+        self._rpc.connect(address)
+
+    # ------------------------------------------------------------- accessors
+    def connected(self) -> bool:
+        with self._lock:
+            return self._group.active() and self._leader is not None and self._epoch_synced
+
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def get_leader(self) -> Optional[str]:
+        return self._leader
+
+    def model_version(self) -> int:
+        return self._model_version
+
+    def set_model_version(self, n: int) -> None:
+        """Set after restoring a checkpoint so leader election prefers the
+        restored peer (reference ``src/moolib.cc:1808-1821``)."""
+        self._model_version = int(n)
+
+    def set_virtual_batch_size(self, n: int) -> None:
+        self._virtual_batch_size = int(n)
+
+    def set_parallel_gradients(self, n: int) -> None:
+        self._parallel_gradients = int(n)
+
+    def parameters(self):
+        """Current synced parameter pytree (jax adaptation of the reference's
+        in-place tensor updates)."""
+        return self._params
+
+    def set_parameters(self, parameters) -> None:
+        """Hand the post-optimizer-step parameters back to the accumulator."""
+        with self._lock:
+            self._params = parameters
+
+    def buffers(self):
+        return self._buffers
+
+    def set_buffers(self, buffers) -> None:
+        with self._lock:
+            self._buffers = buffers
+
+    # state (user blob) ----------------------------------------------------
+    def wants_state(self) -> bool:
+        with self._lock:
+            return self._is_leader and bool(self._state_requesters)
+
+    def set_state(self, state) -> None:
+        """Leader: provide user state; it is pushed (with the model) to every
+        peer that requested it."""
+        with self._lock:
+            requesters, self._state_requesters = self._state_requesters, []
+            params, buffers, version = self._params, self._buffers, self._model_version
+        for peer in requesters:
+            self._rpc.async_callback(
+                peer,
+                "__accum_model_update",
+                lambda r, e: None,
+                self._name,
+                version,
+                params,
+                buffers,
+                state,
+            )
+
+    def has_new_state(self) -> bool:
+        return self._has_new_state
+
+    def state(self):
+        with self._lock:
+            self._has_new_state = False
+            return self._received_state
+
+    # gradients ------------------------------------------------------------
+    def wants_gradients(self) -> bool:
+        with self._lock:
+            return (
+                self.connected() and not self._reduction_inflight and not self._has_gradients
+            )
+
+    def has_gradients(self) -> bool:
+        return self._has_gradients
+
+    def reduce_gradients(self, batch_size: int, gradients=None) -> None:
+        """Contribute local gradients (a pytree) with their batch size and
+        start/continue the asynchronous cohort reduction."""
+        if gradients is None:
+            raise ValueError(
+                "jax adaptation: pass the gradient pytree explicitly, "
+                "reduce_gradients(batch_size, gradients)"
+            )
+        self._start_round(
+            {"num_gradients": 1, "num_skipped": 0, "batch_size": int(batch_size)},
+            gradients,
+        )
+
+    def skip_gradients(self) -> None:
+        """Participate in this reduction round without contributing data."""
+        self._start_round({"num_gradients": 0, "num_skipped": 1, "batch_size": 0}, None)
+
+    def _start_round(self, stats: Dict[str, int], gradients):
+        with self._lock:
+            if not self.connected():
+                raise RpcError("accumulator is not connected")
+            if self._reduction_inflight:
+                raise RpcError("a gradient reduction is already in flight")
+            if self._has_gradients:
+                raise RpcError("unconsumed gradients; call zero_gradients() first")
+            self._reduction_inflight = True
+            payload = {
+                "grads": gradients,
+                "num_gradients": stats["num_gradients"],
+                "num_skipped": stats["num_skipped"],
+                "batch_size": stats["batch_size"],
+            }
+            fut = self._group.all_reduce(f"__accum_grad:{self._name}", payload, op=_grad_reduce_op)
+            fut.add_done_callback(self._on_reduce_done)
+
+    def _on_reduce_done(self, fut):
+        exc = fut.exception()
+        with self._lock:
+            self._reduction_inflight = False
+            if exc is not None:
+                # Group changed or timeout: local contribution is lost; the
+                # user will see wants_gradients() and produce a fresh one
+                # (same observable behavior as the reference's cancel path).
+                utils.log_verbose("accumulator %s: reduction failed: %s", self._name, exc)
+                return
+            result = fut.result(0)
+            # Accumulate across rounds until the virtual batch size is met.
+            if self._accum_grads is None and result["grads"] is not None:
+                self._accum_grads = result["grads"]
+            elif result["grads"] is not None:
+                self._accum_grads = _tree_add(self._accum_grads, result["grads"])
+            for k in ("num_gradients", "num_skipped", "batch_size"):
+                self._accum_stats[k] += result[k]
+            target = self._virtual_batch_size or 1
+            if self._accum_stats["batch_size"] >= target and self._accum_stats["num_gradients"] > 0:
+                n = self._accum_stats["num_gradients"]
+                self._result_grads = jax.tree_util.tree_map(
+                    lambda x: x / n, self._accum_grads
+                )
+                self._result_stats = dict(self._accum_stats)
+                self._accum_grads = None
+                self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+                self._has_gradients = True
+
+    def gradients(self):
+        """The cohort-averaged gradient pytree (valid while has_gradients())."""
+        with self._lock:
+            if not self._has_gradients:
+                raise RpcError("no gradients available")
+            return self._result_grads
+
+    def get_gradient_stats(self) -> Dict[str, int]:
+        return dict(self._result_stats)
+
+    def zero_gradients(self) -> None:
+        with self._lock:
+            self._has_gradients = False
+            self._result_grads = None
+            self._model_version += 1
+
+    # ------------------------------------------------------------------ pump
+    def update(self) -> None:
+        """Internal book-keeping; call every iteration of the train loop."""
+        if self._standalone:
+            self._group.update()
+        now = time.monotonic()
+        with self._lock:
+            leader = self._leader
+            is_leader = self._is_leader
+            synced = self._epoch_synced
+            # Commit a staged model update (deferred so the user thread owns
+            # the model, reference commitModelUpdate src/accumulator.cc:810-836).
+            if self._staged_model is not None:
+                version, params, buffers, state = self._staged_model
+                self._staged_model = None
+                self._params = params
+                if buffers is not None:
+                    self._buffers = buffers
+                self._model_version = version
+                if state is not None:
+                    self._received_state = state
+                    self._has_new_state = True
+                self._epoch_synced = True
+                synced = True
+        # Non-leader that hasn't synced this epoch: (re-)request the model.
+        if leader is not None and not is_leader and not synced:
+            if now - self._last_model_request > _MODEL_REQUEST_RETRY:
+                self._last_model_request = now
+                self._rpc.async_callback(
+                    leader,
+                    "__accum_request_model",
+                    lambda r, e: None,
+                    self._name,
+                    self._rpc.get_name(),
+                )
+        # Leader: periodic model/buffer pushes keep long-lived cohorts fresh.
+        if is_leader and self._group.active():
+            if now - self._last_model_push > _MODEL_PUSH_INTERVAL:
+                self._last_model_push = now
+                self._broadcast_model()
+            elif self._buffers is not None and now - self._last_buffers_push > _BUFFERS_PUSH_INTERVAL:
+                self._last_buffers_push = now
+                self._broadcast_buffers()
+
+    # ------------------------------------------------------------- elections
+    def _on_group_change(self):
+        """Membership epoch changed: reset transient state, elect a leader
+        (allreduce of max(model_version, name), reference :581-625)."""
+        with self._lock:
+            self._leader = None
+            self._is_leader = False
+            self._epoch_synced = False
+            self._staged_model = None
+            self._reduction_inflight = False
+            self._accum_grads = None
+            self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+            if not self._group.active():
+                return
+            fut = self._group.all_reduce(
+                f"__accum_elect:{self._name}",
+                (self._model_version, self._rpc.get_name()),
+                op=lambda a, b: max(a, b),  # lexicographic (version, name)
+            )
+            fut.add_done_callback(self._on_election_done)
+
+    def _on_election_done(self, fut):
+        exc = fut.exception()
+        if exc is not None:
+            utils.log_verbose("accumulator %s: election failed: %s", self._name, exc)
+            return
+        version, leader = fut.result(0)
+        with self._lock:
+            self._leader = leader
+            self._is_leader = leader == self._rpc.get_name()
+            if self._is_leader:
+                self._epoch_synced = True
+                self._last_model_push = time.monotonic()
+            self._last_model_request = 0.0
+        utils.log_info(
+            "accumulator %s: leader=%s (version %s)%s",
+            self._name,
+            leader,
+            version,
+            " [me]" if self._is_leader else "",
+        )
+
+    # --------------------------------------------------------- model service
+    def _on_request_model(self, requester: str):
+        """A peer asks for the model; queue it for wants_state()/set_state()
+        (the reference serves the queue when the user provides state)."""
+        with self._lock:
+            if not self._is_leader:
+                raise RpcError(f"{self._rpc.get_name()} is not the leader")
+            if requester not in self._state_requesters:
+                self._state_requesters.append(requester)
+        return True
+
+    def _on_model_update(self, version: int, params, buffers, state):
+        with self._lock:
+            if version < self._model_version:
+                return False
+            self._staged_model = (version, params, buffers, state)
+        return True
+
+    def _on_buffers_update(self, version: int, buffers):
+        with self._lock:
+            if buffers is not None:
+                self._buffers = buffers
+        return True
+
+    def _broadcast_model(self):
+        with self._lock:
+            members = [m for m in self._group.members() if m != self._rpc.get_name()]
+            params, buffers, version = self._params, self._buffers, self._model_version
+        for peer in members:
+            self._rpc.async_callback(
+                peer,
+                "__accum_model_update",
+                lambda r, e: None,
+                self._name,
+                version,
+                params,
+                buffers,
+                None,
+            )
+
+    def _broadcast_buffers(self):
+        with self._lock:
+            members = [m for m in self._group.members() if m != self._rpc.get_name()]
+            buffers, version = self._buffers, self._model_version
+        for peer in members:
+            self._rpc.async_callback(
+                peer,
+                "__accum_buffers_update",
+                lambda r, e: None,
+                self._name,
+                version,
+                buffers,
+            )
+
+    def close(self) -> None:
+        if self._standalone:
+            self._rpc.close()
+
+
+def _grad_reduce_op(a, b):
+    """Reduce two gradient-round payloads: counts add, grad pytrees add
+    (None = a skip contribution)."""
+    if isinstance(a, dict) and "num_gradients" in a:
+        ga, gb = a.get("grads"), b.get("grads")
+        if ga is None:
+            grads = gb
+        elif gb is None:
+            grads = ga
+        else:
+            grads = _tree_add(ga, gb)
+        return {
+            "grads": grads,
+            "num_gradients": a["num_gradients"] + b["num_gradients"],
+            "num_skipped": a["num_skipped"] + b["num_skipped"],
+            "batch_size": a["batch_size"] + b["batch_size"],
+        }
+    return a + b

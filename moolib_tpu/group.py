@@ -40,9 +40,15 @@ def _is_arr(x) -> bool:
     return hasattr(x, "shape") and hasattr(x, "dtype")
 
 
-def _reduce_values(op: Callable, a, b):
-    """Element-wise reduce two pytrees (or opaque leaves) with ``op``."""
-    return nest.map_many(op, a, b)
+def _resolve_op(op) -> Callable:
+    """Builtin string ops reduce leaf-wise over pytrees; a user callable is
+    applied to the *whole* contributed values (so lexicographic tuple compares
+    and struct-valued reductions like the Accumulator's work — reference
+    ``ReduceVariant`` custom py::object ops, ``src/group.h:230-262``)."""
+    if isinstance(op, str):
+        leaf_op = _OPS[op]
+        return lambda a, b: nest.map_many(leaf_op, a, b)
+    return op
 
 
 class AllReduce(Future):
@@ -238,7 +244,7 @@ class Group:
         """Start an allreduce of ``value`` under ``name``; all active members
         must call with the same name (and call order per name)."""
         future = AllReduce()
-        reduce_fn = _OPS[op] if isinstance(op, str) else op
+        reduce_fn = _resolve_op(op)
         with self._lock:
             if self._sync_id is None or self._rpc.get_name() not in self._members:
                 future.set_exception(RpcError("group not active"))
@@ -276,7 +282,7 @@ class Group:
             return
         total = op.value
         for c in op.contribs[: len(children)]:
-            total = _reduce_values(op.op, total, c)
+            total = op.op(total, c)
         op.sent_up = True
         if parent is None:
             # Root: reduction complete — share down the tree.

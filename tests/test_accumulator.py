@@ -1,0 +1,187 @@
+"""Accumulator tests: elastic DP cohort in one process over loopback."""
+
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu import Accumulator, Broker
+
+
+def make_cohort(free_port, n, virtual_batch_size=None, versions=None):
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.set_timeout(5.0)
+    broker.listen(addr)
+    accs = []
+    for i in range(n):
+        params = {"w": np.zeros((2, 2), np.float32), "b": np.zeros(2, np.float32)}
+        acc = Accumulator("model", params, buffers=None)
+        acc._rpc.set_name(f"peer{i}")
+        acc._rpc.set_timeout(10)
+        acc._rpc.listen("127.0.0.1:0")
+        if versions:
+            acc.set_model_version(versions[i])
+        if virtual_batch_size:
+            acc.set_virtual_batch_size(virtual_batch_size)
+        acc.connect(addr)
+        accs.append(acc)
+    return broker, accs
+
+
+def pump(broker, accs, seconds, until=None):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        broker.update()
+        for a in accs:
+            a.update()
+            if a.wants_state():
+                a.set_state({"opt": "state-of-" + a._rpc.get_name(), "v": a.model_version()})
+        if until is not None and until():
+            return True
+        time.sleep(0.02)
+    return until() if until is not None else None
+
+
+def close_all(broker, accs):
+    for a in accs:
+        a.close()
+    broker.close()
+
+
+def test_election_and_model_sync(free_port):
+    broker, accs = make_cohort(free_port, 3, versions=[5, 2, 0])
+    # Give peer0 distinctive params: everyone should converge to them.
+    accs[0].set_parameters({"w": np.full((2, 2), 7.0, np.float32), "b": np.ones(2, np.float32)})
+    try:
+        ok = pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        assert ok, "cohort never connected"
+        assert accs[0].is_leader()  # highest model_version wins
+        assert all(a.get_leader() == "peer0" for a in accs)
+        assert all(a.model_version() == 5 for a in accs)
+        for a in accs[1:]:
+            np.testing.assert_allclose(a.parameters()["w"], 7.0)
+            assert a.has_new_state() or a.state() is not None
+    finally:
+        close_all(broker, accs)
+
+
+def test_gradient_reduction_mean(free_port):
+    broker, accs = make_cohort(free_port, 3)
+    try:
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        for i, a in enumerate(accs):
+            assert a.wants_gradients()
+            g = {"w": np.full((2, 2), float(i + 1), np.float32), "b": np.zeros(2, np.float32)}
+            a.reduce_gradients(8, g)
+        assert pump(broker, accs, 10, until=lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            grads = a.gradients()
+            np.testing.assert_allclose(np.asarray(grads["w"]), 2.0)  # mean of 1,2,3
+            stats = a.get_gradient_stats()
+            assert stats == {"num_gradients": 3, "num_skipped": 0, "batch_size": 24}
+            a.zero_gradients()
+            assert not a.has_gradients() and a.wants_gradients()
+        assert all(a.model_version() == 1 for a in accs)
+    finally:
+        close_all(broker, accs)
+
+
+def test_skip_gradients(free_port):
+    broker, accs = make_cohort(free_port, 2)
+    try:
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        g = {"w": np.ones((2, 2), np.float32), "b": np.ones(2, np.float32)}
+        accs[0].reduce_gradients(4, g)
+        accs[1].skip_gradients()
+        assert pump(broker, accs, 10, until=lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            np.testing.assert_allclose(np.asarray(a.gradients()["w"]), 1.0)
+            assert a.get_gradient_stats() == {
+                "num_gradients": 1,
+                "num_skipped": 1,
+                "batch_size": 4,
+            }
+    finally:
+        close_all(broker, accs)
+
+
+def test_virtual_batch_size(free_port):
+    broker, accs = make_cohort(free_port, 2, virtual_batch_size=16)
+    try:
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        g1 = {"w": np.ones((2, 2), np.float32), "b": np.zeros(2, np.float32)}
+        # Round 1: total batch 8 < 16 -> no gradients yet.
+        for a in accs:
+            a.reduce_gradients(4, g1)
+        assert pump(
+            broker, accs, 10, until=lambda: all(not a._reduction_inflight for a in accs)
+        )
+        assert not any(a.has_gradients() for a in accs)
+        assert all(a.wants_gradients() for a in accs)
+        # Round 2: another 8 reaches the virtual batch -> fires.
+        for a in accs:
+            a.reduce_gradients(4, g1)
+        assert pump(broker, accs, 10, until=lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            stats = a.get_gradient_stats()
+            assert stats["batch_size"] == 16 and stats["num_gradients"] == 4
+            # 4 gradient contributions of all-ones, averaged -> 1.
+            np.testing.assert_allclose(np.asarray(a.gradients()["w"]), 1.0)
+    finally:
+        close_all(broker, accs)
+
+
+def test_late_joiner_gets_model(free_port):
+    broker, accs = make_cohort(free_port, 2, versions=[3, 3])
+    try:
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        leader = [a for a in accs if a.is_leader()][0]
+        leader.set_parameters({"w": np.full((2, 2), 9.0, np.float32), "b": np.zeros(2, np.float32)})
+
+        late = Accumulator(
+            "model", {"w": np.zeros((2, 2), np.float32), "b": np.zeros(2, np.float32)}
+        )
+        late._rpc.set_name("late")
+        late._rpc.set_timeout(10)
+        late._rpc.listen("127.0.0.1:0")
+        late.connect(f"127.0.0.1:{free_port}")
+        accs.append(late)
+        ok = pump(broker, accs, 30, until=lambda: late.connected())
+        assert ok, "late joiner never connected"
+        np.testing.assert_allclose(np.asarray(late.parameters()["w"]), 9.0)
+        assert late.model_version() == leader.model_version()
+        # And the cohort can still reduce together.
+        g = {"w": np.ones((2, 2), np.float32), "b": np.zeros(2, np.float32)}
+        for a in accs:
+            a.reduce_gradients(2, g)
+        assert pump(broker, accs, 10, until=lambda: all(a.has_gradients() for a in accs))
+        assert all(a.get_gradient_stats()["num_gradients"] == 3 for a in accs)
+    finally:
+        close_all(broker, accs)
+
+
+def test_leader_death_reelection(free_port):
+    broker, accs = make_cohort(free_port, 3, versions=[9, 4, 4])
+    broker.set_timeout(2.0)
+    try:
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        leader = [a for a in accs if a.is_leader()][0]
+        assert leader._rpc.get_name() == "peer0"
+        survivors = [a for a in accs if a is not leader]
+        leader.close()
+        accs.remove(leader)
+        ok = pump(
+            broker,
+            survivors,
+            40,
+            until=lambda: all(
+                a.connected() and a.get_leader() != "peer0" for a in survivors
+            ),
+        )
+        assert ok, "re-election never happened"
+        leaders = {a.get_leader() for a in survivors}
+        assert len(leaders) == 1
+    finally:
+        close_all(broker, accs)

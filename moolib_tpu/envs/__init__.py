@@ -1,0 +1,13 @@
+"""Self-contained environments with the gym step/reset protocol.
+
+The reference depends on external ``gym``/ALE for its examples and tests
+(``examples/atari/environment.py:19-40``); this image has neither, so the
+framework ships its own envs: CartPole (classic control, used by the A2C
+example like the reference's CartPole-v1), Catch (a minimal *learnable*
+pixel game standing in for Atari in IMPALA integration tests), and a
+synthetic Atari-shaped env for throughput benchmarking.
+"""
+
+from .cartpole import CartPoleEnv  # noqa: F401
+from .catch import CatchEnv  # noqa: F401
+from .synthetic import SyntheticAtariEnv  # noqa: F401

@@ -1,0 +1,60 @@
+"""Catch: a minimal learnable pixel environment (deepmind bsuite-style task).
+
+A ball falls from the top of a rows×cols board; the agent moves a paddle on
+the bottom row (actions: left/stay/right) and gets +1 for catching, -1 for
+missing.  Serves as the "Atari" stand-in for IMPALA integration tests: pixel
+observations, episodic reward, and solvable quickly from pixels — the role
+ALE/Pong plays for the reference (``examples/vtrace/config.yaml:23-65``),
+without the ALE dependency.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CatchEnv:
+    num_actions = 3
+
+    def __init__(self, rows: int = 10, columns: int = 5, seed=None, frame_shape=None):
+        self.rows = rows
+        self.columns = columns
+        self._rng = np.random.default_rng(seed)
+        self._ball = [0, 0]
+        self._paddle = 0
+        # Optional upscaled frame (e.g. (84, 84)) to exercise conv encoders.
+        self._frame_shape = frame_shape
+
+    @property
+    def observation_shape(self):
+        if self._frame_shape is not None:
+            return (*self._frame_shape, 1)
+        return (self.rows, self.columns, 1)
+
+    def _obs(self):
+        board = np.zeros((self.rows, self.columns, 1), dtype=np.uint8)
+        board[self._ball[0], self._ball[1], 0] = 255
+        board[self.rows - 1, self._paddle, 0] = 255
+        if self._frame_shape is not None:
+            h, w = self._frame_shape
+            ry, rx = h // self.rows, w // self.columns
+            big = np.zeros((h, w, 1), dtype=np.uint8)
+            up = np.kron(board[..., 0], np.ones((ry, rx), dtype=np.uint8))
+            big[: up.shape[0], : up.shape[1], 0] = up
+            return big
+        return board
+
+    def reset(self):
+        self._ball = [0, int(self._rng.integers(self.columns))]
+        self._paddle = self.columns // 2
+        return self._obs()
+
+    def step(self, action):
+        action = int(np.asarray(action).reshape(()))
+        self._paddle = int(np.clip(self._paddle + (action - 1), 0, self.columns - 1))
+        self._ball[0] += 1
+        done = self._ball[0] == self.rows - 1
+        reward = 0.0
+        if done:
+            reward = 1.0 if self._ball[1] == self._paddle else -1.0
+        return self._obs(), reward, done, {}

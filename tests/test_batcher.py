@@ -1,0 +1,102 @@
+"""Batcher property tests vs jnp.stack/cat (reference test/unit/test_batcher.py
+randomized pattern, incl. cat overflow carry)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moolib_tpu import Batcher
+
+
+def test_stack_mode():
+    b = Batcher(4, dim=0)
+    items = [{"x": np.full((2, 3), float(i)), "s": np.float32(i)} for i in range(4)]
+    for it in items:
+        b.stack(it)
+    assert not b.empty()
+    out = b.get()
+    assert out["x"].shape == (4, 2, 3)
+    np.testing.assert_allclose(np.asarray(out["s"]), [0, 1, 2, 3])
+    assert b.empty()
+
+
+def test_stack_dim1():
+    b = Batcher(3, dim=1)
+    for i in range(3):
+        b.stack(np.full((2, 4), float(i)))
+    out = b.get()
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(out[:, 2]), 2.0)
+
+
+def test_cat_exact():
+    b = Batcher(6, dim=0)
+    b.cat(np.arange(4).reshape(4, 1).astype(np.float32))
+    assert b.empty() and b.size() == 4
+    b.cat(np.arange(2).reshape(2, 1).astype(np.float32) + 100)
+    out = b.get()
+    np.testing.assert_allclose(np.asarray(out[:, 0]), [0, 1, 2, 3, 100, 101])
+
+
+def test_cat_overflow_carry():
+    b = Batcher(4, dim=0)
+    b.cat(np.arange(10).reshape(10, 1).astype(np.float32))
+    # 10 rows -> two complete batches of 4, 2 rows carried.
+    out1 = b.get()
+    out2 = b.get()
+    np.testing.assert_allclose(np.asarray(out1[:, 0]), [0, 1, 2, 3])
+    np.testing.assert_allclose(np.asarray(out2[:, 0]), [4, 5, 6, 7])
+    assert b.empty() and b.size() == 2
+    b.cat(np.arange(2).reshape(2, 1).astype(np.float32) + 50)
+    np.testing.assert_allclose(np.asarray(b.get()[:, 0]), [8, 9, 50, 51])
+
+
+def test_cat_randomized_property():
+    rng = np.random.default_rng(7)
+    size = 8
+    b = Batcher(size, dim=0)
+    rows = []
+    total = 0
+    for _ in range(30):
+        n = int(rng.integers(1, 13))
+        item = rng.normal(size=(n, 5)).astype(np.float32)
+        rows.append(item)
+        total += n
+        b.cat({"x": item})
+    expected = np.concatenate(rows)[: (total // size) * size]
+    got = []
+    while not b.empty():
+        got.append(np.asarray(b.get()["x"]))
+    np.testing.assert_allclose(np.concatenate(got), expected, rtol=1e-6)
+
+
+def test_get_without_batch_raises():
+    b = Batcher(2)
+    with pytest.raises(RuntimeError):
+        b.get()
+
+
+def test_device_placement():
+    import jax
+
+    b = Batcher(2, device="cpu:0" if False else None)
+    b2 = Batcher(2, device=jax.devices()[0])
+    for i in range(2):
+        b2.stack(np.full((3,), float(i)))
+    out = b2.get()
+    assert isinstance(out, jax.Array)
+    assert out.shape == (2, 3)
+
+
+def test_await_batches():
+    import asyncio
+
+    b = Batcher(2)
+
+    async def main():
+        b.stack(np.ones(1))
+        b.stack(np.zeros(1))
+        return await b
+
+    out = asyncio.run(main())
+    assert np.asarray(out).shape == (2, 1)

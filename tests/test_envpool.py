@@ -1,0 +1,109 @@
+"""EnvPool tests: real fork/shm machinery with tensor envs (reference
+test/unit/test_envpool.py pattern)."""
+
+import numpy as np
+import pytest
+
+from moolib_tpu import EnvPool
+from moolib_tpu.envs import CartPoleEnv, CatchEnv
+
+
+class FakeEnv:
+    """Deterministic env: obs counts steps; done every 5th step."""
+
+    def __init__(self):
+        self.counter = -1.0
+
+    def reset(self):
+        self.counter = 0.0
+        return {"obs": np.array([self.counter], dtype=np.float32)}
+
+    def step(self, action):
+        self.counter += 1.0 + float(action)
+        done = self.counter >= 5.0
+        return (
+            {"obs": np.array([self.counter], dtype=np.float32)},
+            float(action),
+            done,
+            {},
+        )
+
+
+def test_envpool_basic():
+    pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=1)
+    try:
+        fut = pool.step(0, np.zeros(4, np.int64))
+        out = fut.result()
+        assert set(out.keys()) == {"obs", "reward", "done"}
+        np.testing.assert_allclose(out["obs"][:, 0], 1.0)  # one step, action 0
+        np.testing.assert_allclose(out["reward"], 0.0)
+        assert not out["done"].any()
+        # Actions add to the counter; env resets at >= 5.
+        for _ in range(3):
+            out = pool.step(0, np.zeros(4, np.int64)).result()
+        np.testing.assert_allclose(out["obs"][:, 0], 4.0)
+        out = pool.step(0, np.zeros(4, np.int64)).result()
+        assert out["done"].all()  # hit 5 -> auto-reset, obs is fresh
+        np.testing.assert_allclose(out["obs"][:, 0], 0.0)
+    finally:
+        pool.close()
+
+
+def test_envpool_double_buffer():
+    pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=2)
+    try:
+        f0 = pool.step(0, np.zeros(4, np.int64))
+        f1 = pool.step(1, np.ones(4, np.int64))
+        out0, out1 = f0.result(), f1.result()
+        np.testing.assert_allclose(out0["obs"][:, 0], 1.0)
+        np.testing.assert_allclose(out1["obs"][:, 0], 2.0)  # action 1 adds 2
+        np.testing.assert_allclose(out1["reward"], 1.0)
+    finally:
+        pool.close()
+
+
+def test_envpool_step_inflight_guard():
+    pool = EnvPool(FakeEnv, num_processes=1, batch_size=2, num_batches=1)
+    try:
+        pool.step(0, np.zeros(2, np.int64))
+        with pytest.raises(RuntimeError, match="in flight"):
+            pool.step(0, np.zeros(2, np.int64))
+    finally:
+        pool.close()
+
+
+def test_envpool_cartpole():
+    pool = EnvPool(CartPoleEnv, num_processes=2, batch_size=8, num_batches=1)
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            out = pool.step(0, rng.integers(0, 2, size=8)).result()
+        assert out["state"].shape == (8, 4)
+        assert out["state"].dtype == np.float32
+        np.testing.assert_allclose(out["reward"], 1.0)
+    finally:
+        pool.close()
+
+
+def test_envpool_pixel_env():
+    pool = EnvPool(CatchEnv, num_processes=2, batch_size=4, num_batches=1)
+    try:
+        total_reward = np.zeros(4)
+        for _ in range(30):
+            out = pool.step(0, np.ones(4, np.int64)).result()
+            total_reward += out["state"][..., 0].sum() * 0  # touch the buffer
+            total_reward += out["reward"]
+        assert out["state"].shape == (4, 10, 5, 1)
+        # Episodes are 9 steps; in 30 steps every env finished >= 3 episodes,
+        # each ending in +1 or -1.
+        assert (np.abs(total_reward) >= 1).any() or (total_reward == 0).all()
+    finally:
+        pool.close()
+
+
+def test_bad_env_raises():
+    def make_bad():
+        raise RuntimeError("nope")
+
+    with pytest.raises(RuntimeError, match="probe process"):
+        EnvPool(make_bad, num_processes=1, batch_size=1, num_batches=1)

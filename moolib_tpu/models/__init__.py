@@ -1,0 +1,12 @@
+"""Model zoo (flax.linen): IMPALA ResNet, recurrent actor-critic cores.
+
+TPU-native re-design of the reference's model layer
+(``examples/atari/models.py:9-153``, ``examples/a2c.py:52-114``): same
+architectures and input/output contract — time-major input dict
+``{"state", "reward", "done", "prev_action"}`` → ``({"policy_logits",
+"baseline"[, "action"]}, core_state)`` — built in flax with bfloat16 compute
+support so the convs/matmuls land on the MXU.
+"""
+
+from .impala import ImpalaNet  # noqa: F401
+from .actor_critic import ActorCriticNet  # noqa: F401

@@ -1,0 +1,71 @@
+"""Small recurrent actor-critic for vector observations (CartPole-class).
+
+Counterpart of the reference A2C example model (``examples/a2c.py:52-114``:
+FC → LSTM → policy + baseline heads) with the same call contract as
+:class:`moolib_tpu.models.ImpalaNet`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ActorCriticNet(nn.Module):
+    num_actions: int
+    hidden_size: int = 128
+    use_lstm: bool = True
+    dtype: Any = jnp.float32
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        if not self.use_lstm:
+            return ()
+        return (
+            jnp.zeros((batch_size, self.hidden_size), jnp.float32),
+            jnp.zeros((batch_size, self.hidden_size), jnp.float32),
+        )
+
+    @nn.compact
+    def __call__(self, inputs, core_state=(), sample_rng: Optional[jax.Array] = None):
+        x = inputs["state"]
+        T, B = x.shape[0], x.shape[1]
+        x = x.reshape(T * B, -1).astype(self.dtype)
+        x = nn.tanh(nn.Dense(self.hidden_size, dtype=self.dtype)(x))
+        x = nn.tanh(nn.Dense(self.hidden_size, dtype=self.dtype)(x))
+
+        if self.use_lstm:
+            x = x.reshape(T, B, -1)
+            notdone = (~inputs["done"]).astype(jnp.float32)
+
+            class _Core(nn.Module):
+                hidden: int
+
+                @nn.compact
+                def __call__(self, carry, xs):
+                    inp, nd = xs
+                    carry = jax.tree_util.tree_map(lambda s: s * nd[:, None], carry)
+                    carry, out = nn.OptimizedLSTMCell(self.hidden)(carry, inp)
+                    return carry, out
+
+            scan_core = nn.scan(
+                _Core,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=0,
+                out_axes=0,
+            )(self.hidden_size)
+            core_state, x = scan_core(tuple(core_state), (x.astype(jnp.float32), notdone))
+            x = x.reshape(T * B, -1)
+
+        policy_logits = nn.Dense(self.num_actions, dtype=jnp.float32)(x)
+        baseline = nn.Dense(1, dtype=jnp.float32)(x)
+        out = {
+            "policy_logits": policy_logits.reshape(T, B, self.num_actions),
+            "baseline": baseline.reshape(T, B),
+        }
+        if sample_rng is not None:
+            out["action"] = jax.random.categorical(sample_rng, out["policy_logits"])
+        return out, core_state

@@ -1,0 +1,9 @@
+"""Numerical ops: v-trace, returns/advantages, losses — all jit-safe."""
+
+from . import returns, vtrace  # noqa: F401
+from .returns import (  # noqa: F401
+    discounted_returns,
+    entropy_loss,
+    generalized_advantage_estimation,
+    softmax_cross_entropy,
+)

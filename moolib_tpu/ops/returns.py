@@ -1,0 +1,60 @@
+"""Return/advantage estimators: n-step discounted returns and GAE.
+
+The n-step return matches the reference A2C loss inputs
+(``examples/a2c.py:121-164``); GAE is provided for the recurrent-PPO family
+(BASELINE.json config list).  All are ``lax.scan`` formulations.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def discounted_returns(
+    rewards: jax.Array, discounts: jax.Array, bootstrap_value: jax.Array
+) -> jax.Array:
+    """R_t = r_t + gamma_t * R_{t+1}, time-major [T, B]."""
+
+    def body(acc, xs):
+        r_t, d_t = xs
+        acc = r_t + d_t * acc
+        return acc, acc
+
+    _, out = jax.lax.scan(body, bootstrap_value, (rewards, discounts), reverse=True)
+    return out
+
+
+def generalized_advantage_estimation(
+    rewards: jax.Array,
+    values: jax.Array,
+    discounts: jax.Array,
+    bootstrap_value: jax.Array,
+    lambda_: float = 0.95,
+):
+    """GAE(lambda); returns (advantages, value_targets), time-major [T, B]."""
+    values_t1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = rewards + discounts * values_t1 - values
+
+    def body(acc, xs):
+        delta_t, d_t = xs
+        acc = delta_t + d_t * lambda_ * acc
+        return acc, acc
+
+    _, advantages = jax.lax.scan(
+        body, jnp.zeros_like(bootstrap_value), (deltas, discounts), reverse=True
+    )
+    return jax.lax.stop_gradient(advantages), jax.lax.stop_gradient(advantages + values)
+
+
+def entropy_loss(logits: jax.Array) -> jax.Array:
+    """Negative mean policy entropy (minimized => maximises entropy)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(logp)
+    return jnp.mean(jnp.sum(p * logp, axis=-1))
+
+
+def softmax_cross_entropy(logits: jax.Array, actions: jax.Array) -> jax.Array:
+    """-log pi(a|s), elementwise (policy-gradient building block)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, actions[..., None], axis=-1).squeeze(-1)

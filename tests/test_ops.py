@@ -1,0 +1,122 @@
+"""vtrace / returns tests vs naive python reference implementations."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moolib_tpu.ops import (
+    discounted_returns,
+    entropy_loss,
+    generalized_advantage_estimation,
+    softmax_cross_entropy,
+    vtrace,
+)
+
+
+def naive_vtrace(log_rhos, discounts, rewards, values, bootstrap, rho_bar, c_bar):
+    T, B = rewards.shape
+    rhos = np.exp(log_rhos)
+    cr = np.minimum(rho_bar, rhos)
+    cs = np.minimum(1.0, rhos)
+    vs = np.zeros((T + 1, B))
+    vs[T] = bootstrap
+    values_ext = np.concatenate([values, bootstrap[None]], 0)
+    acc = np.zeros(B)
+    for t in reversed(range(T)):
+        delta = cr[t] * (rewards[t] + discounts[t] * values_ext[t + 1] - values[t])
+        acc = delta + discounts[t] * cs[t] * acc
+        vs[t] = values[t] + acc
+    vs_t1 = vs[1:]
+    pg_adv = np.minimum(rho_bar, rhos) * (rewards + discounts * vs_t1 - values)
+    return vs[:-1], pg_adv
+
+
+def test_vtrace_matches_naive():
+    rng = np.random.default_rng(0)
+    T, B, A = 12, 5, 4
+    behavior = rng.normal(size=(T, B, A)).astype(np.float32)
+    target = rng.normal(size=(T, B, A)).astype(np.float32)
+    actions = rng.integers(0, A, size=(T, B))
+    discounts = (rng.random((T, B)) > 0.1).astype(np.float32) * 0.99
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+
+    out = jax.jit(vtrace.from_logits)(
+        jnp.asarray(behavior),
+        jnp.asarray(target),
+        jnp.asarray(actions),
+        jnp.asarray(discounts),
+        jnp.asarray(rewards),
+        jnp.asarray(values),
+        jnp.asarray(bootstrap),
+    )
+
+    def logp(lg):
+        lg = lg - lg.max(-1, keepdims=True)
+        p = np.exp(lg)
+        return lg - np.log(p.sum(-1, keepdims=True))
+
+    lr = np.take_along_axis(logp(target), actions[..., None], -1).squeeze(-1) - (
+        np.take_along_axis(logp(behavior), actions[..., None], -1).squeeze(-1)
+    )
+    np.testing.assert_allclose(np.asarray(out.log_rhos), lr, rtol=1e-3, atol=1e-4)
+    vs, pg = naive_vtrace(lr, discounts, rewards, values, bootstrap, 1.0, 1.0)
+    np.testing.assert_allclose(np.asarray(out.vs), vs, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages), pg, rtol=1e-3, atol=1e-3)
+
+
+def test_vtrace_on_policy_reduces_to_nstep():
+    """With identical policies, rhos=1 and vs = n-step TD(lambda=1) returns."""
+    rng = np.random.default_rng(1)
+    T, B, A = 8, 3, 5
+    logits = rng.normal(size=(T, B, A)).astype(np.float32)
+    actions = rng.integers(0, A, size=(T, B))
+    discounts = np.full((T, B), 0.9, np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    out = vtrace.from_logits(
+        jnp.asarray(logits), jnp.asarray(logits), jnp.asarray(actions),
+        jnp.asarray(discounts), jnp.asarray(rewards), jnp.asarray(values),
+        jnp.asarray(bootstrap),
+    )
+    expected = np.asarray(
+        discounted_returns(jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(bootstrap))
+    )
+    np.testing.assert_allclose(np.asarray(out.vs), expected, rtol=1e-3, atol=1e-3)
+
+
+def test_discounted_returns():
+    rewards = jnp.asarray([[1.0], [1.0], [1.0]])
+    discounts = jnp.asarray([[0.5], [0.5], [0.0]])
+    out = discounted_returns(rewards, discounts, jnp.asarray([100.0]))
+    np.testing.assert_allclose(np.asarray(out)[:, 0], [1.75, 1.5, 1.0])
+
+
+def test_gae_shapes_and_zero_lambda():
+    rng = np.random.default_rng(2)
+    T, B = 6, 4
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    discounts = np.full((T, B), 0.99, np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    adv, targets = generalized_advantage_estimation(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(discounts),
+        jnp.asarray(bootstrap), lambda_=0.0,
+    )
+    values_t1 = np.concatenate([values[1:], bootstrap[None]], 0)
+    np.testing.assert_allclose(
+        np.asarray(adv), rewards + 0.99 * values_t1 - values, rtol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(targets), np.asarray(adv) + values, rtol=1e-5)
+
+
+def test_entropy_and_xent():
+    logits = jnp.zeros((2, 3, 4))
+    # Uniform policy: entropy = log(4); entropy_loss is negative entropy.
+    np.testing.assert_allclose(float(entropy_loss(logits)), -np.log(4), rtol=1e-5)
+    actions = jnp.zeros((2, 3), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(softmax_cross_entropy(logits, actions)), np.log(4), rtol=1e-5
+    )

@@ -1,0 +1,26 @@
+"""TPU parallelism: meshes, collectives, sharded train steps, ring attention.
+
+This package is the ICI data plane of the framework (SURVEY.md §2.4's
+"TPU-native equivalent"): DP/FSDP/TP/SP all expressed as jax sharding over a
+Mesh, with the elastic RPC stack (broker/group/accumulator) as the DCN
+control plane around it.
+"""
+
+from .mesh import (  # noqa: F401
+    AXES,
+    initialize_distributed,
+    local_batch_size,
+    make_mesh,
+    named,
+    replicated,
+    shard_batch_spec,
+)
+from .collectives import (  # noqa: F401
+    all_gather_axis,
+    reduce_scatter_axis,
+    ring_permute,
+    tree_pmean,
+    tree_psum,
+)
+from .ring_attention import full_attention, ring_attention, ring_attention_sharded  # noqa: F401
+from .train import fsdp_spec, make_train_step, param_shardings  # noqa: F401

@@ -1,0 +1,36 @@
+"""Collective helpers for use inside jit/shard_map.
+
+XLA inserts most collectives automatically from sharding propagation; these
+wrappers are for explicit ``shard_map`` regions (ring attention, hand-written
+reductions) and for pytree-level convenience.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_psum(tree: Any, axis_name: str) -> Any:
+    return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, axis_name), tree)
+
+
+def tree_pmean(tree: Any, axis_name: str) -> Any:
+    return jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def ring_permute(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    """Send ``x`` to the next device on the ring (ICI neighbour)."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_gather_axis(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reduce_scatter_axis(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)

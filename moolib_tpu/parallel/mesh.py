@@ -1,0 +1,94 @@
+"""Device mesh construction and sharding helpers.
+
+This is the TPU-native data plane the reference lacked (SURVEY.md §2.4): the
+reference synchronizes gradients with a hand-rolled RPC tree over TCP
+(``src/group.h:553-654``); here a static cohort forms a
+``jax.sharding.Mesh`` and gradient/model math runs *inside* jit with XLA
+collectives riding ICI.  Axis convention (used throughout the framework):
+
+- ``dp``: data parallel (batch sharded, grads all-reduced)
+- ``tp``: tensor parallel (weight matrices sharded)
+- ``sp``: sequence/context parallel (time axis sharded; ring attention)
+- ``ep``: expert parallel (MoE experts sharded)
+
+Multi-host: call :func:`initialize_distributed` first (wraps
+``jax.distributed.initialize``); ``jax.devices()`` then spans all hosts and
+meshes lay out so that dp crosses DCN while tp/sp stay inside the ICI domain.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "tp", "sp", "ep")
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up (control plane: DCN; data plane: ICI)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a Mesh from an axis-size dict, e.g. ``{"dp": 4, "tp": 2}``.
+
+    Missing sizes are inferred: at most one axis may be -1 (absorbs the rest);
+    with no dict at all, every device goes to ``dp``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {"dp": n}
+    axes = dict(axes)
+    unknown = [k for k, v in axes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = math.prod(v for v in axes.values() if v != -1)
+    if unknown:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        axes[unknown[0]] = n // known
+    total = math.prod(axes.values())
+    if total != n:
+        raise ValueError(f"mesh {axes} needs {total} devices, have {n}")
+    arr = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    """Shorthand: ``named(mesh, "dp", None)`` → NamedSharding over P(dp, ∅)."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch_spec(mesh: Mesh, time_major: bool = True) -> P:
+    """PartitionSpec for an RL batch: batch axis over dp (and time over sp if
+    the mesh has one). Time-major [T, B, ...] per the framework convention."""
+    has_sp = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+    if time_major:
+        return P("sp" if has_sp else None, "dp")
+    return P("dp", "sp" if has_sp else None)
+
+
+def local_batch_size(mesh: Mesh, global_batch: int, axis: str = "dp") -> int:
+    size = mesh.shape[axis]
+    if global_batch % size:
+        raise ValueError(f"batch {global_batch} not divisible by {axis}={size}")
+    return global_batch // size

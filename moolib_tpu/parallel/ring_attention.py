@@ -1,0 +1,100 @@
+"""Ring attention: sequence/context-parallel attention over an ICI ring.
+
+New TPU-idiomatic capability (the reference has no attention or sequence
+parallelism at all — SURVEY.md §5.7): the sequence axis is sharded over the
+``sp`` mesh axis; each device keeps its local Q block resident and the K/V
+blocks rotate around the ring with ``ppermute`` (one ICI hop per step) while
+a streaming (flash-style) softmax accumulates the output.  Peak memory per
+device is O(T/n · T/n) for scores and O(T/n) for K/V — full attention over
+sequences n× longer than a single chip could hold, with communication fully
+overlappable with the block matmuls.
+
+Layout: [B, T, H, D] ("BTHD"), T sharded on ``sp``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def full_attention(q, k, v, causal: bool = True):
+    """Reference dense attention (single device), for testing parity."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * scale
+    if causal:
+        Tq, Tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool))
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, axis_name: str = "sp", causal: bool = True):
+    """Per-shard body: call inside ``shard_map`` with T sharded on
+    ``axis_name``. q/k/v: [B, T_local, H, D]."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = q.shape[-1] ** -0.5
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    qf = q.astype(jnp.float32)
+
+    # Mark the accumulators as varying over the ring axis so the fori_loop
+    # carry type matches after the axis_index-dependent updates inside.
+    o = jax.lax.pcast(jnp.zeros((B, H, Tq, D), jnp.float32), axis_name, to='varying')
+    l = jax.lax.pcast(jnp.zeros((B, H, Tq), jnp.float32), axis_name, to='varying')
+    m = jax.lax.pcast(jnp.full((B, H, Tq), _NEG_INF, jnp.float32), axis_name, to='varying')
+
+    q_pos = my * Tq + jnp.arange(Tq)
+
+    def body(i, carry):
+        o, l, m, k_c, v_c = carry
+        src = (my - i) % n  # whose K/V block we hold at step i
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * Tk + jnp.arange(Tk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        if causal:
+            # Fully-masked rows: keep them at zero weight (avoid exp(-inf-(-inf))).
+            p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_c.astype(jnp.float32))
+        # Rotate K/V one step around the ring (device j -> j+1).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        return (o, l, m_new, k_c, v_c)
+
+    o, l, m, _, _ = jax.lax.fori_loop(0, n, body, (o, l, m, k, v))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = True
+):
+    """Global entry point: q/k/v are [B, T, H, D] jax arrays (any sharding);
+    runs ring attention with T sharded over ``mesh``'s ``axis_name``."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return fn(q, k, v)

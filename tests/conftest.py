@@ -12,6 +12,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# The image's sitecustomize imports jax at interpreter start (axon TPU
+# plugin), locking in JAX_PLATFORMS before conftest runs — override via the
+# runtime config instead (backends are not initialized yet at collect time).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

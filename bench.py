@@ -1,0 +1,111 @@
+"""Headline benchmark: IMPALA learner throughput on the flagship model.
+
+Times the full jitted train step (ImpalaNet forward + v-trace loss + backward
++ RMSProp update) on the reference's Atari configuration
+(``examples/vtrace/config.yaml:23-65``: 84x84x4 frames, batch_size 32 unrolls,
+unroll_length 20) and reports environment frames consumed per second by the
+learner — the north-star "IMPALA Atari SPS per chip" metric (BASELINE.json).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference repo publishes no numeric baselines (BASELINE.md), so
+``vs_baseline`` is reported against the reference's only hard floor: the
+config's own real-time requirement (learner must keep up with 2*128 actor
+envs at ~60 fps emulator speed ≈ 15,360 frames/s) — values > 1 mean the
+learner outpaces the reference's full actor fleet.
+"""
+
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from moolib_tpu.models import ImpalaNet
+from moolib_tpu.ops import entropy_loss, softmax_cross_entropy, vtrace
+
+# Reference IMPALA defaults (examples/vtrace/config.yaml).
+T = 20  # unroll_length
+B = 32  # batch_size (unrolls per learner step)
+NUM_ACTIONS = 6
+OBS = (84, 84, 4)
+DISCOUNTING = 0.99
+WARMUP = 3
+ITERS = 20
+REALTIME_FLOOR_SPS = 2 * 128 * 60.0  # reference actor fleet at emulator speed
+
+
+def loss_fn(params, batch, model):
+    out, _ = model.apply(params, batch, ())
+    target_logits = out["policy_logits"][:-1]
+    baseline = out["baseline"]
+    vt = vtrace.from_logits(
+        batch["policy_logits"][:-1],
+        target_logits,
+        batch["action"][:-1],
+        (~batch["done"][1:]).astype(jnp.float32) * DISCOUNTING,
+        jnp.clip(batch["reward"][1:], -1, 1),
+        baseline[:-1],
+        jax.lax.stop_gradient(baseline[-1]),
+    )
+    pg = jnp.mean(softmax_cross_entropy(target_logits, batch["action"][:-1]) * vt.pg_advantages)
+    bl = 0.5 * jnp.mean((vt.vs - baseline[:-1]) ** 2)
+    ent = entropy_loss(target_logits)
+    return pg + 0.5 * bl + 0.01 * ent
+
+
+def main():
+    model = ImpalaNet(num_actions=NUM_ACTIONS, use_lstm=False, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    batch = {
+        "state": jnp.asarray(
+            rng.integers(0, 256, size=(T + 1, B, *OBS), dtype=np.uint8)
+        ),
+        "reward": jnp.asarray(rng.normal(size=(T + 1, B)).astype(np.float32)),
+        "done": jnp.asarray(rng.random((T + 1, B)) < 0.02),
+        "prev_action": jnp.asarray(rng.integers(0, NUM_ACTIONS, size=(T + 1, B))),
+        "action": jnp.asarray(rng.integers(0, NUM_ACTIONS, size=(T + 1, B))),
+        "policy_logits": jnp.asarray(
+            rng.normal(size=(T + 1, B, NUM_ACTIONS)).astype(np.float32)
+        ),
+    }
+    params = model.init(jax.random.key(0), batch, ())
+    opt = optax.rmsprop(1e-3, decay=0.99, eps=0.01)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(partial(loss_fn, model=model))(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(WARMUP):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    frames_per_step = T * B
+    sps = frames_per_step * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "impala_learner_sps",
+                "value": round(sps, 1),
+                "unit": "env_frames/s",
+                "vs_baseline": round(sps / REALTIME_FLOOR_SPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -142,6 +142,15 @@ class Accumulator:
         """Connect to the broker coordinating this cohort."""
         self._rpc.connect(address)
 
+    def listen(self, address: str = "127.0.0.1:0") -> None:
+        """Standalone-mode passthrough: listen on the internal Rpc so other
+        peers can reach this one (required before connect in multi-peer use)."""
+        self._rpc.listen(address)
+
+    def set_name(self, name: str) -> None:
+        """Standalone-mode passthrough: set this peer's Rpc name."""
+        self._rpc.set_name(name)
+
     # ------------------------------------------------------------- accessors
     def connected(self) -> bool:
         with self._lock:
@@ -245,7 +254,15 @@ class Accumulator:
     def _start_round(self, stats: Dict[str, int], gradients):
         with self._lock:
             if not self.connected():
-                raise RpcError("accumulator is not connected")
+                # The epoch can change between the caller's wants_gradients()
+                # check and this call (peer joined/left). Elastic semantics:
+                # the contribution is dropped, wants_gradients() comes back
+                # once the new cohort settles (reference cancel path).
+                utils.log_verbose(
+                    "accumulator %s: dropping gradient contribution (not connected)",
+                    self._name,
+                )
+                return
             if self._reduction_inflight:
                 raise RpcError("a gradient reduction is already in flight")
             if self._has_gradients:

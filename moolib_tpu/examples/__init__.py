@@ -1,0 +1,2 @@
+"""Reference agents built on moolib_tpu (counterpart of the reference's
+``examples/``): A2C on CartPole and the distributed IMPALA/V-trace agent."""

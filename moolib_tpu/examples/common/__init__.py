@@ -1,0 +1,151 @@
+"""Shared agent plumbing (counterpart of reference ``examples/common/``):
+stats with cohort-wide delta allreduce, per-actor-batch state threading, and
+TSV logging."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.stats import RunningMeanStd, StatMean, StatSum  # noqa: F401
+from ...batcher import Batcher
+
+
+class GlobalStatsAccumulator:
+    """Allreduce stat *deltas* cohort-wide (reference
+    ``examples/common/__init__.py:65-121``): each peer tracks the snapshot it
+    last reduced, reduces the difference, and re-queues the delta if the
+    reduction fails (e.g. on a membership change)."""
+
+    def __init__(self, group, stats: Dict):
+        self._group = group
+        self._stats = stats
+        self._last = {k: v.snapshot() for k, v in stats.items()}
+        self._pending_delta: Optional[dict] = None
+        self._inflight = None
+
+    def reduce(self, stats: Dict) -> None:
+        if self._inflight is not None and not self._inflight.done():
+            return
+        delta = {k: v.delta(self._last[k]) for k, v in stats.items()}
+        if self._pending_delta is not None:
+            for k, d in self._pending_delta.items():
+                delta[k] = _delta_add(delta[k], d)
+        self._last = {k: v.snapshot() for k, v in stats.items()}
+        # Subtract our own contribution after the reduce (we already hold it).
+        fut = self._group.all_reduce("__global_stats", delta, op=_delta_reduce_op)
+        self._pending_delta = None
+
+        def on_done(f, delta=delta):
+            exc = f.exception()
+            if exc is not None:
+                # Failed (churn): re-queue our delta so nothing is lost.
+                self._pending_delta = (
+                    delta
+                    if self._pending_delta is None
+                    else {k: _delta_add(self._pending_delta[k], d) for k, d in delta.items()}
+                )
+                return
+            total = f.result(0)
+            for k, v in self._stats.items():
+                # Apply everyone else's contribution (total minus ours).
+                v.apply_delta(_delta_sub(total[k], delta[k]))
+
+        fut.add_done_callback(on_done)
+        self._inflight = fut
+
+    def reset(self) -> None:
+        for k, v in self._stats.items():
+            v.reset()
+        self._last = {k: v.snapshot() for k, v in self._stats.items()}
+
+    def local_reset(self, *keys: str) -> None:
+        """Reset chosen stats for local windowing without desyncing the delta
+        protocol (re-snapshots them so the next reduce sends a zero delta)."""
+        for k in keys:
+            self._stats[k].reset()
+            self._last[k] = self._stats[k].snapshot()
+
+
+def _delta_add(a, b):
+    if isinstance(a, tuple):
+        return tuple(x + y for x, y in zip(a, b))
+    return a + b
+
+
+def _delta_sub(a, b):
+    if isinstance(a, tuple):
+        return tuple(x - y for x, y in zip(a, b))
+    return a - b
+
+
+def _delta_reduce_op(a, b):
+    return {k: _delta_add(a[k], b[k]) for k in a}
+
+
+class EnvBatchState:
+    """Per-actor-batch bookkeeping (reference
+    ``examples/common/__init__.py:154-207``): previous action, carried LSTM
+    state, time batcher assembling [T+1, B, ...] unrolls with the last step
+    carried into the next unroll, and episode return/step accounting."""
+
+    def __init__(self, batch_size: int, unroll_length: int, model, device=None):
+        self.batch_size = batch_size
+        self.unroll_length = unroll_length
+        self.prev_action = jnp.zeros((batch_size,), jnp.int32)
+        self.core_state = model.initial_state(batch_size)
+        self.initial_core_state = self.core_state
+        self.time_batcher = Batcher(unroll_length + 1, device=None, dim=0)
+        self.future = None
+        self.episode_return = np.zeros(batch_size, np.float64)
+        self.episode_step = np.zeros(batch_size, np.int64)
+        self.running_reward = np.zeros(batch_size, np.float64)
+        self.step_count = 0
+
+    def update(self, obs: Dict[str, np.ndarray], stats: Optional[Dict] = None) -> None:
+        """Account rewards/episodes for a fresh observation batch."""
+        reward = np.asarray(obs["reward"], np.float64)
+        done = np.asarray(obs["done"], bool)
+        self.episode_return += reward
+        self.episode_step += 1
+        self.step_count += self.batch_size
+        if stats is not None:
+            for i in np.nonzero(done)[0]:
+                stats["mean_episode_return"] += float(self.episode_return[i])
+                stats["mean_episode_step"] += float(self.episode_step[i])
+                stats["episodes_done"] += 1
+            stats["steps_done"] += self.batch_size
+        self.episode_return[done] = 0.0
+        self.episode_step[done] = 0
+
+
+class TsvLogger:
+    """Incremental TSV logging (reference ``examples/common/record.py``):
+    writes a header once, appends rows, creates a ``latest`` symlink."""
+
+    def __init__(self, path: str, symlink: bool = True):
+        self.path = path
+        self._fields = None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if symlink:
+            link = os.path.join(os.path.dirname(path) or ".", "latest.tsv")
+            try:
+                if os.path.islink(link):
+                    os.unlink(link)
+                os.symlink(os.path.basename(path), link)
+            except OSError:
+                pass
+
+    def log(self, **fields) -> None:
+        if self._fields is None:
+            self._fields = list(fields)
+            with open(self.path, "a") as f:
+                f.write("\t".join(["time"] + self._fields) + "\n")
+        row = [f"{time.time():.3f}"] + [str(fields.get(k, "")) for k in self._fields]
+        with open(self.path, "a") as f:
+            f.write("\t".join(row) + "\n")

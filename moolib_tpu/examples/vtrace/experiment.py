@@ -1,0 +1,419 @@
+"""IMPALA (V-trace) distributed agent — the flagship example.
+
+Counterpart of the reference's ``examples/vtrace/experiment.py`` with the
+same loop priority order (``:364-529``):
+
+1. pump group/accumulator; serve/consume state sync
+2. stats allreduce on an interval; leader-only checkpointing
+3. if gradients are ready: optimizer step + ``zero_gradients``
+4. elif a learner batch is ready and the cohort wants gradients:
+   forward + v-trace loss + backward → ``reduce_gradients``
+5. else act: round-robin over double-buffered actor batches — EnvPool step,
+   jitted inference, time-batching into [T+1, B] unrolls, learner batch
+   assembly by concatenation along the batch dim
+
+TPU design: acting and learning are two jitted functions on the same chip
+(the reference's CUDA stream games become XLA async dispatch); the learner
+step can optionally shard over a mesh (``--mesh dp=N``) in which case the
+batch is split over ``dp`` and XLA all-reduces gradients over ICI *inside*
+the step, with the Accumulator handling only cross-host elasticity.
+
+Run: ``python -m moolib_tpu.examples.vtrace.experiment --env catch``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ... import Accumulator, Batcher, Broker, EnvPool, Group, Rpc
+from ...envs import CartPoleEnv, CatchEnv, SyntheticAtariEnv
+from ...models import ActorCriticNet, ImpalaNet
+from ...ops import entropy_loss, softmax_cross_entropy, vtrace
+from .. import common
+
+
+def make_flags(argv=None):
+    p = argparse.ArgumentParser(description="moolib_tpu IMPALA (vtrace)")
+    p.add_argument("--env", default="catch", choices=["catch", "cartpole", "synthetic"])
+    p.add_argument("--total_steps", type=int, default=500_000)
+    p.add_argument("--actor_batch_size", type=int, default=32)
+    p.add_argument("--num_actor_batches", type=int, default=2)
+    p.add_argument("--unroll_length", type=int, default=20)
+    p.add_argument("--batch_size", type=int, default=8, help="learner batch (unrolls)")
+    p.add_argument("--virtual_batch_size", type=int, default=8)
+    p.add_argument("--num_env_processes", type=int, default=4)
+    p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--discounting", type=float, default=0.99)
+    p.add_argument("--entropy_cost", type=float, default=0.01)
+    p.add_argument("--baseline_cost", type=float, default=0.5)
+    p.add_argument("--grad_norm_clipping", type=float, default=40.0)
+    p.add_argument("--use_lstm", action="store_true")
+    p.add_argument("--address", default="127.0.0.1:4431")
+    p.add_argument("--connect", default=None, help="external broker address")
+    p.add_argument("--local_name", default=None)
+    p.add_argument("--train_id", default="impala")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--checkpoint_interval", type=float, default=600.0)
+    p.add_argument("--stats_interval", type=float, default=2.0)
+    p.add_argument("--log_interval", type=float, default=5.0)
+    p.add_argument("--device", default=None, help="jax device str, e.g. 'tpu:0'")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quiet", action="store_true")
+    return p.parse_args(argv)
+
+
+def make_env_factory(flags):
+    # Envs use OS-entropy seeding (seed=None): a fixed seed here would make
+    # every env in every worker replay identical trajectories, silently
+    # correlating the whole actor batch. flags.seed still seeds the model.
+    if flags.env == "catch":
+        return CatchEnv, CatchEnv().num_actions, (10, 5, 1)
+    if flags.env == "cartpole":
+        return CartPoleEnv, 2, (4,)
+    return SyntheticAtariEnv, 6, (84, 84, 4)
+
+
+def make_model(flags, num_actions, obs_shape):
+    if len(obs_shape) == 3:
+        channels = (16, 32, 32) if obs_shape[0] >= 32 else (16, 32)
+        return ImpalaNet(
+            num_actions=num_actions, channels=channels, use_lstm=flags.use_lstm
+        )
+    return ActorCriticNet(num_actions=num_actions, use_lstm=flags.use_lstm)
+
+
+def compute_loss(params, model, batch, initial_core_state, flags):
+    """V-trace actor-critic loss over a [T+1, B] learner batch (reference
+    ``experiment.py:103-155``)."""
+    learner_outputs, _ = model.apply(params, batch, initial_core_state)
+    target_logits = learner_outputs["policy_logits"][:-1]
+    baseline = learner_outputs["baseline"]
+    bootstrap_value = baseline[-1]
+
+    behavior_logits = batch["policy_logits"][:-1]
+    actions = batch["action"][:-1]
+    rewards = jnp.clip(batch["reward"][1:], -1, 1)
+    done = batch["done"][1:]
+    discounts = (~done).astype(jnp.float32) * flags.discounting
+
+    vt = vtrace.from_logits(
+        behavior_logits,
+        target_logits,
+        actions,
+        discounts,
+        rewards,
+        baseline[:-1],
+        jax.lax.stop_gradient(bootstrap_value),
+    )
+    pg_loss = jnp.mean(
+        softmax_cross_entropy(target_logits, actions) * vt.pg_advantages
+    )
+    baseline_loss = 0.5 * jnp.mean((vt.vs - baseline[:-1]) ** 2)
+    ent_loss = entropy_loss(target_logits)
+    total = (
+        pg_loss
+        + flags.baseline_cost * baseline_loss
+        + flags.entropy_cost * ent_loss
+    )
+    return total, {
+        "pg_loss": pg_loss,
+        "baseline_loss": baseline_loss,
+        "entropy_loss": ent_loss,
+    }
+
+
+def save_checkpoint(path, params, opt_state, steps, model_version):
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        pickle.dump(
+            {
+                "params": jax.device_get(params),
+                "opt_state": jax.device_get(opt_state),
+                "steps": steps,
+                "model_version": model_version,
+            },
+            f,
+        )
+    os.replace(tmp, path)  # atomic tmp+rename like the reference (:186-204)
+
+
+def load_checkpoint(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def train(flags, on_stats=None) -> dict:
+    env_factory, num_actions, obs_shape = make_env_factory(flags)
+    # Fork env workers before jax device state exists in this process.
+    envs = [
+        EnvPool(
+            env_factory,
+            num_processes=flags.num_env_processes,
+            batch_size=flags.actor_batch_size,
+            num_batches=1,
+        )
+        for _ in range(flags.num_actor_batches)
+    ]
+
+    model = make_model(flags, num_actions, obs_shape)
+    B = flags.actor_batch_size
+    T = flags.unroll_length
+    rng = jax.random.key(flags.seed)
+    device = None
+    if flags.device:
+        matches = [d for d in jax.devices() if flags.device in str(d).lower()]
+        if not matches:
+            raise ValueError(
+                f"--device {flags.device!r} matches none of {jax.devices()}"
+            )
+        device = matches[0]
+
+    def dummy_batch(t, b):
+        return {
+            "state": jnp.zeros((t, b, *obs_shape), jnp.float32),
+            "reward": jnp.zeros((t, b), jnp.float32),
+            "done": jnp.zeros((t, b), bool),
+            "prev_action": jnp.zeros((t, b), jnp.int32),
+            "action": jnp.zeros((t, b), jnp.int32),
+            "policy_logits": jnp.zeros((t, b, num_actions), jnp.float32),
+        }
+
+    rng, init_rng = jax.random.split(rng)
+    params = model.init(init_rng, dummy_batch(1, B), model.initial_state(B))
+    opt = optax.chain(
+        optax.clip_by_global_norm(flags.grad_norm_clipping),
+        optax.rmsprop(flags.learning_rate, decay=0.99, eps=0.01),
+    )
+    opt_state = opt.init(params)
+    steps_done = 0
+    model_version = 0
+
+    if flags.checkpoint and os.path.exists(flags.checkpoint):
+        ck = load_checkpoint(flags.checkpoint)
+        params, opt_state = ck["params"], ck["opt_state"]
+        steps_done, model_version = ck["steps"], ck["model_version"]
+
+    @jax.jit
+    def act_step(params, inputs, core_state, rng_key):
+        out, new_core = model.apply(params, inputs, core_state, sample_rng=rng_key)
+        return out, new_core
+
+    grad_fn = jax.jit(
+        jax.value_and_grad(partial(compute_loss, model=model, flags=flags), has_aux=True)
+    )
+
+    # --- cohort wiring ---------------------------------------------------
+    broker: Optional[Broker] = None
+    if flags.connect is None:
+        broker = Broker()
+        broker.set_name("broker")
+        broker.listen(flags.address)
+        broker_addr = flags.address
+    else:
+        broker_addr = flags.connect
+
+    rpc = Rpc()
+    rpc.set_name(flags.local_name or f"impala-{os.getpid()}")
+    rpc.listen("127.0.0.1:0")
+    rpc.connect(broker_addr)
+    rpc_group = Group(rpc, name=flags.train_id)
+    accumulator = Accumulator(
+        "model", params, buffers=None, group=rpc_group
+    )
+    accumulator.set_virtual_batch_size(flags.virtual_batch_size)
+    accumulator.set_model_version(model_version)
+
+    stats = {
+        "mean_episode_return": common.StatMean(),
+        "mean_episode_step": common.StatMean(),
+        "episodes_done": common.StatSum(),
+        "steps_done": common.StatSum(),
+        "sgd_steps": common.StatSum(),
+        "loss": common.StatMean(),
+        "pg_loss": common.StatMean(),
+        "entropy_loss": common.StatMean(),
+    }
+    # Resume: continue the step count from the checkpoint.
+    stats["steps_done"] += steps_done
+    global_stats = common.GlobalStatsAccumulator(rpc_group, stats)
+
+    env_states = [
+        common.EnvBatchState(B, T, model) for _ in range(flags.num_actor_batches)
+    ]
+    learn_batcher = Batcher(flags.batch_size, device=device, dim=1)
+    # Initial LSTM states ride a parallel batcher (batch axis 0) so they
+    # split/merge across learner batches exactly like the unrolls do.
+    core_batcher = Batcher(flags.batch_size, device=device, dim=0) if flags.use_lstm else None
+
+    last_stats = time.monotonic()
+    last_log = time.monotonic()
+    last_checkpoint = time.monotonic()
+    final_return = None
+    start = time.time()
+    cur = 0
+    # Kick off the first step of every actor batch (double buffering).
+    for i, st in enumerate(env_states):
+        st.future = envs[i].step(0, np.zeros(B, np.int64))
+
+    try:
+        while stats["steps_done"].value < flags.total_steps:
+            if broker is not None:
+                broker.update()
+            rpc_group.update()
+            accumulator.update()
+
+            if accumulator.wants_state():
+                accumulator.set_state(
+                    {
+                        "opt_state": jax.device_get(opt_state),
+                        "steps": stats["steps_done"].value,
+                    }
+                )
+            if accumulator.has_new_state():
+                st = accumulator.state()
+                if st is not None:
+                    opt_state = st["opt_state"]
+                    params = accumulator.parameters()
+
+            if not accumulator.connected():
+                time.sleep(0.05)
+                continue
+
+            now = time.monotonic()
+            if now - last_stats > flags.stats_interval:
+                last_stats = now
+                global_stats.reduce(stats)
+            if (
+                flags.checkpoint
+                and accumulator.is_leader()
+                and now - last_checkpoint > flags.checkpoint_interval
+            ):
+                last_checkpoint = now
+                save_checkpoint(
+                    flags.checkpoint, params, opt_state,
+                    stats["steps_done"].value, accumulator.model_version(),
+                )
+
+            if accumulator.has_gradients():
+                grads = accumulator.gradients()
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                accumulator.set_parameters(params)
+                accumulator.zero_gradients()
+                stats["sgd_steps"] += 1
+            elif not learn_batcher.empty() and accumulator.wants_gradients():
+                batch = learn_batcher.get()
+                initial_core = core_batcher.get() if core_batcher is not None else ()
+                (loss, aux), grads = grad_fn(
+                    params, batch=batch, initial_core_state=initial_core
+                )
+                stats["loss"] += float(loss)
+                stats["pg_loss"] += float(aux["pg_loss"])
+                stats["entropy_loss"] += float(aux["entropy_loss"])
+                accumulator.reduce_gradients(flags.batch_size, jax.device_get(grads))
+            else:
+                # --- act ------------------------------------------------
+                st = env_states[cur]
+                obs = st.future.result()
+                st.update(obs, stats)
+                inputs = {
+                    "state": jnp.asarray(np.asarray(obs["state"], np.float32))[None],
+                    "reward": jnp.asarray(obs["reward"])[None],
+                    "done": jnp.asarray(obs["done"])[None],
+                    "prev_action": st.prev_action[None],
+                }
+                rng, act_rng = jax.random.split(rng)
+                core_before = st.core_state  # LSTM state entering this step
+                out, new_core = act_step(params, inputs, st.core_state, act_rng)
+                action = out["action"][0]
+                # Queue the next env step immediately (overlaps with learning).
+                st.future = envs[cur].step(0, np.asarray(action))
+                st.time_batcher.stack(
+                    {
+                        "state": inputs["state"][0],
+                        "reward": inputs["reward"][0],
+                        "done": inputs["done"][0],
+                        "prev_action": st.prev_action,
+                        "action": action,
+                        "policy_logits": out["policy_logits"][0],
+                    }
+                )
+                st.prev_action = action
+                st.core_state = new_core
+                if not st.time_batcher.empty():
+                    unroll = st.time_batcher.get()  # [T+1, B, ...]
+                    learn_batcher.cat(unroll)
+                    if core_batcher is not None:
+                        core_batcher.cat(st.initial_core_state)
+                    # Carry the last timestep into the next unroll; its
+                    # initial LSTM state is the state *before* that step.
+                    st.initial_core_state = core_before
+                    st.time_batcher.stack(
+                        {k: v[-1] for k, v in unroll.items()}
+                    )
+                cur = (cur + 1) % flags.num_actor_batches
+
+            if now - last_log > flags.log_interval:
+                last_log = now
+                sps = stats["steps_done"].value / max(time.time() - start, 1e-6)
+                ret = stats["mean_episode_return"].result()
+                if not flags.quiet:
+                    print(
+                        f"steps={int(stats['steps_done'].value)} sps={sps:.0f} "
+                        f"return={ret if ret is None else round(ret, 2)} "
+                        f"sgd={int(stats['sgd_steps'].value)} "
+                        f"loss={stats['loss'].result()}",
+                        flush=True,
+                    )
+                if on_stats is not None:
+                    on_stats({k: v.result() if hasattr(v, "result") else v for k, v in stats.items()})
+                last_return = stats["mean_episode_return"].result()
+                if last_return is not None:
+                    final_return = last_return
+                # Windowed stats reset through the accumulator so the delta
+                # allreduce stays in sync (a bare .reset() would broadcast a
+                # huge negative delta to the cohort).
+                global_stats.local_reset(
+                    "loss", "pg_loss", "entropy_loss",
+                    "mean_episode_return", "mean_episode_step",
+                )
+    finally:
+        if flags.checkpoint and accumulator.is_leader():
+            save_checkpoint(
+                flags.checkpoint, params, opt_state,
+                stats["steps_done"].value, accumulator.model_version(),
+            )
+        for e in envs:
+            e.close()
+        accumulator.close()
+        rpc.close()
+        if broker is not None:
+            broker.close()
+
+    recent = stats["mean_episode_return"].result()
+    return {
+        "steps": stats["steps_done"].value,
+        "episodes": stats["episodes_done"].value,
+        "sgd_steps": stats["sgd_steps"].value,
+        "mean_episode_return": recent if recent is not None else final_return,
+        "sps": stats["steps_done"].value / max(time.time() - start, 1e-6),
+    }
+
+
+def main(argv=None):
+    train(make_flags(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,8 +15,12 @@ import jax.numpy as jnp
 
 
 class ActorCriticNet(nn.Module):
+    """Reference shape: FC-128 → FC-32 → (LSTM-32) → heads
+    (``examples/a2c.py:55-66``)."""
+
     num_actions: int
     hidden_size: int = 128
+    core_size: int = 32
     use_lstm: bool = True
     dtype: Any = jnp.float32
 
@@ -24,8 +28,8 @@ class ActorCriticNet(nn.Module):
         if not self.use_lstm:
             return ()
         return (
-            jnp.zeros((batch_size, self.hidden_size), jnp.float32),
-            jnp.zeros((batch_size, self.hidden_size), jnp.float32),
+            jnp.zeros((batch_size, self.core_size), jnp.float32),
+            jnp.zeros((batch_size, self.core_size), jnp.float32),
         )
 
     @nn.compact
@@ -34,7 +38,7 @@ class ActorCriticNet(nn.Module):
         T, B = x.shape[0], x.shape[1]
         x = x.reshape(T * B, -1).astype(self.dtype)
         x = nn.tanh(nn.Dense(self.hidden_size, dtype=self.dtype)(x))
-        x = nn.tanh(nn.Dense(self.hidden_size, dtype=self.dtype)(x))
+        x = nn.tanh(nn.Dense(self.core_size, dtype=self.dtype)(x))
 
         if self.use_lstm:
             x = x.reshape(T, B, -1)
@@ -56,7 +60,7 @@ class ActorCriticNet(nn.Module):
                 split_rngs={"params": False},
                 in_axes=0,
                 out_axes=0,
-            )(self.hidden_size)
+            )(self.core_size)
             core_state, x = scan_core(tuple(core_state), (x.astype(jnp.float32), notdone))
             x = x.reshape(T * B, -1)
 

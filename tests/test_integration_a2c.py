@@ -1,0 +1,30 @@
+"""A2C learning integration test — the reference's quality bar
+(test/integration/test_a2c.py:15-35): after 40k CartPole steps the mean
+episode return must reach >= 100 in most trailing windows and the entropy
+loss must stay in (-1, 0). The reference version of this test is @skip'd in
+its own CI; here it runs (and passes)."""
+
+import numpy as np
+import pytest
+
+from moolib_tpu.examples.a2c import make_flags, train
+
+
+def test_a2c_learns_cartpole(free_port):
+    flags = make_flags(
+        [
+            "--total_steps",
+            "40000",
+            "--address",
+            f"127.0.0.1:{free_port}",
+            "--quiet",
+        ]
+    )
+    stats = train(flags)
+    returns = np.asarray(stats["window_returns"])
+    assert len(returns) > 50, "too few episodes"
+    # Trailing windows of 40 episodes: more than half must average >= 100.
+    windows = [returns[i : i + 40].mean() for i in range(len(returns) - 40, len(returns) - 4, 4)]
+    good = sum(w >= 100 for w in windows)
+    assert good > len(windows) // 2, f"did not learn: windows={windows}"
+    assert -1.0 < stats["entropy_loss"] < 0.0

@@ -28,6 +28,8 @@ ndarrays with fixed shapes/dtypes.
 
 from __future__ import annotations
 
+import ctypes
+import mmap
 import multiprocessing as mp
 from multiprocessing import shared_memory
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -35,6 +37,77 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 _FIELD_RESERVED = ("reward", "done")
+_SHUTDOWN = -1
+
+
+class _MpQueue:
+    """Fallback doorbell: multiprocessing SimpleQueue of batch indices."""
+
+    def __init__(self, ctx):
+        self._q = ctx.SimpleQueue()
+
+    def put(self, v: int) -> None:
+        self._q.put(v)
+
+    def get(self) -> int:
+        return self._q.get()
+
+
+class _MpSem:
+    def __init__(self, ctx):
+        self._s = ctx.Semaphore(0)
+
+    def release(self) -> None:
+        self._s.release()
+
+    def acquire(self, timeout=None) -> bool:
+        return self._s.acquire(True, timeout)
+
+
+def _make_doorbells(ctx, num_processes: int, num_batches: int):
+    """Native futex rings/semaphores in one fork-shared anonymous mapping
+    (counterpart of the reference's shm semaphores + queues, src/shm.h),
+    falling back to multiprocessing primitives when g++ is unavailable."""
+    from . import native
+
+    lib = native.get_shmq()
+    if lib is None:
+        return (
+            [_MpQueue(ctx) for _ in range(num_processes)],
+            [_MpSem(ctx) for _ in range(num_batches)],
+            None,
+        )
+    # Power-of-two capacity: the ring indexes with u32 cursors mod capacity,
+    # which only stays consistent across the 2^32 wrap for powers of two.
+    cap = 16
+    while cap < 4 * num_batches:
+        cap *= 2
+    ring_sz = (native.NativeRing.size(lib, cap) + 63) & ~63
+    sem_sz = (native.NativeSemaphore.size(lib) + 63) & ~63
+    total = ring_sz * num_processes + sem_sz * num_batches
+    mm = mmap.mmap(-1, total)  # MAP_SHARED | MAP_ANONYMOUS: inherited on fork
+    base = ctypes.addressof(ctypes.c_char.from_buffer(mm))
+    queues = [
+        native.NativeRing(lib, base + i * ring_sz, cap) for i in range(num_processes)
+    ]
+    off = ring_sz * num_processes
+    sems = [
+        native.NativeSemaphore(lib, base + off + i * sem_sz)
+        for i in range(num_batches)
+    ]
+
+    class _RingQueue:
+        def __init__(self, ring):
+            self._ring = ring
+
+        def put(self, v: int) -> None:
+            self._ring.push(int(v))
+
+        def get(self) -> int:
+            out = self._ring.pop()
+            return _SHUTDOWN if out is None else out
+
+    return [_RingQueue(q) for q in queues], sems, mm
 
 
 def _normalize_obs(obs) -> Dict[str, np.ndarray]:
@@ -113,7 +186,7 @@ class EnvRunner:
         try:
             while True:
                 b = self.task_queue.get()
-                if b is None:
+                if b is None or b == _SHUTDOWN:
                     break
                 self._step_batch(b, views[b], act_views[b])
                 self.done_sems[b].release()
@@ -284,8 +357,9 @@ class EnvPool:
             layout_act.append((seg.name, act_shape, np.dtype(action_dtype).str))
 
         # 3. Fork workers, hand each its env slice + the shm layout.
-        self._task_queues = [ctx.SimpleQueue() for _ in range(num_processes)]
-        self._done_sems = [ctx.Semaphore(0) for _ in range(num_batches)]
+        self._task_queues, self._done_sems, self._doorbell_mm = _make_doorbells(
+            ctx, num_processes, num_batches
+        )
         self._procs: List = []
         per = batch_size // num_processes
         extra = batch_size % num_processes
@@ -333,7 +407,7 @@ class EnvPool:
         self._closed = True
         for q in self._task_queues:
             try:
-                q.put(None)
+                q.put(_SHUTDOWN)
             except Exception:
                 pass
         for p in self._procs:

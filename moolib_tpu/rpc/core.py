@@ -177,6 +177,12 @@ def _chunk_len(c) -> int:
     return c.nbytes if isinstance(c, memoryview) else len(c)
 
 
+def _request_chunks(rid: int, fn_name: str, body: List[bytes]) -> List[bytes]:
+    """Single source of truth for the request frame layout."""
+    fnb = fn_name.encode()
+    return [struct.pack("<BQH", KIND_REQUEST, rid, len(fnb)) + fnb] + body
+
+
 def _local_addresses() -> List[str]:
     """Addresses to advertise for a wildcard listen: real interfaces first,
     loopback last (reference: deviceAddresses gathering for the greeting)."""
@@ -284,11 +290,15 @@ class _Peer:
         "recent",
         "executing",
         "find_inflight",
+        "native_ok",
     )
 
     def __init__(self, name: str):
         self.name = name
         self.uid: Optional[str] = None
+        # Whether the peer can decode the native codec (negotiated in the
+        # greeting; until/unless true we send pickle-codec payloads).
+        self.native_ok = False
         self.connections: Dict[str, _Connection] = {}
         self.addresses: List[str] = []
         self.pending: List["_Outgoing"] = []  # waiting for a connection
@@ -312,13 +322,25 @@ class _Peer:
 
 
 class _Outgoing:
-    __slots__ = ("rid", "peer_name", "fn_name", "chunks", "future", "deadline", "sent_at")
+    __slots__ = (
+        "rid",
+        "peer_name",
+        "fn_name",
+        "chunks",
+        "chunks_portable",
+        "payload_obj",
+        "future",
+        "deadline",
+        "sent_at",
+    )
 
-    def __init__(self, rid, peer_name, fn_name, chunks, future, deadline):
+    def __init__(self, rid, peer_name, fn_name, chunks, payload_obj, future, deadline):
         self.rid = rid
         self.peer_name = peer_name
         self.fn_name = fn_name
-        self.chunks = chunks
+        self.chunks = chunks  # native-or-python encoding (sender's default)
+        self.chunks_portable = None  # lazily built pickle-codec encoding
+        self.payload_obj = payload_obj  # retained for portable re-encode
         self.future = future
         self.deadline = deadline
         self.sent_at = time.monotonic()
@@ -442,6 +464,9 @@ class Rpc:
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=utils.get_max_threads() or min(32, (os.cpu_count() or 4))
         )
+        # Warm the native codec here (user thread): first use compiles with
+        # g++; doing it lazily would block the IO event loop mid-greeting.
+        serialization.native_available()
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop_main, name="moolib-rpc", daemon=True)
         self._started = threading.Event()
@@ -620,11 +645,9 @@ class Rpc:
             future.set_exception(RpcError(f"serialization error: {e}"))
             return
         rid = next(self._rid)
-        fnb = fn_name.encode()
-        header = struct.pack("<BQH", KIND_REQUEST, rid, len(fnb)) + fnb
-        chunks = [header] + body
+        chunks = _request_chunks(rid, fn_name, body)
         deadline = time.monotonic() + self._timeout
-        out = _Outgoing(rid, peer_name, fn_name, chunks, future, deadline)
+        out = _Outgoing(rid, peer_name, fn_name, chunks, (args, kwargs), future, deadline)
 
         def _done(fut: Future):
             # Completed (incl. user cancel): drop the resend buffer promptly.
@@ -644,7 +667,7 @@ class Rpc:
         conn = peer.best_connection(self._transport_order) if peer else None
         if conn is not None:
             try:
-                conn.send_frame(out.chunks)
+                conn.send_frame(self._chunks_for(peer, out))
                 out.sent_at = time.monotonic()
                 return
             except Exception:
@@ -654,6 +677,18 @@ class Rpc:
             peer = self._peers.setdefault(out.peer_name, _Peer(out.peer_name))
         peer.pending.append(out)
         self._loop.create_task(self._find_peer(peer))
+
+    def _chunks_for(self, peer: _Peer, out: _Outgoing) -> List[bytes]:
+        """Codec negotiation: if the peer can't decode native payloads,
+        re-encode this request with the portable pickle codec."""
+        if peer.native_ok or not serialization.native_available():
+            return out.chunks
+        if out.chunks_portable is None:
+            sp = serialization._py_serialize(out.payload_obj)
+            out.chunks_portable = _request_chunks(
+                out.rid, out.fn_name, serialization.pack(sp)
+            )
+        return out.chunks_portable
 
     async def _find_peer(self, peer: _Peer):
         if peer.find_inflight:
@@ -743,12 +778,15 @@ class Rpc:
         return True
 
     def _send_greeting(self, conn: _Connection):
-        greeting = serialization.dumps(
+        # Greetings always use the portable pickle codec: they must parse
+        # before codec support has been negotiated.
+        greeting = serialization.dumps_portable(
             {
                 "sig": SIGNATURE,
                 "name": self._name,
                 "uid": self._uid,
                 "addrs": list(self._listen_addrs),
+                "native": serialization.native_available(),
             }
         )
         conn.send_frame([struct.pack("<B", KIND_GREETING), greeting])
@@ -817,6 +855,7 @@ class Rpc:
             peer.recent.clear()
             peer.executing.clear()
         peer.uid = uid
+        peer.native_ok = bool(info.get("native", False))
         for a in info.get("addrs", []):
             if a not in peer.addresses:
                 peer.addresses.append(a)
@@ -865,16 +904,21 @@ class Rpc:
 
         def respond(value, error: Optional[str]):
             def _send():
+                ser_fn = (
+                    serialization.serialize
+                    if (peer is None or peer.native_ok)
+                    else serialization._py_serialize
+                )
                 try:
                     if error is not None:
-                        body = serialization.pack(serialization.serialize(error))
+                        body = serialization.pack(ser_fn(error))
                         chunks = [struct.pack("<BQ", KIND_ERROR, rid)] + body
                     else:
-                        body = serialization.pack(serialization.serialize(value))
+                        body = serialization.pack(ser_fn(value))
                         chunks = [struct.pack("<BQ", KIND_RESPONSE, rid)] + body
                 except Exception as e:  # noqa: BLE001
                     body = serialization.pack(
-                        serialization.serialize(f"response serialization error: {e}")
+                        serialization._py_serialize(f"response serialization error: {e}")
                     )
                     chunks = [struct.pack("<BQ", KIND_ERROR, rid)] + body
                 if peer is not None:

@@ -1,0 +1,141 @@
+// Native shared-memory synchronization for the EnvPool doorbell path.
+//
+// TPU-native counterpart of the reference's process-shared semaphores and
+// lock-free queues over POSIX shm (src/shm.h:96-232 SharedSemaphore,
+// src/env.h:50-71 SharedQueue; spin-wait action words src/env.h:276-292).
+// Re-designed: futex-backed counting semaphores and SPSC int32 rings living
+// in anonymous MAP_SHARED memory created by the parent *before* fork, so no
+// named segments, no cleanup, and the fast path is a single atomic op.
+//
+// Exposed as a plain C ABI for ctypes; all objects are placed into caller-
+// provided shared memory (python allocates one mmap and hands out offsets).
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+namespace {
+
+int futex(std::atomic<int32_t>* uaddr, int op, int val, const timespec* timeout) {
+  return syscall(SYS_futex, reinterpret_cast<int32_t*>(uaddr), op, val, timeout,
+                 nullptr, 0);
+}
+
+struct Sem {
+  std::atomic<int32_t> value;
+  std::atomic<int32_t> waiters;
+};
+
+struct Ring {
+  std::atomic<uint32_t> head;  // producer cursor
+  std::atomic<uint32_t> tail;  // consumer cursor
+  uint32_t capacity;
+  Sem items;
+  Sem space;
+  // int32 slots follow
+  int32_t* slots() { return reinterpret_cast<int32_t*>(this + 1); }
+};
+
+void sem_init_(Sem* s, int32_t initial) {
+  s->value.store(initial, std::memory_order_relaxed);
+  s->waiters.store(0, std::memory_order_relaxed);
+}
+
+void sem_post_(Sem* s, int32_t n) {
+  s->value.fetch_add(n, std::memory_order_release);
+  if (s->waiters.load(std::memory_order_acquire) > 0) {
+    futex(&s->value, FUTEX_WAKE, n, nullptr);
+  }
+}
+
+// Returns 0 on success, -1 on timeout, -2 on EINTR (caller must return to
+// python so pending signal handlers — Ctrl-C — get a chance to run).
+int sem_wait_(Sem* s, int64_t timeout_ms) {
+  // Fast path: brief spin (the reference spin-waits its action words; we cap
+  // the spin and fall back to futex so idle workers cost nothing).
+  for (int i = 0; i < 1024; i++) {
+    int32_t v = s->value.load(std::memory_order_acquire);
+    if (v > 0 &&
+        s->value.compare_exchange_weak(v, v - 1, std::memory_order_acquire)) {
+      return 0;
+    }
+  }
+  timespec ts;
+  timespec* tsp = nullptr;
+  if (timeout_ms >= 0) {
+    ts.tv_sec = timeout_ms / 1000;
+    ts.tv_nsec = (timeout_ms % 1000) * 1000000;
+    tsp = &ts;
+  }
+  for (;;) {
+    int32_t v = s->value.load(std::memory_order_acquire);
+    if (v > 0) {
+      if (s->value.compare_exchange_weak(v, v - 1, std::memory_order_acquire))
+        return 0;
+      continue;
+    }
+    s->waiters.fetch_add(1, std::memory_order_acq_rel);
+    int rc = futex(&s->value, FUTEX_WAIT, 0, tsp);
+    s->waiters.fetch_sub(1, std::memory_order_acq_rel);
+    if (rc == -1 && errno == ETIMEDOUT) return -1;
+    if (rc == -1 && errno == EINTR) return -2;
+    // EAGAIN: value changed under us; retry.
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- counting semaphore -------------------------------------------------
+size_t moolib_sem_size() { return sizeof(Sem); }
+void moolib_sem_init(void* p, int32_t initial) { sem_init_(static_cast<Sem*>(p), initial); }
+void moolib_sem_post(void* p, int32_t n) { sem_post_(static_cast<Sem*>(p), n); }
+int moolib_sem_wait(void* p, int64_t timeout_ms) {
+  return sem_wait_(static_cast<Sem*>(p), timeout_ms);
+}
+int32_t moolib_sem_value(void* p) {
+  return static_cast<Sem*>(p)->value.load(std::memory_order_acquire);
+}
+
+// ---- SPSC int32 ring queue ---------------------------------------------
+size_t moolib_ring_size(uint32_t capacity) {
+  return sizeof(Ring) + capacity * sizeof(int32_t);
+}
+void moolib_ring_init(void* p, uint32_t capacity) {
+  Ring* r = static_cast<Ring*>(p);
+  r->head.store(0, std::memory_order_relaxed);
+  r->tail.store(0, std::memory_order_relaxed);
+  r->capacity = capacity;
+  sem_init_(&r->items, 0);
+  sem_init_(&r->space, (int32_t)capacity);
+}
+// Returns 0 on success, -1 on timeout, -2 on EINTR.
+int moolib_ring_push(void* p, int32_t value, int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(p);
+  int rc = sem_wait_(&r->space, timeout_ms);
+  if (rc != 0) return rc;
+  uint32_t h = r->head.load(std::memory_order_relaxed);
+  r->slots()[h % r->capacity] = value;
+  r->head.store(h + 1, std::memory_order_release);
+  sem_post_(&r->items, 1);
+  return 0;
+}
+// Returns 0 on success (value in *out), -1 on timeout, -2 on EINTR.
+int moolib_ring_pop(void* p, int32_t* out, int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(p);
+  int rc = sem_wait_(&r->items, timeout_ms);
+  if (rc != 0) return rc;
+  uint32_t t = r->tail.load(std::memory_order_relaxed);
+  *out = r->slots()[t % r->capacity];
+  r->tail.store(t + 1, std::memory_order_release);
+  sem_post_(&r->space, 1);
+  return 0;
+}
+
+}  // extern "C"

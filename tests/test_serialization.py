@@ -69,10 +69,33 @@ def test_roundtrip_bfloat16():
 def test_arrays_out_of_band():
     x = np.zeros(1000, dtype=np.float64)
     sp = ser.serialize({"x": x, "n": 3})
-    # The 8000-byte payload must be out of band, not in the pickle stream.
+    # The 8000-byte payload must be out of band, not in the header stream
+    # (holds for both the native codec and the pickle fallback).
     assert len(sp.payload) < 500
-    assert len(sp.arrays) == 1
-    assert sp.arrays[0].shape == (1000,)
+    arrays = sp.np_arrays if isinstance(sp, ser.NativePayload) else sp.arrays
+    assert len(arrays) == 1
+    assert arrays[0].shape == (1000,)
+
+
+def test_python_fallback_roundtrip(monkeypatch):
+    """The pickle path must keep working when the native codec is absent."""
+    monkeypatch.setattr(ser, "_native_codec", lambda: None)
+    obj = {"x": np.arange(6, dtype=np.int32), "j": jnp.ones(3), "s": "str"}
+    sp = ser.serialize(obj)
+    assert isinstance(sp, ser.SerializedPayload)
+    out = ser.deserialize(ser.unpack(ser.pack_bytes(sp)))
+    np.testing.assert_array_equal(out["x"], obj["x"])
+    assert isinstance(out["j"], jax.Array)
+    assert out["s"] == "str"
+
+
+def test_native_codec_available_and_faster_path():
+    from moolib_tpu.native import get_codec
+
+    codec = get_codec()
+    assert codec is not None, "native codec failed to build"
+    sp = ser.serialize([1, "two", {"three": 3.0}])
+    assert isinstance(sp, ser.NativePayload)
 
 
 def test_noncontiguous_numpy():

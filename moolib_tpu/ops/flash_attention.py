@@ -1,0 +1,119 @@
+"""Pallas TPU flash attention (single-chip blockwise attention).
+
+The single-chip complement of ``moolib_tpu.parallel.ring_attention``: scores
+never materialize in HBM — K/V stream through VMEM in blocks while a running
+(max, sum, accumulator) triple folds the softmax (same math as the ring
+kernel, here over the *local* sequence).  Written with ``pl.pallas_call``
+grid (batch*heads, q-blocks, kv-blocks): the kv axis is innermost so the
+output block revisits and the scratch accumulators carry across iterations
+(standard TPU pallas accumulation pattern).
+
+The reference framework has no attention at all (SURVEY.md §5.7) — this is
+new TPU-idiomatic capability for the long-context side of the framework.
+
+Layout [B, T, H, D]; falls back to the XLA dense path for shapes that don't
+tile (T not divisible by the block size, tiny D).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, bk]
+
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_prev = m_scr[:, :1]  # [bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    if causal:
+        # Rows whose every key is masked: keep them at zero weight.
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Blockwise attention; q/k/v: [B, T, H, D] → [B, T, H, D]."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if Tq % block_q or Tk % block_k:
+        from ..parallel.ring_attention import full_attention
+
+        return full_attention(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = D**-0.5
+
+    # [B, T, H, D] -> [B*H, T, D]
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    grid = (B * H, Tq // block_q, Tk // block_k)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)

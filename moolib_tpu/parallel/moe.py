@@ -1,0 +1,94 @@
+"""Mixture-of-Experts with expert parallelism (EP) over a mesh axis.
+
+New TPU-idiomatic capability beyond the reference (SURVEY.md §2.3: expert
+parallelism absent).  Switch-style top-1 routing with a capacity factor and
+GShard-style dense dispatch/combine einsums — the formulation XLA shards
+cleanly: expert-indexed weights carry an ``ep``-shardable leading axis and
+the dispatch einsum lowers to an all-to-all over ICI when tokens and experts
+live on different devices.
+
+Use :func:`moe_param_spec` for the PartitionSpecs of the expert weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class SwitchMoE(nn.Module):
+    """Top-1 routed MLP block: x [.., S, D] -> [.., S, D].
+
+    Attributes:
+      num_experts: number of experts (shard over "ep").
+      ffn_dim: expert hidden width.
+      capacity_factor: per-expert slots = ceil(S / E * factor); overflowing
+        tokens fall through the residual (standard switch behavior).
+    """
+
+    num_experts: int
+    ffn_dim: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        orig_shape = x.shape
+        D = x.shape[-1]
+        x2 = x.reshape(-1, D)  # [T, D] tokens
+        T = x2.shape[0]
+        E = self.num_experts
+        C = max(1, int(T / E * self.capacity_factor))
+
+        router = nn.Dense(E, dtype=jnp.float32, name="router")
+        logits = router(x2.astype(jnp.float32))  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate, expert = jnp.max(probs, axis=-1), jnp.argmax(probs, axis=-1)  # [T]
+
+        # Position of each token within its expert's capacity (cumsum trick).
+        expert_1h = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [T, E]
+        pos_in_expert = jnp.cumsum(expert_1h, axis=0) * expert_1h  # 1-based
+        pos = jnp.sum(pos_in_expert, axis=-1) - 1  # [T], -1 if... (>=0 here)
+        keep = pos < C  # overflow tokens dropped (residual passthrough)
+
+        # Dense dispatch/combine tensors [T, E, C].
+        dispatch = (
+            jax.nn.one_hot(expert, E, dtype=self.dtype)[:, :, None]
+            * jax.nn.one_hot(jnp.where(keep, pos, 0), C, dtype=self.dtype)[:, None, :]
+            * keep[:, None, None].astype(self.dtype)
+        )
+        combine = dispatch * gate[:, None, None].astype(self.dtype)
+
+        # Expert weights: leading E axis shards over "ep".
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (E, D, self.ffn_dim), jnp.float32
+        )
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (E, self.ffn_dim, D), jnp.float32
+        )
+
+        xs = jnp.einsum("tec,td->ecd", dispatch, x2.astype(self.dtype))  # [E, C, D]
+        h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", xs, w_in.astype(self.dtype)))
+        ys = jnp.einsum("ecf,efd->ecd", h, w_out.astype(self.dtype))  # [E, C, D]
+        out = jnp.einsum("tec,ecd->td", combine, ys)  # [T, D]
+
+        # Load-balancing auxiliary loss (Switch Transformer eq. 4).
+        density = jnp.mean(expert_1h.astype(jnp.float32), axis=0)  # fraction routed
+        density_proxy = jnp.mean(probs, axis=0)
+        aux_loss = E * jnp.sum(density * density_proxy)
+
+        out = out.astype(x.dtype).reshape(orig_shape)
+        return x + out, aux_loss  # residual catches dropped tokens
+
+
+def moe_param_spec(ep_axis: str = "ep"):
+    """PartitionSpecs for SwitchMoE params: experts sharded over ``ep_axis``."""
+    return {
+        "router": {"kernel": P(), "bias": P()},
+        "w_in": P(ep_axis, None, None),
+        "w_out": P(ep_axis, None, None),
+    }

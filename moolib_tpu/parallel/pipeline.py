@@ -1,0 +1,91 @@
+"""Pipeline parallelism: a GPipe-style microbatch schedule over a ``pp`` axis.
+
+New capability beyond the reference (SURVEY.md §2.3: pipeline parallelism
+absent).  SPMD formulation: every device runs the same program inside
+``shard_map``; device ``d`` holds stage ``d``'s parameters (stage-stacked
+arrays sharded on their leading axis), activations march around the ring
+with ``ppermute`` once per tick, and for ``M`` microbatches and ``S`` stages
+the loop runs ``M + S - 1`` ticks (the classic fill/drain bubble).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    microbatches: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "pp",
+):
+    """Run ``y_m = stage_{S-1}(... stage_0(x_m))`` for every microbatch.
+
+    Args:
+      stage_fn: ``stage_fn(params_for_one_stage, x) -> y`` with x/y of the
+        same shape (activation shape is uniform across stages).
+      stage_params: pytree whose leaves have a leading stage axis of size S
+        (sharded over ``axis_name`` inside the mapped region).
+      microbatches: [M, ...] array of microbatch inputs.
+      mesh: mesh with an ``axis_name`` axis of size S.
+
+    Returns: [M, ...] outputs from the final stage.
+    """
+    S = mesh.shape[axis_name]
+    M = microbatches.shape[0]
+
+    def body(params_local, xs):
+        # params_local: leaves [1, ...] (this stage's slice); xs: all
+        # microbatches (replicated — only stage 0 consumes them).
+        params_me = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        stage = jax.lax.axis_index(axis_name)
+        act_shape = xs.shape[1:]
+        # Mark the loop buffers as varying over the pipeline axis (their
+        # updates depend on axis_index, so the carry type must match).
+        carry = jax.lax.pcast(jnp.zeros(act_shape, xs.dtype), axis_name, to="varying")
+        outs = jax.lax.pcast(jnp.zeros_like(xs), axis_name, to="varying")
+
+        def tick(i, state):
+            carry, outs = state
+            # Stage 0 ingests microbatch i (when still filling); others take
+            # the activation handed over the ring.
+            x_in = jnp.where(
+                stage == 0,
+                xs[jnp.minimum(i, M - 1)],
+                carry,
+            )
+            y = stage_fn(params_me, x_in)
+            # Final stage banks its result for microbatch i - (S - 1).
+            out_idx = i - (S - 1)
+            valid = jnp.logical_and(stage == S - 1, out_idx >= 0)
+            idx = jnp.clip(out_idx, 0, M - 1)
+            outs = outs.at[idx].set(jnp.where(valid, y, outs[idx]))
+            # Hand activations to the next stage (ring step).
+            perm = [(j, (j + 1) % S) for j in range(S)]
+            carry = jax.lax.ppermute(y, axis_name, perm)
+            return carry, outs
+
+        _, outs = jax.lax.fori_loop(0, M + S - 1, tick, (carry, outs))
+        # Results live on the last stage; share them with everyone.
+        outs = jax.lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), axis_name
+        )
+        return outs
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )
+    sharded_params = jax.tree_util.tree_map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P(axis_name))), stage_params
+    )
+    return fn(sharded_params, microbatches)

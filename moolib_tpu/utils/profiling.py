@@ -1,0 +1,68 @@
+"""Tracing/profiling hooks.
+
+The reference has no tracer — only ``debug_info`` dumps and log timings
+(SURVEY.md §5.1).  The TPU build replaces that with first-class hooks:
+
+- :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace of XLA execution (compile, HBM, ICI waits).
+- :class:`StepTimer` — cheap wall-clock section timing with EMA summaries,
+  for the python-side loop (act/learn/reduce shares).
+- :func:`annotate` — ``jax.profiler.TraceAnnotation`` passthrough so loop
+  phases show up inside device traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a jax profiler trace into ``log_dir`` (view with TensorBoard)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Label a region so it appears inside the device trace timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """EMA section timer for the training loop's python side."""
+
+    def __init__(self, alpha: float = 0.05):
+        self._alpha = alpha
+        self._ema: Dict[str, float] = {}
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            prev = self._ema.get(name)
+            self._ema[name] = dt if prev is None else (1 - self._alpha) * prev + self._alpha * dt
+            self._counts[name] += 1
+
+    def summary(self) -> Dict[str, float]:
+        """EMA seconds per section."""
+        return dict(self._ema)
+
+    def report(self) -> str:
+        total = sum(self._ema.values()) or 1e-9
+        parts = [
+            f"{k}={v*1e3:.1f}ms({v/total*100:.0f}%)"
+            for k, v in sorted(self._ema.items(), key=lambda kv: -kv[1])
+        ]
+        return " ".join(parts)

@@ -1,0 +1,99 @@
+"""MoE (expert parallel), pipeline parallel, flash attention, checkpoint."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from moolib_tpu import parallel
+from moolib_tpu.checkpoint import Checkpointer
+from moolib_tpu.ops.flash_attention import flash_attention
+
+
+def test_switch_moe_routing_and_shapes():
+    model = parallel.SwitchMoE(num_experts=4, ffn_dim=32, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 8))
+    params = model.init(jax.random.key(1), x)
+    (out, aux), _ = jax.jit(lambda p, x: (model.apply(p, x), 0))(params, x)
+    assert out.shape == x.shape
+    assert np.isfinite(float(aux))
+    # Routed output must differ from the residual input.
+    assert not np.allclose(np.asarray(out), np.asarray(x))
+
+
+def test_switch_moe_expert_parallel_on_mesh():
+    mesh = parallel.make_mesh({"ep": 4, "dp": 2})
+    model = parallel.SwitchMoE(num_experts=8, ffn_dim=64, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (4, 32, 16))
+    params = model.init(jax.random.key(1), x)
+    spec = parallel.moe_param_spec("ep")
+    sharded = {
+        "params": {
+            "router": jax.tree_util.tree_map(
+                lambda p: jax.device_put(p, NamedSharding(mesh, P())),
+                params["params"]["router"],
+            ),
+            "w_in": jax.device_put(
+                params["params"]["w_in"], NamedSharding(mesh, spec["w_in"])
+            ),
+            "w_out": jax.device_put(
+                params["params"]["w_out"], NamedSharding(mesh, spec["w_out"])
+            ),
+        }
+    }
+    out_sharded, aux = jax.jit(model.apply)(sharded, x)
+    out_plain, aux2 = model.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out_sharded), np.asarray(out_plain), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_pipeline_matches_sequential():
+    mesh = parallel.make_mesh({"pp": 4, "dp": 2})
+    S, M, Dim = 4, 6, 8
+    rng = np.random.default_rng(0)
+    ws = jnp.asarray(rng.normal(size=(S, Dim, Dim)).astype(np.float32) * 0.5)
+    xs = jnp.asarray(rng.normal(size=(M, 3, Dim)).astype(np.float32))
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    out = parallel.pipeline_apply(stage_fn, ws, xs, mesh, axis_name="pp")
+    expected = xs
+    for s in range(S):
+        expected = jnp.tanh(expected @ ws[s])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_matches_dense():
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 256, 2, 32
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    for causal in (True, False):
+        out = flash_attention(q, k, v, causal=causal)
+        ref = parallel.full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_checkpointer_roundtrip(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ckpt"), max_to_keep=2)
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)}, "step": 7}
+    ck.save(7, state)
+    ck.save(10, {"params": {"w": jnp.zeros((2, 3))}, "step": 10})
+    ck.save(12, {"params": {"w": jnp.ones((2, 3))}, "step": 12})
+    assert ck.all_steps() == [10, 12]  # gc keeps 2
+    restored = ck.restore()
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 1.0)
+    assert int(restored["step"]) == 12
+    old = ck.restore(step=10)
+    np.testing.assert_allclose(np.asarray(old["params"]["w"]), 0.0)
+
+
+def test_checkpointer_pickle_fallback(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ckpt2"), use_orbax=False)
+    ck.save(1, {"x": np.arange(3)})
+    out = ck.restore()
+    np.testing.assert_array_equal(out["x"], np.arange(3))

@@ -86,9 +86,14 @@ class Checkpointer:
         path = self._step_path(step)
         if not os.path.exists(path):
             return None
-        if self._use_orbax and os.path.exists(os.path.join(path, "_METADATA")) or (
-            self._use_orbax and not os.path.exists(os.path.join(path, "state.pkl"))
-        ):
+        is_pickle = os.path.exists(os.path.join(path, "state.pkl"))
+        if not is_pickle:
+            if not self._use_orbax:
+                raise RuntimeError(
+                    f"checkpoint {path} was written by orbax but orbax is "
+                    "unavailable here (install orbax-checkpoint or restore "
+                    "on the saving host)"
+                )
             if target is not None:
                 return self._ckptr.restore(path, item=target)
             return self._ckptr.restore(path)

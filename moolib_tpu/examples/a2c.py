@@ -74,6 +74,9 @@ def make_flags(argv=None):
 
 def train(flags, on_stats=None) -> dict:
     """Full training loop; returns final stats (for the integration test)."""
+    from ..utils import apply_platform_env
+
+    apply_platform_env()
     # EnvPool must fork before jax spins up device state (same constraint the
     # reference solves with its early fork server, src/env.cc:149-169).
     envs = EnvPool(

@@ -154,6 +154,9 @@ def load_checkpoint(path):
 
 
 def train(flags, on_stats=None) -> dict:
+    from ...utils import apply_platform_env
+
+    apply_platform_env()
     env_factory, num_actions, obs_shape = make_env_factory(flags)
     # Fork env workers before jax device state exists in this process.
     envs = [

@@ -1,0 +1,67 @@
+"""Recurrent Q-network for the R2D2-family example (FC → LSTM → Q-values).
+
+Same call contract as the other models: time-major input dict →
+({"q": [T,B,A]}, core_state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class RecurrentQNet(nn.Module):
+    num_actions: int
+    hidden_size: int = 128
+    core_size: int = 64
+    use_lstm: bool = True
+    dtype: Any = jnp.float32
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        if not self.use_lstm:
+            return ()
+        return (
+            jnp.zeros((batch_size, self.core_size), jnp.float32),
+            jnp.zeros((batch_size, self.core_size), jnp.float32),
+        )
+
+    @nn.compact
+    def __call__(self, inputs, core_state=()):
+        x = inputs["state"]
+        T, B = x.shape[0], x.shape[1]
+        x = x.reshape(T * B, -1).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.hidden_size, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.core_size, dtype=self.dtype)(x))
+
+        if self.use_lstm:
+            x = x.reshape(T, B, -1)
+            notdone = (~inputs["done"]).astype(jnp.float32)
+
+            class _Core(nn.Module):
+                hidden: int
+
+                @nn.compact
+                def __call__(self, carry, xs):
+                    inp, nd = xs
+                    carry = jax.tree_util.tree_map(lambda s: s * nd[:, None], carry)
+                    carry, out = nn.OptimizedLSTMCell(self.hidden)(carry, inp)
+                    return carry, out
+
+            scan_core = nn.scan(
+                _Core,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=0,
+                out_axes=0,
+            )(self.core_size)
+            core_state, x = scan_core(tuple(core_state), (x.astype(jnp.float32), notdone))
+            x = x.reshape(T * B, -1)
+
+        # Dueling heads: V + (A - mean A).
+        value = nn.Dense(1, dtype=jnp.float32)(x.astype(jnp.float32))
+        adv = nn.Dense(self.num_actions, dtype=jnp.float32)(x.astype(jnp.float32))
+        q = value + adv - adv.mean(axis=-1, keepdims=True)
+        return {"q": q.reshape(T, B, self.num_actions)}, core_state

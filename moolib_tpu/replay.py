@@ -1,0 +1,188 @@
+"""Distributed prioritized experience replay over RPC.
+
+Supports the R2D2 / recurrent-value-based agent family (BASELINE.json config
+list: "R2D2 / recurrent PPO with LSTM policy + prioritized replay RPC").
+The reference ships no replay buffer — actors would implement one over raw
+``Rpc.define`` — so this is framework-level capability the reference leaves
+to applications:
+
+- :class:`ReplayBuffer` — in-memory prioritized buffer (proportional
+  sampling via a numpy sum-tree, O(log n) updates), thread-safe, pytree
+  items (numpy/jax leaves ride the RPC array path untouched).
+- :class:`ReplayServer` — exposes add/sample/update_priorities/size as RPC
+  functions on an ``Rpc`` peer.
+- :class:`ReplayClient` — call-through wrappers returning RPC futures.
+
+Sampling returns (batch, indices, importance weights) with the standard
+PER correction ``w_i = (N * P(i))^-beta / max_j w_j``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .rpc import Rpc
+from .utils import nest
+
+
+class SumTree:
+    """Binary indexed sum-tree over fixed capacity (power of two internally)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = 1
+        while self.capacity < capacity:
+            self.capacity *= 2
+        self.tree = np.zeros(2 * self.capacity, dtype=np.float64)
+
+    def set(self, idx, value) -> None:
+        idx = np.atleast_1d(np.asarray(idx, np.int64))
+        value = np.atleast_1d(np.asarray(value, np.float64))
+        pos = idx + self.capacity
+        self.tree[pos] = value
+        # Walk the touched paths up, one vectorized level at a time.
+        parents = np.unique(pos // 2)
+        while parents[0] >= 1:
+            self.tree[parents] = self.tree[2 * parents] + self.tree[2 * parents + 1]
+            if parents[0] == 1:
+                break
+            parents = np.unique(parents // 2)
+
+    def total(self) -> float:
+        return float(self.tree[1])
+
+    def get(self, idx) -> np.ndarray:
+        return self.tree[np.asarray(idx, np.int64) + self.capacity]
+
+    def sample(self, targets: np.ndarray) -> np.ndarray:
+        """Find leaf indices whose prefix-sum interval contains each target."""
+        idx = np.ones(len(targets), dtype=np.int64)
+        t = np.asarray(targets, np.float64).copy()
+        while idx[0] < self.capacity:
+            left = self.tree[2 * idx]
+            go_right = t > left
+            t = np.where(go_right, t - left, t)
+            idx = 2 * idx + go_right
+        return idx - self.capacity
+
+
+class ReplayBuffer:
+    """Prioritized ring buffer of pytree items."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6, beta: float = 0.4, seed=None):
+        self.capacity = int(capacity)
+        self.alpha = alpha
+        self.beta = beta
+        self._tree = SumTree(self.capacity)
+        self._items: List[Any] = [None] * self.capacity
+        self._next = 0
+        self._size = 0
+        self._max_priority = 1.0
+        self._lock = threading.Lock()
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def size(self) -> int:
+        return self._size
+
+    def add(self, items: Sequence[Any], priorities: Optional[Sequence[float]] = None):
+        """Insert items (list of pytrees); returns their slot indices."""
+        with self._lock:
+            n = len(items)
+            if priorities is None:
+                priorities = [self._max_priority] * n
+            idxs = [(self._next + i) % self.capacity for i in range(n)]
+            for i, item in zip(idxs, items):
+                self._items[i] = item
+            prios = np.maximum(np.asarray(priorities, np.float64), 1e-6)
+            self._max_priority = max(self._max_priority, float(prios.max()))
+            self._tree.set(np.asarray(idxs), prios**self.alpha)
+            self._next = (self._next + n) % self.capacity
+            self._size = min(self._size + n, self.capacity)
+            return idxs
+
+    def sample(self, batch_size: int) -> Tuple[Any, np.ndarray, np.ndarray]:
+        """(stacked batch, indices, importance weights)."""
+        with self._lock:
+            if self._size == 0:
+                raise ValueError("replay buffer is empty")
+            total = self._tree.total()
+            # Stratified proportional sampling.
+            seg = total / batch_size
+            targets = (np.arange(batch_size) + self._rng.random(batch_size)) * seg
+            idxs = self._tree.sample(np.minimum(targets, total * (1 - 1e-9)))
+            # Guard slots never written (tree zero-padded region).
+            idxs = np.clip(idxs, 0, max(self._size - 1, 0))
+            probs = self._tree.get(idxs) / max(total, 1e-12)
+            weights = (self._size * np.maximum(probs, 1e-12)) ** (-self.beta)
+            weights = weights / weights.max()
+            batch = nest.stack([self._items[int(i)] for i in idxs], dim=0)
+            return batch, idxs.astype(np.int64), weights.astype(np.float32)
+
+    def update_priorities(self, indices, priorities) -> None:
+        with self._lock:
+            prios = np.maximum(np.asarray(priorities, np.float64), 1e-6)
+            self._max_priority = max(self._max_priority, float(prios.max()))
+            self._tree.set(np.asarray(indices, np.int64), prios**self.alpha)
+
+
+class ReplayServer:
+    """Serve a ReplayBuffer to the cohort over RPC."""
+
+    def __init__(self, rpc: Rpc, name: str, buffer: ReplayBuffer):
+        self._rpc = rpc
+        self._buffer = buffer
+        self._name = name
+        rpc.define(f"{name}.add", self._on_add)
+        rpc.define(f"{name}.sample", self._on_sample)
+        rpc.define(f"{name}.update_priorities", self._on_update)
+        rpc.define(f"{name}.size", self._buffer.size)
+
+    def _on_add(self, items, priorities=None):
+        return self._buffer.add(items, priorities)
+
+    def _on_sample(self, batch_size):
+        batch, idxs, weights = self._buffer.sample(batch_size)
+        return {"batch": batch, "indices": idxs, "weights": weights}
+
+    def _on_update(self, indices, priorities):
+        self._buffer.update_priorities(indices, priorities)
+        return True
+
+
+class ReplayClient:
+    """Actor/learner-side handle to a remote ReplayServer."""
+
+    def __init__(self, rpc: Rpc, server_peer: str, name: str):
+        self._rpc = rpc
+        self._peer = server_peer
+        self._name = name
+
+    def add_async(self, items, priorities=None):
+        return self._rpc.async_(self._peer, f"{self._name}.add", items, priorities)
+
+    def add(self, items, priorities=None):
+        return self._rpc.sync(self._peer, f"{self._name}.add", items, priorities)
+
+    def sample_async(self, batch_size: int):
+        return self._rpc.async_(self._peer, f"{self._name}.sample", batch_size)
+
+    def sample(self, batch_size: int):
+        out = self._rpc.sync(self._peer, f"{self._name}.sample", batch_size)
+        return out["batch"], out["indices"], out["weights"]
+
+    def update_priorities_async(self, indices, priorities):
+        return self._rpc.async_(
+            self._peer, f"{self._name}.update_priorities", indices, priorities
+        )
+
+    def update_priorities(self, indices, priorities) -> None:
+        """Fire-and-forget priority write-back (the learner never blocks)."""
+        self.update_priorities_async(indices, priorities)
+
+    def size(self) -> int:
+        return self._rpc.sync(self._peer, f"{self._name}.size")

@@ -128,6 +128,23 @@ def get_max_threads() -> Optional[int]:
 # ---------------------------------------------------------------------------
 
 
+def apply_platform_env() -> None:
+    """Honor the JAX_PLATFORMS env var even when a sitecustomize imported jax
+    at interpreter start (which locks the env-var-based selection). Call at
+    the top of CLI entry points, before any jax computation."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception:  # backends already initialized; keep whatever exists
+        pass
+
+
 class Timer:
     """Monotonic elapsed-seconds timer."""
 

@@ -1,0 +1,43 @@
+"""Build the optional native extension alongside the package.
+
+The codec also builds on first use at runtime (moolib_tpu.native), so a pure
+``pip install .`` without a compiler still yields a working install.
+"""
+
+import os
+
+import numpy as np
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+
+class OptionalBuildExt(build_ext):
+    """Native codec is an accelerator: failure to compile must not fail the
+    install (the package falls back to the python paths)."""
+
+    def run(self):
+        try:
+            super().run()
+        except Exception as e:  # noqa: BLE001
+            print(f"warning: native extension build failed ({e}); "
+                  "runtime build-on-first-use or pure-python fallback applies")
+
+    def build_extension(self, ext):
+        try:
+            super().build_extension(ext)
+        except Exception as e:  # noqa: BLE001
+            print(f"warning: building {ext.name} failed ({e})")
+
+
+setup(
+    ext_modules=[
+        Extension(
+            "_moolib_codec",
+            sources=[os.path.join("native", "codec.cc")],
+            include_dirs=[np.get_include()],
+            extra_compile_args=["-O2", "-std=c++17"],
+            optional=True,
+        )
+    ],
+    cmdclass={"build_ext": OptionalBuildExt},
+)

@@ -1,0 +1,10 @@
+"""R2D2 smoke: recurrent Q-learning + prioritized replay learns CartPole."""
+
+from moolib_tpu.examples.r2d2 import make_flags, train
+
+
+def test_r2d2_learns_cartpole():
+    flags = make_flags(["--total_steps", "30000", "--quiet"])
+    stats = train(flags)
+    assert stats["sgd_steps"] > 500
+    assert stats["mean_episode_return"] > 100, stats["mean_episode_return"]

@@ -1,0 +1,144 @@
+"""Allreduce bandwidth benchmark — RPC tree (DCN) and XLA psum (ICI).
+
+Counterpart of the reference's multi-node benchmark
+(``test/test_multinode_allreduce.cc:16-181``: WORLD_SIZE/RANK env vars,
+chunked ring allreduce over raw RPC, throughput per payload size).  Two
+modes:
+
+- ``rpc``: N peers + broker (single process by default, or one rank per
+  process via WORLD_SIZE/RANK/BROKER_ADDR env vars like the reference)
+  running the elastic binary-tree allreduce over loopback/DCN.
+- ``ici``: jitted ``psum`` over every local device — the TPU data plane the
+  reference never had. On one chip this measures HBM-loopback; on a slice
+  it measures real ICI collective bandwidth.
+
+Prints one line per size: elements, MB, milliseconds, MB/s (bytes, not the
+reference's ambiguous "M/s" element count).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+
+def bench_rpc(args):
+    from moolib_tpu import Broker, Group, Rpc
+
+    world_size = int(os.environ.get("WORLD_SIZE", args.world_size))
+    rank = os.environ.get("RANK")
+    broker_addr = os.environ.get("BROKER_ADDR", args.broker_addr)
+
+    if rank is None:
+        # Single-process cohort (the reference's loopback test pattern).
+        broker = Broker()
+        broker.set_name("broker")
+        broker.listen(broker_addr)
+        peers = []
+        for i in range(world_size):
+            rpc = Rpc()
+            rpc.set_name(f"rank{i}")
+            rpc.listen("127.0.0.1:0")
+            rpc.connect(broker_addr)
+            g = Group(rpc, "bench")
+            g.set_timeout(60)
+            peers.append((rpc, g))
+        pump = lambda: (broker.update(), [g.update() for _, g in peers])
+        groups = [g for _, g in peers]
+    else:
+        raise SystemExit(
+            "multi-process mode: run one process per rank with RANK set and "
+            "rank 0 also running `python -m moolib_tpu.broker`"
+        )
+
+    deadline = time.time() + 30
+    while not all(g.active() for g in groups) and time.time() < deadline:
+        pump()
+        time.sleep(0.01)
+    assert all(g.active() for g in groups), "cohort never converged"
+
+    print(f"# rpc tree allreduce, {world_size} peers, loopback")
+    print(f"{'elems':>10} {'MB':>8} {'ms':>9} {'MB/s':>10}")
+    for size in args.sizes:
+        data = [np.random.randn(size).astype(np.float32) for _ in range(world_size)]
+        # Warmup round.
+        futs = [g.all_reduce("w", d) for g, d in zip(groups, data)]
+        while not all(f.done() for f in futs):
+            pump()
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            futs = [g.all_reduce("x", d) for g, d in zip(groups, data)]
+            while not all(f.done() for f in futs):
+                pump()
+            for f in futs:
+                f.result(0)
+        dt = (time.perf_counter() - t0) / args.iters
+        mb = size * 4 / 1e6
+        print(f"{size:>10} {mb:>8.2f} {dt*1e3:>9.2f} {mb/dt:>10.1f}")
+    for rpc, _ in peers:
+        rpc.close()
+    broker.close()
+
+
+def bench_ici(args):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from moolib_tpu import parallel
+
+    devices = jax.devices()
+    mesh = parallel.make_mesh({"dp": len(devices)})
+    print(f"# XLA psum over {len(devices)} x {devices[0].platform} (ICI data plane)")
+    print(f"{'elems':>10} {'MB':>8} {'ms':>9} {'MB/s':>10}")
+
+    for size in args.sizes:
+        n = len(devices)
+        per = (size + n - 1) // n
+        x = jnp.zeros((n, per), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+        @jax.jit
+        def allreduce(x):
+            return jax.shard_map(
+                lambda v: jax.lax.psum(v, "dp"),
+                mesh=mesh,
+                in_specs=P("dp"),
+                out_specs=P("dp"),
+            )(x)
+
+        out = allreduce(x)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = allreduce(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        mb = size * 4 / 1e6
+        print(f"{size:>10} {mb:>8.2f} {dt*1e3:>9.2f} {mb/dt:>10.1f}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="moolib_tpu allreduce benchmark")
+    p.add_argument("mode", choices=["rpc", "ici"], nargs="?", default="rpc")
+    p.add_argument("--world_size", type=int, default=4)
+    p.add_argument("--broker_addr", default="127.0.0.1:4499")
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--sizes",
+        type=int,
+        nargs="+",
+        default=[400, 10_000, 100_000, 1_000_000, 2_621_440],
+    )
+    args = p.parse_args(argv)
+    if args.mode == "rpc":
+        bench_rpc(args)
+    else:
+        bench_ici(args)
+
+
+if __name__ == "__main__":
+    main()

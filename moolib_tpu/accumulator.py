@@ -105,9 +105,11 @@ class Accumulator:
         # gradient machinery
         self._virtual_batch_size: Optional[int] = None
         self._parallel_gradients = 1
+        self._wire_dtype = None  # e.g. jnp.bfloat16: halves allreduce bytes
         self._reduction_inflight = False
         self._accum_grads = None
         self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+        self._grad_dtypes = None
         self._has_gradients = False
         self._result_grads = None
         self._result_stats: Dict[str, int] = {}
@@ -176,6 +178,15 @@ class Accumulator:
     def set_parallel_gradients(self, n: int) -> None:
         self._parallel_gradients = int(n)
 
+    def set_wire_dtype(self, dtype) -> None:
+        """Compress gradients to ``dtype`` (e.g. jnp.bfloat16) on the wire.
+
+        TPU-idiomatic extension: the tree allreduce rides DCN/TCP where bytes
+        are the bottleneck; bf16 halves traffic at negligible quality cost
+        for gradients (the accumulate/average still happens in the original
+        dtype after decompression at each hop's reduce)."""
+        self._wire_dtype = dtype
+
     def parameters(self):
         """Current synced parameter pytree (jax adaptation of the reference's
         in-place tensor updates)."""
@@ -242,14 +253,36 @@ class Accumulator:
                 "jax adaptation: pass the gradient pytree explicitly, "
                 "reduce_gradients(batch_size, gradients)"
             )
+        if self._wire_dtype is not None:
+            wd = self._wire_dtype
+            # Remember the true dtypes so gradients() can restore them.
+            self._grad_dtypes = jax.tree_util.tree_map(
+                lambda g: np.asarray(g).dtype, gradients
+            )
+            gradients = jax.tree_util.tree_map(
+                lambda g: np.asarray(g).astype(wd), gradients
+            )
         self._start_round(
-            {"num_gradients": 1, "num_skipped": 0, "batch_size": int(batch_size)},
+            {
+                "num_gradients": 1,
+                "num_skipped": 0,
+                "batch_size": int(batch_size),
+                "wire": np.dtype(self._wire_dtype).name if self._wire_dtype else None,
+            },
             gradients,
         )
 
     def skip_gradients(self) -> None:
         """Participate in this reduction round without contributing data."""
-        self._start_round({"num_gradients": 0, "num_skipped": 1, "batch_size": 0}, None)
+        self._start_round(
+            {
+                "num_gradients": 0,
+                "num_skipped": 1,
+                "batch_size": 0,
+                "wire": np.dtype(self._wire_dtype).name if self._wire_dtype else None,
+            },
+            None,
+        )
 
     def _start_round(self, stats: Dict[str, int], gradients):
         with self._lock:
@@ -273,6 +306,7 @@ class Accumulator:
                 "num_gradients": stats["num_gradients"],
                 "num_skipped": stats["num_skipped"],
                 "batch_size": stats["batch_size"],
+                "wire": stats.get("wire"),
             }
             fut = self._group.all_reduce(f"__accum_grad:{self._name}", payload, op=_grad_reduce_op)
             fut.add_done_callback(self._on_reduce_done)
@@ -288,19 +322,31 @@ class Accumulator:
                 utils.log_verbose("accumulator %s: reduction failed: %s", self._name, exc)
                 return
             result = fut.result(0)
-            # Accumulate across rounds until the virtual batch size is met.
-            if self._accum_grads is None and result["grads"] is not None:
-                self._accum_grads = result["grads"]
-            elif result["grads"] is not None:
-                self._accum_grads = _tree_add(self._accum_grads, result["grads"])
+            # Accumulate across rounds until the virtual batch size is met
+            # (in f32 when wire compression is on, to avoid absorption).
+            rg = result["grads"]
+            if rg is not None and self._wire_dtype is not None:
+                rg = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), rg)
+            if self._accum_grads is None and rg is not None:
+                self._accum_grads = rg
+            elif rg is not None:
+                self._accum_grads = _tree_add(self._accum_grads, rg)
             for k in ("num_gradients", "num_skipped", "batch_size"):
                 self._accum_stats[k] += result[k]
             target = self._virtual_batch_size or 1
             if self._accum_stats["batch_size"] >= target and self._accum_stats["num_gradients"] > 0:
                 n = self._accum_stats["num_gradients"]
-                self._result_grads = jax.tree_util.tree_map(
-                    lambda x: x / n, self._accum_grads
-                )
+                if self._wire_dtype is not None and self._grad_dtypes is not None:
+                    # Restore each leaf's original dtype (averaging in f32).
+                    self._result_grads = jax.tree_util.tree_map(
+                        lambda x, dt: (np.asarray(x, np.float32) / n).astype(dt),
+                        self._accum_grads,
+                        self._grad_dtypes,
+                    )
+                else:
+                    self._result_grads = jax.tree_util.tree_map(
+                        lambda x: x / n, self._accum_grads
+                    )
                 self._result_stats = dict(self._accum_stats)
                 self._accum_grads = None
                 self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
@@ -469,19 +515,36 @@ class Accumulator:
 
 def _grad_reduce_op(a, b):
     """Reduce two gradient-round payloads: counts add, grad pytrees add
-    (None = a skip contribution)."""
+    (None = a skip contribution).
+
+    Wire compression: leaves arrive in the wire dtype (e.g. bf16) but each
+    hop accumulates in float32 and re-rounds the partial sum to the wire
+    dtype before it travels on — log2(n) roundings instead of n-1 lossy
+    adds, so small contributions are never absorbed by a large running sum.
+    """
     if isinstance(a, dict) and "num_gradients" in a:
         ga, gb = a.get("grads"), b.get("grads")
+        wire = a.get("wire") or b.get("wire")
         if ga is None:
             grads = gb
         elif gb is None:
             grads = ga
         else:
-            grads = _tree_add(ga, gb)
+            if wire is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda x, y: (
+                        np.asarray(x, np.float32) + np.asarray(y, np.float32)
+                    ).astype(np.dtype(wire)),
+                    ga,
+                    gb,
+                )
+            else:
+                grads = _tree_add(ga, gb)
         return {
             "grads": grads,
             "num_gradients": a["num_gradients"] + b["num_gradients"],
             "num_skipped": a["num_skipped"] + b["num_skipped"],
             "batch_size": a["batch_size"] + b["batch_size"],
+            "wire": wire,
         }
     return a + b

@@ -177,10 +177,17 @@ def _chunk_len(c) -> int:
     return c.nbytes if isinstance(c, memoryview) else len(c)
 
 
-def _request_chunks(rid: int, fn_name: str, body: List[bytes]) -> List[bytes]:
-    """Single source of truth for the request frame layout."""
+def _request_chunks(
+    rid: int, fn_name: str, body: List[bytes], timeout_s: float
+) -> List[bytes]:
+    """Single source of truth for the request frame layout. The sender's
+    call timeout travels with the request so the receiver can size its
+    at-most-once dedup window to outlive every possible resend."""
     fnb = fn_name.encode()
-    return [struct.pack("<BQH", KIND_REQUEST, rid, len(fnb)) + fnb] + body
+    hdr = struct.pack(
+        "<BQIH", KIND_REQUEST, rid, min(int(timeout_s), 0xFFFFFFFF), len(fnb)
+    )
+    return [hdr + fnb] + body
 
 
 def _local_addresses() -> List[str]:
@@ -261,14 +268,22 @@ class _Connection:
         self._explicit_addr: Optional[str] = None
 
     def send_frame(self, chunks: List[bytes]) -> None:
+        # Coalesce the frame into ONE buffer and issue a single write().
+        # Feeding many chunks into the transport triggers CPython 3.12's
+        # sendmsg multi-buffer accounting bug (gh: "pop from an empty deque"
+        # in _adjust_leftover_buffer), which corrupts the stream under load.
+        # One memcpy per frame also beats the sendmsg path on throughput.
         total = sum(_chunk_len(c) for c in chunks)
-        self.writer.write(struct.pack("<I", total))
+        buf = bytearray(4 + total)
+        struct.pack_into("<I", buf, 0, total)
+        off = 4
         for c in chunks:
-            # Zero-copy for out-of-band array buffers: asyncio transports
-            # accept bytes-like objects; flatten multi-dim memoryviews.
             if isinstance(c, memoryview) and c.ndim != 1:
                 c = c.cast("B")
-            self.writer.write(c)
+            n = _chunk_len(c)
+            buf[off : off + n] = c
+            off += n
+        self.writer.write(buf)
         self.send_count += 1
 
     def close(self) -> None:
@@ -332,6 +347,9 @@ class _Outgoing:
         "future",
         "deadline",
         "sent_at",
+        "timeout_s",
+        "resent",
+        "parked",
     )
 
     def __init__(self, rid, peer_name, fn_name, chunks, payload_obj, future, deadline):
@@ -344,6 +362,9 @@ class _Outgoing:
         self.future = future
         self.deadline = deadline
         self.sent_at = time.monotonic()
+        self.timeout_s = _DEFAULT_TIMEOUT
+        self.resent = False  # RTT samples from resent requests are ambiguous
+        self.parked = False  # already waiting in peer.pending
 
 
 class _FnDef:
@@ -645,9 +666,10 @@ class Rpc:
             future.set_exception(RpcError(f"serialization error: {e}"))
             return
         rid = next(self._rid)
-        chunks = _request_chunks(rid, fn_name, body)
+        chunks = _request_chunks(rid, fn_name, body, self._timeout)
         deadline = time.monotonic() + self._timeout
         out = _Outgoing(rid, peer_name, fn_name, chunks, (args, kwargs), future, deadline)
+        out.timeout_s = self._timeout
 
         def _done(fut: Future):
             # Completed (incl. user cancel): drop the resend buffer promptly.
@@ -672,10 +694,12 @@ class Rpc:
                 return
             except Exception:
                 conn.close()
-        # No usable connection: park on the peer and go find it.
+        # No usable connection: park on the peer (once) and go find it.
         if peer is None:
             peer = self._peers.setdefault(out.peer_name, _Peer(out.peer_name))
-        peer.pending.append(out)
+        if not out.parked:
+            out.parked = True
+            peer.pending.append(out)
         self._loop.create_task(self._find_peer(peer))
 
     def _chunks_for(self, peer: _Peer, out: _Outgoing) -> List[bytes]:
@@ -686,7 +710,7 @@ class Rpc:
         if out.chunks_portable is None:
             sp = serialization._py_serialize(out.payload_obj)
             out.chunks_portable = _request_chunks(
-                out.rid, out.fn_name, serialization.pack(sp)
+                out.rid, out.fn_name, serialization.pack(sp), out.timeout_s
             )
         return out.chunks_portable
 
@@ -877,6 +901,7 @@ class Rpc:
         pending, peer.pending = peer.pending, []
         seen = set()
         for out in pending:
+            out.parked = False
             if out.rid in self._outgoing and out.rid not in seen:
                 seen.add(out.rid)
                 self._try_send(out)
@@ -885,10 +910,13 @@ class Rpc:
                 self._try_send(out)
 
     def _on_request(self, conn: _Connection, frame: bytes):
-        rid, fnlen = struct.unpack_from("<QH", frame, 1)
-        off = 1 + 8 + 2
+        rid, sender_timeout, fnlen = struct.unpack_from("<QIH", frame, 1)
+        off = 1 + 8 + 4 + 2
         fn_name = frame[off : off + fnlen].decode()
         off += fnlen
+        # At-most-once window must outlive every possible resend by this
+        # sender: size it from the *sender's* call timeout, not ours.
+        dedup_ttl = max(2.0 * sender_timeout, 120.0)
         peer = self._peers.get(conn.peer_name) if conn.peer_name else None
         if peer is not None:
             cached = peer.recent.get(rid)
@@ -923,7 +951,7 @@ class Rpc:
                     chunks = [struct.pack("<BQ", KIND_ERROR, rid)] + body
                 if peer is not None:
                     peer.executing.discard(rid)
-                    peer.recent[rid] = (time.monotonic(), chunks)
+                    peer.recent[rid] = (time.monotonic(), chunks, dedup_ttl)
                 # Respond over the best currently-alive connection to the peer;
                 # fall back to the connection the request came in on.
                 target = peer.best_connection(self._transport_order) if peer else None
@@ -1008,8 +1036,10 @@ class Rpc:
         out = self._outgoing.pop(rid, None)
         if out is None:
             return  # late/duplicate response
-        rtt = time.monotonic() - out.sent_at
-        conn.latency = rtt if conn.latency is None else conn.latency * 0.9 + rtt * 0.1
+        if not out.resent:
+            # Resent requests give ambiguous RTTs (which send did this answer?)
+            rtt = time.monotonic() - out.sent_at
+            conn.latency = rtt if conn.latency is None else conn.latency * 0.9 + rtt * 0.1
         try:
             value = serialization.deserialize(serialization.unpack(frame, 9))
         except Exception as e:  # noqa: BLE001
@@ -1031,15 +1061,31 @@ class Rpc:
                 out.future.set_exception(
                     RpcError(f"Call ({out.peer_name}::{out.fn_name}) timed out")
                 )
+            # Periodic resend of stale outstanding requests (the analogue of
+            # the reference's poke/nack cycle, src/rpc.cc:2526-2703): a
+            # response can die on a half-dead socket after our greeting-time
+            # resend; receiver dedup returns the cached response.
+            for out in list(self._outgoing.values()):
+                if now - out.sent_at > 3.0:
+                    out.resent = True  # RTT from this rid is no longer a sample
+                    self._try_send(out)
+                    out.sent_at = now
+            # Prune dead entries from pending queues (their futures already
+            # timed out); park flags reset so nothing leaks against a peer
+            # that never comes back.
+            for peer in self._peers.values():
+                if peer.pending:
+                    peer.pending = [
+                        o for o in peer.pending if o.rid in self._outgoing
+                    ]
             # Retry unsent/parked requests whose peers got connected meanwhile,
             # and resend periodically (at-most-once holds via receiver dedup).
-            # Dedup cache must outlive the call timeout, or a reconnect resend
-            # after the cache expires would re-execute a non-idempotent handler.
-            recent_ttl = max(2 * self._timeout, 120.0)
+            # Dedup entries carry their own TTL (derived from each sender's
+            # call timeout at request time).
             for peer in self._peers.values():
                 now2 = time.monotonic()
                 peer.recent = {
-                    rid: v for rid, v in peer.recent.items() if now2 - v[0] < recent_ttl
+                    rid: v for rid, v in peer.recent.items() if now2 - v[0] < v[2]
                 }
                 # Keep hunting for peers with parked requests.
                 if peer.pending and not peer.connections:

@@ -1,0 +1,51 @@
+"""Gradient wire compression (bf16 allreduce payloads)."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from moolib_tpu import Accumulator, Broker
+
+
+def test_bf16_wire_gradients(free_port):
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    accs = []
+    for i in range(2):
+        acc = Accumulator("m", {"w": np.zeros((4,), np.float32)})
+        acc.set_name(f"p{i}")
+        acc.listen()
+        acc.set_wire_dtype(jnp.bfloat16)
+        acc.connect(addr)
+        accs.append(acc)
+    try:
+        deadline = time.time() + 30
+        while not all(a.connected() for a in accs) and time.time() < deadline:
+            broker.update()
+            for a in accs:
+                a.update()
+                if a.wants_state():
+                    a.set_state({})
+            time.sleep(0.02)
+        assert all(a.connected() for a in accs)
+        g = {"w": np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)}
+        for a in accs:
+            a.reduce_gradients(1, g)
+        deadline = time.time() + 15
+        while not all(a.has_gradients() for a in accs) and time.time() < deadline:
+            broker.update()
+            for a in accs:
+                a.update()
+            time.sleep(0.02)
+        for a in accs:
+            out = np.asarray(a.gradients()["w"], np.float32)
+            assert out.dtype == np.float32
+            # bf16 carries ~3 decimal digits: mean of identical grads = grads.
+            np.testing.assert_allclose(out, [1, 2, 3, 4], rtol=0.01)
+    finally:
+        for a in accs:
+            a.close()
+        broker.close()

@@ -1,0 +1,160 @@
+"""Fault injection for the RPC reliability layer.
+
+The reference's poke/ack/nack/resend machinery is its hardest, least-tested
+code (SURVEY.md §7 "hard parts": needs a deterministic harness). Here a
+TCP proxy sits between client and host and kills connections mid-flight;
+the assertions are the reliability contract:
+
+- calls complete despite connection churn (reconnect + resend), and
+- non-idempotent handlers execute at most once (receiver dedup), so the
+  observed side-effect count equals the number of *calls*, not sends.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from moolib_tpu import Rpc, RpcError
+
+
+class ChaosProxy:
+    """TCP proxy that forwards bytes and can kill all live links on demand."""
+
+    def __init__(self, target_port: int):
+        self._target_port = target_port
+        self._lsock = socket.socket()
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(16)
+        self.port = self._lsock.getsockname()[1]
+        self._links = []
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                cli, _ = self._lsock.accept()
+            except OSError:
+                return
+            try:
+                srv = socket.create_connection(("127.0.0.1", self._target_port))
+            except OSError:
+                cli.close()
+                continue
+            self._links.append((cli, srv))
+            threading.Thread(target=self._pump, args=(cli, srv), daemon=True).start()
+            threading.Thread(target=self._pump, args=(srv, cli), daemon=True).start()
+
+    def _pump(self, a, b):
+        try:
+            while True:
+                data = a.recv(65536)
+                if not data:
+                    break
+                b.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (a, b):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def kill_links(self):
+        links, self._links = self._links, []
+        for a, b in links:
+            for s in (a, b):
+                try:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER, b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                    s.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        self._closed = True
+        self.kill_links()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def chaos_pair(free_port):
+    host, client = Rpc(), Rpc()
+    host.set_name("host")
+    client.set_name("client")
+    client.set_timeout(30)
+    host.listen(f"127.0.0.1:{free_port}")
+    proxy = ChaosProxy(free_port)
+    client.connect(f"127.0.0.1:{proxy.port}")
+    yield host, client, proxy
+    proxy.close()
+    host.close()
+    client.close()
+
+
+def test_calls_survive_connection_churn(chaos_pair):
+    host, client, proxy = chaos_pair
+    host.define("echo", lambda x: x * 2)
+    assert client.sync("host", "echo", 21) == 42  # link established
+
+    futures = []
+    for i in range(60):
+        futures.append(client.async_("host", "echo", i))
+        if i % 20 == 10:
+            proxy.kill_links()  # mid-burst: requests + responses in flight die
+            time.sleep(0.1)
+    results = [f.result() for f in futures]
+    assert results == [2 * i for i in range(60)]
+
+
+def test_at_most_once_execution_under_churn(chaos_pair):
+    host, client, proxy = chaos_pair
+    counter = {"n": 0}
+    lock = threading.Lock()
+
+    def bump(tag):
+        with lock:
+            counter["n"] += 1
+        # Slow handler: the response is often in flight when links die,
+        # forcing client resends of already-executed requests.
+        time.sleep(0.05)
+        return tag
+
+    host.define("bump", bump)
+    assert client.sync("host", "bump", -1) == -1
+    futures = [client.async_("host", "bump", i) for i in range(20)]
+    for _ in range(4):
+        time.sleep(0.12)
+        proxy.kill_links()
+    results = [f.result() for f in futures]
+    assert results == list(range(20))
+    # 21 calls total (warmup + 20): dedup must have eaten every resend.
+    assert counter["n"] == 21, f"handler ran {counter['n']} times for 21 calls"
+
+
+def test_failover_to_advertised_address(chaos_pair):
+    """If the proxy path dies but the peer is reachable at an address it
+    advertised in its greeting, calls fail over transparently (the
+    reference's remote-address-list reconnect)."""
+    host, client, proxy = chaos_pair
+    host.define("noop", lambda: 7)
+    assert client.sync("host", "noop") == 7
+    proxy.close()  # the original path is gone for good
+    assert client.sync("host", "noop") == 7  # direct connection takes over
+
+
+def test_timeout_when_peer_dead(chaos_pair):
+    host, client, proxy = chaos_pair
+    host.define("noop", lambda: None)
+    client.sync("host", "noop")
+    client.set_timeout(2)
+    host.close()
+    proxy.kill_links()
+    fut = client.async_("host", "noop")
+    with pytest.raises(RpcError, match="timed out"):
+        fut.result()

@@ -185,7 +185,7 @@ class EnvRunner:
             act_views[b] = np.ndarray(shape, dtype=dtype, buffer=seg.buf)
         try:
             while True:
-                b = self.task_queue.get()
+                b = self._get_task()
                 if b is None or b == _SHUTDOWN:
                     break
                 self._step_batch(b, views[b], act_views[b])
@@ -193,6 +193,16 @@ class EnvRunner:
         finally:
             for seg in segs:
                 seg.close()
+
+    def _get_task(self):
+        """Blocking task fetch with an idle suicide timer: an orphaned worker
+        (parent gone without close()) exits instead of lingering forever
+        (reference EnvRunner 1800 s idle suicide, src/env.h:446-450)."""
+        get = getattr(self.task_queue, "get_timeout", None)
+        if get is None and hasattr(self.task_queue, "_ring"):
+            out = self.task_queue._ring.pop(timeout=1800.0)
+            return _SHUTDOWN if out is None else out
+        return self.task_queue.get()
 
     def _step_batch(self, b: int, view: Dict[str, np.ndarray], actions: np.ndarray):
         for i in range(self.lo, self.hi):

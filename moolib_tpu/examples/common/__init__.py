@@ -126,9 +126,10 @@ class EnvBatchState:
 
 class TsvLogger:
     """Incremental TSV logging (reference ``examples/common/record.py``):
-    writes a header once, appends rows, creates a ``latest`` symlink."""
+    writes a header once, appends rows, creates a ``latest`` symlink and a
+    run ``metadata.json`` (argv, env, start time — reference ``:32-84``)."""
 
-    def __init__(self, path: str, symlink: bool = True):
+    def __init__(self, path: str, symlink: bool = True, metadata: Optional[dict] = None):
         self.path = path
         self._fields = None
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -140,6 +141,21 @@ class TsvLogger:
                 os.symlink(os.path.basename(path), link)
             except OSError:
                 pass
+        import json
+        import sys
+
+        meta = {
+            "argv": sys.argv,
+            "start_time": time.time(),
+            "log": os.path.basename(path),
+        }
+        if metadata:
+            meta.update(metadata)
+        try:
+            with open(os.path.join(os.path.dirname(path) or ".", "metadata.json"), "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+        except OSError:
+            pass
 
     def log(self, **fields) -> None:
         if self._fields is None:

@@ -10,3 +10,5 @@ support so the convs/matmuls land on the MXU.
 
 from .impala import ImpalaNet  # noqa: F401
 from .actor_critic import ActorCriticNet  # noqa: F401
+from .qnet import RecurrentQNet  # noqa: F401
+from .transformer import TransformerLM  # noqa: F401

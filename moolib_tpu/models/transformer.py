@@ -1,0 +1,88 @@
+"""Causal Transformer LM with pluggable attention: dense / pallas flash /
+ring (sequence-parallel over a mesh axis).
+
+Long-context model surface for the framework's SP capability (the reference
+has no attention models at all, SURVEY.md §5.7). Attention selection:
+
+- ``attention="dense"`` — XLA dense (small T, debugging);
+- ``attention="flash"`` — pallas blockwise kernel, single chip;
+- ``attention="ring"`` — ring attention over the ``sp`` axis of a mesh
+  passed at apply time (``model.apply(params, tokens, mesh=mesh)``), for
+  sequences longer than one chip's HBM.
+
+bfloat16 compute, f32 params/logits; pre-LN blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class Block(nn.Module):
+    d_model: int
+    num_heads: int
+    attention: str
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, mesh=None):
+        B, T, D = x.shape
+        H = self.num_heads
+        hd = D // H
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        qkv = nn.Dense(3 * D, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, hd), 3, axis=2)
+
+        if self.attention == "ring":
+            from ..parallel.ring_attention import ring_attention
+
+            if mesh is None:
+                raise ValueError("attention='ring' needs mesh= at apply time")
+            att = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+        elif self.attention == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            att = flash_attention(q, k, v, causal=True)
+        else:
+            from ..parallel.ring_attention import full_attention
+
+            att = full_attention(q, k, v, causal=True)
+        att = att.reshape(B, T, D)
+        x = x + nn.Dense(D, dtype=self.dtype, name="proj")(att)
+
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.Dense(4 * D, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(D, dtype=self.dtype)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int
+    d_model: int = 256
+    num_heads: int = 4
+    num_layers: int = 4
+    max_len: int = 8192
+    attention: str = "flash"  # dense | flash | ring
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, mesh=None) -> jax.Array:
+        B, T = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos")(
+            jnp.arange(T)[None, :]
+        )
+        x = x + pos
+        for i in range(self.num_layers):
+            x = Block(
+                self.d_model, self.num_heads, self.attention, self.dtype, name=f"block{i}"
+            )(x, mesh=mesh)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(
+            x.astype(jnp.float32)
+        )

@@ -1,0 +1,58 @@
+"""Batch-size finder: measure the throughput-optimal batch for a jitted fn.
+
+The reference ships an unused latency-model search (``src/batchsizefinder.h``,
+dead code). This is the live TPU version: walk powers of two, time the jitted
+function (compile excluded), stop when marginal per-sample speedup drops
+below ``threshold`` or memory runs out, and return the best batch size.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+
+from . import log_info
+
+
+def find_batch_size(
+    make_batch: Callable[[int], object],
+    fn: Callable,
+    start: int = 8,
+    max_batch: int = 4096,
+    threshold: float = 1.05,
+    iters: int = 5,
+) -> int:
+    """Return the batch size with the best samples/sec.
+
+    Args:
+      make_batch: ``make_batch(n) -> args tuple`` building inputs of batch n.
+      fn: jittable callable taking ``*make_batch(n)``.
+      threshold: keep doubling while throughput improves by at least this
+        factor; stop on regression, plateau, or OOM.
+    """
+    jfn = jax.jit(fn)
+    best_bs, best_rate = None, 0.0
+    bs = start
+    while bs <= max_batch:
+        try:
+            args = make_batch(bs)
+            out = jfn(*args)  # compile
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = jfn(*args)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / iters
+        except (RuntimeError, jax.errors.JaxRuntimeError) as e:  # OOM etc.
+            log_info("batch size %d failed (%s); stopping search", bs, type(e).__name__)
+            break
+        rate = bs / dt
+        log_info("batch %d: %.1f samples/s (%.2f ms)", bs, rate, dt * 1e3)
+        if best_bs is not None and rate < best_rate * threshold:
+            break
+        if rate > best_rate:
+            best_bs, best_rate = bs, rate
+        bs *= 2
+    return best_bs if best_bs is not None else start

@@ -36,6 +36,7 @@ jitted train step instead — same math, collective data plane.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -59,6 +60,18 @@ def _tree_add(a, b):
 
 def _tree_zeros_like(t):
     return jax.tree_util.tree_map(lambda x: np.zeros_like(np.asarray(x)), t)
+
+
+class _Round:
+    """One in-flight gradient reduction round."""
+
+    __slots__ = ("future", "done", "result", "error")
+
+    def __init__(self, future):
+        self.future = future
+        self.done = False
+        self.result = None
+        self.error = None
 
 
 class Accumulator:
@@ -106,7 +119,12 @@ class Accumulator:
         self._virtual_batch_size: Optional[int] = None
         self._parallel_gradients = 1
         self._wire_dtype = None  # e.g. jnp.bfloat16: halves allreduce bytes
-        self._reduction_inflight = False
+        # In-flight reduction rounds, oldest first.  With
+        # set_parallel_gradients(n) up to n rounds overlap; results are
+        # applied strictly in issue order — the Group sequences same-name ops
+        # per epoch, so the order is identical on every peer (reference
+        # pipelining guarantee, src/moolib.cc:1830-1842).
+        self._inflight: collections.deque = collections.deque()
         self._accum_grads = None
         self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
         self._grad_dtypes = None
@@ -176,6 +194,14 @@ class Accumulator:
         self._virtual_batch_size = int(n)
 
     def set_parallel_gradients(self, n: int) -> None:
+        """Allow ``n`` gradient reductions in flight at once.
+
+        With n > 1 the train loop can keep computing (gradients up to n model
+        versions old) while earlier reductions are still on the wire; results
+        are applied in the same order on all peers (reference
+        ``src/moolib.cc:1830-1842``, ``src/accumulator.cc:251-256``)."""
+        if n < 1:
+            raise ValueError("parallel_gradients must be >= 1")
         self._parallel_gradients = int(n)
 
     def set_wire_dtype(self, dtype) -> None:
@@ -239,7 +265,9 @@ class Accumulator:
     def wants_gradients(self) -> bool:
         with self._lock:
             return (
-                self.connected() and not self._reduction_inflight and not self._has_gradients
+                self.connected()
+                and len(self._inflight) < self._parallel_gradients
+                and not self._has_gradients
             )
 
     def has_gradients(self) -> bool:
@@ -296,11 +324,13 @@ class Accumulator:
                     self._name,
                 )
                 return
-            if self._reduction_inflight:
-                raise RpcError("a gradient reduction is already in flight")
+            if len(self._inflight) >= self._parallel_gradients:
+                raise RpcError(
+                    f"{len(self._inflight)} gradient reductions already in flight "
+                    f"(parallel_gradients={self._parallel_gradients})"
+                )
             if self._has_gradients:
                 raise RpcError("unconsumed gradients; call zero_gradients() first")
-            self._reduction_inflight = True
             payload = {
                 "grads": gradients,
                 "num_gradients": stats["num_gradients"],
@@ -309,19 +339,37 @@ class Accumulator:
                 "wire": stats.get("wire"),
             }
             fut = self._group.all_reduce(f"__accum_grad:{self._name}", payload, op=_grad_reduce_op)
-            fut.add_done_callback(self._on_reduce_done)
+            round_ = _Round(fut)
+            self._inflight.append(round_)
+            fut.add_done_callback(lambda f, r=round_: self._on_round_done(r, f))
 
-    def _on_reduce_done(self, fut):
-        exc = fut.exception()
+    def _on_round_done(self, round_, fut):
         with self._lock:
-            self._reduction_inflight = False
-            if exc is not None:
+            round_.done = True
+            round_.error = fut.exception()
+            if round_.error is None:
+                round_.result = fut.result(0)
+            self._drain_rounds_locked()
+
+    def _drain_rounds_locked(self):
+        """Apply completed rounds in issue order (pipelining keeps the order
+        identical on every peer: the Group sequences same-name ops)."""
+        while self._inflight and self._inflight[0].done:
+            if self._inflight[0].error is not None:
                 # Group changed or timeout: local contribution is lost; the
                 # user will see wants_gradients() and produce a fresh one
                 # (same observable behavior as the reference's cancel path).
-                utils.log_verbose("accumulator %s: reduction failed: %s", self._name, exc)
-                return
-            result = fut.result(0)
+                # Errored rounds free their pipeline slot even while a result
+                # is pending consumption.
+                round_ = self._inflight.popleft()
+                utils.log_verbose(
+                    "accumulator %s: reduction failed: %s", self._name, round_.error
+                )
+                continue
+            if self._has_gradients:
+                break  # result pending consumption; apply after zero_gradients
+            round_ = self._inflight.popleft()
+            result = round_.result
             # Accumulate across rounds until the virtual batch size is met
             # (in f32 when wire compression is on, to avoid absorption).
             rg = result["grads"]
@@ -367,6 +415,9 @@ class Accumulator:
             self._has_gradients = False
             self._result_grads = None
             self._model_version += 1
+            # Pipelined rounds that completed while the result was pending
+            # consumption can now be applied.
+            self._drain_rounds_locked()
 
     # ------------------------------------------------------------------ pump
     def update(self) -> None:
@@ -421,7 +472,9 @@ class Accumulator:
             self._is_leader = False
             self._epoch_synced = False
             self._staged_model = None
-            self._reduction_inflight = False
+            # Old-epoch rounds are dead; their futures error via the Group's
+            # cancel, but the records must go now so new rounds can start.
+            self._inflight.clear()
             self._accum_grads = None
             self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
             if not self._group.active():

@@ -172,8 +172,11 @@ class Group:
             ]
             for op in expired:
                 del self._ops[op.key]
-            for op in expired:
-                op.future.set_exception(RpcError(f"allreduce {op.key} timed out"))
+        # Futures complete outside the group lock: done-callbacks (e.g. the
+        # Accumulator's) take their own locks, and completing inline would
+        # invert the lock order against all_reduce callers.
+        for op in expired:
+            op.future.set_exception(RpcError(f"allreduce {op.key} timed out"))
 
     def _on_ping_reply(self, result, error):
         self._ping_inflight = False
@@ -260,7 +263,8 @@ class Group:
             self._ops[key] = opstate
             parked = self._parked.pop(key, [])
             opstate.contribs.extend(parked)
-            self._check_op_locked(opstate)
+            action = self._check_op_locked(opstate)
+        self._finish_op(opstate, action)
         return future
 
     def _on_reduce(self, key, value):
@@ -273,13 +277,17 @@ class Group:
                 self._parked.setdefault(key, []).append(value)
                 return None
             op.contribs.append(value)
-            self._check_op_locked(op)
+            action = self._check_op_locked(op)
+        self._finish_op(op, action)
         return None
 
     def _check_op_locked(self, op: _Op):
+        """Reduce ready contributions; returns an action the caller performs
+        *outside* the group lock (sends and future completion run caller
+        callbacks / take caller locks — lock-order safety), or None."""
         idx, parent, children = self._tree()
         if op.sent_up or len(op.contribs) < len(children):
-            return
+            return None
         total = op.value
         for c in op.contribs[: len(children)]:
             total = op.op(total, c)
@@ -287,20 +295,33 @@ class Group:
         if parent is None:
             # Root: reduction complete — share down the tree.
             del self._ops[op.key]
-            self._share_down(op.key, total, idx)
+            return ("root", total, idx, self._members)
+        return ("up", self._members[parent], total)
+
+    def _finish_op(self, op: _Op, action) -> None:
+        """Perform the deferred part of _check_op_locked outside the lock.
+        ``members`` is the epoch snapshot taken under the lock: a concurrent
+        membership change must not be observed half-way (receivers drop
+        messages whose epoch key is stale, so sends to old members are safe).
+        """
+        if action is None:
+            return
+        if action[0] == "root":
+            _, total, idx, members = action
+            self._share_down(op.key, total, idx, members)
             op.future.set_result(total)
-        else:
-            parent_name = self._members[parent]
+            return
+        _, parent_name, total = action
 
-            def _sent(result, error, op=op):
-                if error is not None:
-                    with self._lock:
-                        self._ops.pop(op.key, None)
-                    op.future.set_exception(RpcError(f"allreduce send failed: {error}"))
+        def _sent(result, error, op=op):
+            if error is not None:
+                with self._lock:
+                    self._ops.pop(op.key, None)
+                op.future.set_exception(RpcError(f"allreduce send failed: {error}"))
 
-            self._rpc.async_callback(
-                parent_name, "__group_reduce", _sent, self._name, op.key, total
-            )
+        self._rpc.async_callback(
+            parent_name, "__group_reduce", _sent, self._name, op.key, total
+        )
 
     def _on_share(self, key, result):
         key = tuple(key) if isinstance(key, list) else key
@@ -311,15 +332,15 @@ class Group:
             if op is None:
                 return None
             idx, _, _ = self._tree()
-        self._share_down(key, result, idx)
+            members = self._members
+        self._share_down(key, result, idx, members)
         op.future.set_result(result)
         return None
 
-    def _share_down(self, key, result, idx: int):
-        n = len(self._members)
+    def _share_down(self, key, result, idx: int, members: List[str]):
+        n = len(members)
         for c in (2 * idx + 1, 2 * idx + 2):
             if c < n:
-                child = self._members[c]
                 self._rpc.async_callback(
-                    child, "__group_share", lambda r, e: None, self._name, key, result
+                    members[c], "__group_share", lambda r, e: None, self._name, key, result
                 )

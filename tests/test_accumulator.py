@@ -116,7 +116,7 @@ def test_virtual_batch_size(free_port):
         for a in accs:
             a.reduce_gradients(4, g1)
         assert pump(
-            broker, accs, 10, until=lambda: all(not a._reduction_inflight for a in accs)
+            broker, accs, 10, until=lambda: all(not a._inflight for a in accs)
         )
         assert not any(a.has_gradients() for a in accs)
         assert all(a.wants_gradients() for a in accs)
@@ -158,6 +158,47 @@ def test_late_joiner_gets_model(free_port):
             a.reduce_gradients(2, g)
         assert pump(broker, accs, 10, until=lambda: all(a.has_gradients() for a in accs))
         assert all(a.get_gradient_stats()["num_gradients"] == 3 for a in accs)
+    finally:
+        close_all(broker, accs)
+
+
+def test_parallel_gradients_pipelined(free_port):
+    """With set_parallel_gradients(2) two rounds overlap on the wire; results
+    are applied in issue order and the second is held until zero_gradients."""
+    broker, accs = make_cohort(free_port, 2)
+    try:
+        for a in accs:
+            a.set_parallel_gradients(2)
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        # peer0 contributes two rounds back-to-back; peer1 holds back its
+        # second contribution, so round 2 cannot complete yet (deterministic:
+        # allreduce needs every member).
+        first, second = accs
+        for round_val in (1.0, 5.0):
+            g = {
+                "w": np.full((2, 2), round_val, np.float32),
+                "b": np.zeros(2, np.float32),
+            }
+            first.reduce_gradients(4, g)
+        second.reduce_gradients(4, {"w": np.ones((2, 2), np.float32), "b": np.zeros(2, np.float32)})
+        # Both of peer0's slots are used (round 1 may have completed already —
+        # then has_gradients blocks; otherwise the pipeline is full).
+        assert not first.wants_gradients()
+        with pytest.raises(Exception, match="in flight|unconsumed"):
+            first.reduce_gradients(4, {"w": np.ones((2, 2), np.float32), "b": np.zeros(2, np.float32)})
+        # First round lands first, in order, on every peer.
+        assert pump(broker, accs, 10, until=lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            np.testing.assert_allclose(np.asarray(a.gradients()["w"]), 1.0)
+            a.zero_gradients()
+        # peer1 contributes its second round; peer0's was pipelined and needs
+        # no new contribution.
+        second.reduce_gradients(4, {"w": np.full((2, 2), 5.0, np.float32), "b": np.zeros(2, np.float32)})
+        assert pump(broker, accs, 10, until=lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            np.testing.assert_allclose(np.asarray(a.gradients()["w"]), 5.0)
+            a.zero_gradients()
+        assert all(a.model_version() == 2 for a in accs)
     finally:
         close_all(broker, accs)
 

@@ -162,6 +162,11 @@ def _register_jax(codec_mod) -> None:
     import jax.numpy as jnp
 
     def from_numpy(x):
+        # device_put can zero-copy alias a host numpy buffer (CPU backend);
+        # a view over the transient receive buffer must be copied to an
+        # owning array first — jax keeps THAT alive.
+        if not x.flags.owndata:
+            x = x.copy()
         return jnp.asarray(x)
 
     codec_mod.register_jax(jax.Array, to_numpy, from_numpy)

@@ -65,6 +65,13 @@ class RpcError(RuntimeError):
     """Custom exception for Rpc errors (matches reference ``RpcError``)."""
 
 
+class FrameTooLargeError(RpcError):
+    """Payload exceeds the 4 GiB wire-frame limit (u32 length prefix).
+
+    Permanent for a given payload: callers must NOT treat it as a dead
+    connection (closing + resending would flap the link forever)."""
+
+
 class Future:
     """Thread-safe future with asyncio interop, mirroring the reference's
     ``FutureWrapper`` (``src/moolib.cc:316-392``)."""
@@ -274,6 +281,8 @@ class _Connection:
         # in _adjust_leftover_buffer), which corrupts the stream under load.
         # One memcpy per frame also beats the sendmsg path on throughput.
         total = sum(_chunk_len(c) for c in chunks)
+        if total > 0xFFFFFFFF:
+            raise FrameTooLargeError(f"frame of {total} bytes exceeds the 4 GiB limit")
         buf = bytearray(4 + total)
         struct.pack_into("<I", buf, 0, total)
         off = 4
@@ -293,6 +302,37 @@ class _Connection:
                 self.writer.close()
             except Exception:
                 pass
+
+
+class _NativeConnection(_Connection):
+    """A stream owned by the native epoll engine (``native/transport.cc``).
+
+    Same duck type as ``_Connection``; frames go out through the C engine
+    (which adds the 4-byte length prefix and batches writes with writev),
+    and arrive via engine callbacks instead of an asyncio read loop.
+    """
+
+    __slots__ = ("net", "conn_id", "rpc")
+
+    def __init__(self, net, conn_id: int, transport: str, rpc, inbound: bool = False):
+        super().__init__(transport, None, None, inbound=inbound)
+        self.net = net
+        self.conn_id = conn_id
+        self.rpc = rpc
+
+    def send_frame(self, chunks: List[bytes]) -> None:
+        if sum(_chunk_len(c) for c in chunks) > 0xFFFFFFFF:
+            raise FrameTooLargeError("frame exceeds the 4 GiB limit")
+        if not self.net.send_iov(self.conn_id, chunks):
+            raise RpcError("native send failed (engine destroyed)")
+        self.send_count += 1
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self.net.close_conn(self.conn_id)
+            # Explicit closes get no engine callback; detach ourselves.
+            self.rpc._native_forget(self.conn_id)
 
 
 class _Peer:
@@ -463,12 +503,23 @@ def _batch_calls(calls):
 
 
 class Rpc:
-    """An RPC peer. See module docstring for the design."""
+    """An RPC peer. See module docstring for the design.
+
+    Concurrency model (mirrors the reference's poll-thread + fine-grained
+    locking rather than pure loop confinement): ``_state`` guards all engine
+    state (peers, outgoing, connections). With the native transport, frames
+    are processed directly on the C++ epoll thread under ``_state`` — no
+    cross-thread hop on the hot path. Futures complete *outside* ``_state``
+    (their done-callbacks take caller locks). The asyncio fallback keeps all
+    socket writes on the loop thread (asyncio transports are not
+    thread-safe), so there sends marshal onto the loop as before.
+    """
 
     def __init__(self):
         self._name = utils.create_uid()
         self._uid = utils.create_uid()
         self._timeout = _DEFAULT_TIMEOUT
+        self._state = threading.RLock()
         self._transport_order = ["ipc", "tcp"]
         self._functions: Dict[str, _FnDef] = {}
         self._peers: Dict[str, _Peer] = {}
@@ -488,6 +539,24 @@ class Rpc:
         # Warm the native codec here (user thread): first use compiles with
         # g++; doing it lazily would block the IO event loop mid-greeting.
         serialization.native_available()
+        # Native epoll IO engine (C++), with asyncio fallback. The engine owns
+        # the sockets; protocol state stays on the asyncio loop thread.
+        self._net = None
+        self._native_conns: Dict[int, _NativeConnection] = {}
+        self._connect_reqs: Dict[int, Any] = {}
+        self._connect_req_counter = itertools.count(1)
+        if os.environ.get("MOOLIB_TPU_NATIVE_TRANSPORT", "1") != "0":
+            try:
+                from ..native.transport import NativeNet
+
+                self._net = NativeNet(
+                    self._net_on_accept,
+                    self._net_on_frame,
+                    self._net_on_close,
+                    self._net_on_connect,
+                )
+            except Exception:  # noqa: BLE001 - fall back to asyncio sockets
+                self._net = None
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop_main, name="moolib-rpc", daemon=True)
         self._started = threading.Event()
@@ -516,7 +585,22 @@ class Rpc:
         if threading.current_thread() is self._thread:
             fn(*args)
         else:
-            self._loop.call_soon_threadsafe(fn, *args)
+            try:
+                self._loop.call_soon_threadsafe(fn, *args)
+            except RuntimeError:
+                pass  # loop shut down
+
+    def _spawn(self, coro_factory):
+        """Schedule a coroutine on the engine loop from any thread."""
+        if threading.current_thread() is self._thread:
+            self._loop.create_task(coro_factory())
+        else:
+            try:
+                self._loop.call_soon_threadsafe(
+                    lambda: self._loop.create_task(coro_factory())
+                )
+            except RuntimeError:
+                pass
 
     # ------------------------------------------------------------------ api
     def set_name(self, name: str) -> None:
@@ -533,6 +617,26 @@ class Rpc:
 
     def listen(self, address: str) -> None:
         kind, target = parse_address(address)
+        if self._net is not None:
+            if kind == "tcp":
+                host, port = target
+                native_host = host
+                if host not in ("", "0.0.0.0"):
+                    # The native engine binds numeric IPv4 only; resolve
+                    # hostnames here (user thread, listen is rare).
+                    import socket as _socket
+
+                    try:
+                        _socket.inet_pton(_socket.AF_INET, host)
+                    except OSError:
+                        native_host = _socket.gethostbyname(host)
+                actual_port = self._net.listen_tcp(native_host, port)
+                self._advertise_tcp(native_host, actual_port)
+            else:
+                self._net.listen_unix(target)
+                with self._state:
+                    self._listen_addrs.append(f"ipc://{target}")
+            return
         fut = concurrent.futures.Future()
 
         async def _do():
@@ -544,13 +648,7 @@ class Rpc:
                     )
                     sock = server.sockets[0]
                     actual_port = sock.getsockname()[1]
-                    if host in ("0.0.0.0", ""):
-                        # Advertise every reachable interface address so
-                        # cross-host gossip discovery works (not just loopback).
-                        for adv in _local_addresses():
-                            self._listen_addrs.append(f"tcp://{adv}:{actual_port}")
-                    else:
-                        self._listen_addrs.append(f"tcp://{host}:{actual_port}")
+                    self._advertise_tcp(host, actual_port)
                 else:
                     path = target
                     try:
@@ -568,6 +666,16 @@ class Rpc:
 
         asyncio.run_coroutine_threadsafe(_do(), self._loop)
         fut.result(10)
+
+    def _advertise_tcp(self, host: str, actual_port: int) -> None:
+        with self._state:
+            if host in ("0.0.0.0", ""):
+                # Advertise every reachable interface address so cross-host
+                # gossip discovery works (not just loopback).
+                for adv in _local_addresses():
+                    self._listen_addrs.append(f"tcp://{adv}:{actual_port}")
+            else:
+                self._listen_addrs.append(f"tcp://{host}:{actual_port}")
 
     def connect(self, address: str) -> None:
         """Connect to an address; the connection is kept alive (reconnects)."""
@@ -620,6 +728,10 @@ class Rpc:
         return self.async_(peer_name, fn_name, *args, **kwargs).result()
 
     def debug_info(self) -> str:
+        with self._state:
+            return self._debug_info_locked()
+
+    def _debug_info_locked(self) -> str:
         lines = [f"Rpc {self._name} (uid {self._uid}) listen={self._listen_addrs}"]
         for p in self._peers.values():
             lines.append(f"  peer {p.name} uid={p.uid} addrs={p.addresses}")
@@ -649,6 +761,15 @@ class Rpc:
             self._thread.join(timeout=5)
         except Exception:
             pass
+        if self._net is not None:
+            # After the loop stops nothing sends; joining the epoll thread
+            # here guarantees no callback fires into a dead Rpc. (ctypes
+            # releases the GIL during the call, so an in-flight callback can
+            # finish.)
+            try:
+                self._net.destroy()
+            except Exception:
+                pass
         self._executor.shutdown(wait=False)
 
     def __del__(self):  # pragma: no cover - best effort
@@ -673,24 +794,43 @@ class Rpc:
 
         def _done(fut: Future):
             # Completed (incl. user cancel): drop the resend buffer promptly.
-            self._call_in_loop(self._outgoing.pop, rid, None)
+            with self._state:
+                self._outgoing.pop(rid, None)
 
         future.add_done_callback(_done)
 
+        if self._net is not None:
+            # Native engine: sends are thread-safe; register + send inline.
+            with self._state:
+                if not future.done():
+                    self._outgoing[rid] = out
+                    self._try_send(out)
+            return
+
         def _do():
-            if not future.done():
-                self._outgoing[rid] = out
-                self._try_send(out)
+            with self._state:
+                if not future.done():
+                    self._outgoing[rid] = out
+                    self._try_send(out)
 
         self._call_in_loop(_do)
 
     def _try_send(self, out: _Outgoing):
+        # Caller holds self._state.
         peer = self._peers.get(out.peer_name)
         conn = peer.best_connection(self._transport_order) if peer else None
         if conn is not None:
             try:
                 conn.send_frame(self._chunks_for(peer, out))
                 out.sent_at = time.monotonic()
+                return
+            except FrameTooLargeError as e:
+                # Permanent for this payload — fail the call; closing the
+                # (healthy) connection and resending would flap forever.
+                # Complete off-thread: we hold _state here.
+                with self._state:
+                    self._outgoing.pop(out.rid, None)
+                self._executor.submit(out.future.set_exception, RpcError(str(e)))
                 return
             except Exception:
                 conn.close()
@@ -700,7 +840,7 @@ class Rpc:
         if not out.parked:
             out.parked = True
             peer.pending.append(out)
-        self._loop.create_task(self._find_peer(peer))
+        self._spawn(lambda peer=peer: self._find_peer(peer))
 
     def _chunks_for(self, peer: _Peer, out: _Outgoing) -> List[bytes]:
         """Codec negotiation: if the peer can't decode native payloads,
@@ -721,10 +861,13 @@ class Rpc:
         try:
             # Try known addresses first, then gossip through connected peers
             # (reference reqLookingForPeer, src/rpc.cc:2332-2433).
-            for addr in list(peer.addresses):
+            with self._state:
+                addrs = list(peer.addresses)
+            for addr in addrs:
                 if await self._connect_once(addr):
                     return
-            others = [p for p in self._peers.values() if p is not peer and p.connections]
+            with self._state:
+                others = [p for p in self._peers.values() if p is not peer and p.connections]
             if others:
                 sample = random.sample(others, min(len(others), max(2, int(len(others) ** 0.5))))
                 for other in sample:
@@ -736,12 +879,11 @@ class Rpc:
                         except Exception:
                             return
                         if addrs:
-                            def _upd():
+                            with self._state:
                                 for a in addrs:
                                     if a not in peer.addresses:
                                         peer.addresses.append(a)
-                                self._loop.create_task(self._retry_connect(peer))
-                            self._call_in_loop(_upd)
+                            self._spawn(lambda peer=peer: self._retry_connect(peer))
 
                     f.add_done_callback(_found)
         finally:
@@ -753,7 +895,9 @@ class Rpc:
                 return
             await self._connect_once(addr)
 
-    async def _connect_once(self, address: str) -> bool:
+    async def _connect_once(self, address: str, explicit_addr: Optional[str] = None) -> bool:
+        if self._net is not None:
+            return await self._native_connect(address, explicit_addr)
         try:
             kind, target = parse_address(address)
             if kind == "tcp":
@@ -764,6 +908,9 @@ class Rpc:
         except Exception:
             return False
         conn = _Connection(kind, reader, writer)
+        if explicit_addr is not None:
+            # Tag so the reconnect task can see whether its address is live.
+            conn._explicit_addr = explicit_addr
         self._conns.append(conn)
         self._send_greeting(conn)
         self._loop.create_task(self._read_loop(conn))
@@ -778,28 +925,109 @@ class Rpc:
                 if getattr(c, "_explicit_addr", None) == address
             )
             if not have:
-                ok = await self._connect_once_explicit(address)
+                ok = await self._connect_once(address, explicit_addr=address)
                 backoff = 0.5 if ok else min(backoff * 2, 4.0)
             await asyncio.sleep(backoff)
 
-    async def _connect_once_explicit(self, address: str) -> bool:
+    # ------------------------------------------------- native engine plumbing
+    async def _native_connect(self, address: str, explicit_addr: Optional[str]) -> bool:
         try:
             kind, target = parse_address(address)
-            if kind == "tcp":
-                host, port = target
-                reader, writer = await asyncio.open_connection(host, port)
-            else:
-                reader, writer = await asyncio.open_unix_connection(target)
         except Exception:
             return False
-        conn = _Connection(kind, reader, writer)
-        conn_explicit_addr = address
-        # Tag so the reconnect task can see whether its address is still live.
-        conn._explicit_addr = conn_explicit_addr  # type: ignore[attr-defined]
-        self._conns.append(conn)
-        self._send_greeting(conn)
-        self._loop.create_task(self._read_loop(conn))
-        return True
+        if kind == "tcp":
+            host, port = target
+            host = await self._resolve_host(host)
+            if host is None:
+                return False
+        req_id = next(self._connect_req_counter)
+        af = self._loop.create_future()
+        with self._state:
+            self._connect_reqs[req_id] = (af, kind, explicit_addr)
+        if kind == "tcp":
+            self._net.connect_tcp(req_id, host, port)
+        else:
+            self._net.connect_unix(req_id, target)
+        return await af
+
+    async def _resolve_host(self, host: str) -> Optional[str]:
+        """Resolve a hostname to a numeric address off the IO threads (the
+        native engine only dials numeric addresses — blocking getaddrinfo on
+        its epoll thread would stall every connection)."""
+        import socket as _socket
+
+        try:
+            _socket.inet_pton(_socket.AF_INET, host)
+            return host  # already numeric
+        except OSError:
+            pass
+        try:
+            infos = await self._loop.getaddrinfo(host, None, type=_socket.SOCK_STREAM)
+        except OSError:
+            return None
+        for family, _, _, _, sockaddr in infos:
+            if family == _socket.AF_INET:
+                return sockaddr[0]
+        return infos[0][4][0] if infos else None
+
+    # The _net_on_* callbacks run on the C++ epoll thread and process frames
+    # right there under _state — no cross-thread hop on the hot path (the
+    # reference handles messages on its poll thread the same way). The frame
+    # is a ZERO-COPY view into the engine's receive buffer, valid only until
+    # the callback returns: every deserialize path copies array/bytes leaves
+    # during materialization, and nothing may retain `frame` (or slices of
+    # it) past the callback.
+    def _net_on_accept(self, conn_id: int, transport: str):
+        with self._state:
+            conn = _NativeConnection(self._net, conn_id, transport, self, inbound=True)
+            self._native_conns[conn_id] = conn
+            self._conns.append(conn)
+            self._send_greeting(conn)
+
+    def _net_on_frame(self, conn_id: int, frame: bytes):
+        with self._state:
+            conn = self._native_conns.get(conn_id)
+            if conn is None or conn.closed:
+                return
+            conn.recv_count += 1
+            conn.last_recv = time.monotonic()
+        self._on_frame(conn, frame)
+
+    def _net_on_close(self, conn_id: int):
+        with self._state:
+            conn = self._native_conns.pop(conn_id, None)
+            if conn is None:
+                return
+            conn.closed = True
+            self._detach_conn(conn)
+
+    def _native_forget(self, conn_id: int):
+        with self._state:
+            conn = self._native_conns.pop(conn_id, None)
+            if conn is not None:
+                self._detach_conn(conn)
+
+    def _net_on_connect(self, req_id: int, conn_id: int):
+        # Register the connection synchronously: the peer's greeting can race
+        # through the epoll thread the moment the connect resolves, and it
+        # must find the connection registered.
+        with self._state:
+            entry = self._connect_reqs.pop(req_id, None)
+            if entry is None:
+                if conn_id >= 0:
+                    self._net.close_conn(conn_id)
+                return
+            af, kind, explicit_addr = entry
+            ok = conn_id >= 0
+            if ok:
+                conn = _NativeConnection(self._net, conn_id, kind, self)
+                if explicit_addr is not None:
+                    conn._explicit_addr = explicit_addr
+                self._native_conns[conn_id] = conn
+                self._conns.append(conn)
+                self._send_greeting(conn)
+        # The awaiting coroutine lives on the loop: complete its future there.
+        self._call_in_loop(_set_async_result, af, ok)
 
     def _send_greeting(self, conn: _Connection):
         # Greetings always use the portable pickle codec: they must parse
@@ -840,12 +1068,13 @@ class Rpc:
             self._detach_conn(conn)
 
     def _detach_conn(self, conn: _Connection):
-        if conn in self._conns:
-            self._conns.remove(conn)
-        if conn.peer_name is not None:
-            peer = self._peers.get(conn.peer_name)
-            if peer is not None and peer.connections.get(conn.transport) is conn:
-                del peer.connections[conn.transport]
+        with self._state:
+            if conn in self._conns:
+                self._conns.remove(conn)
+            if conn.peer_name is not None:
+                peer = self._peers.get(conn.peer_name)
+                if peer is not None and peer.connections.get(conn.transport) is conn:
+                    del peer.connections[conn.transport]
 
     def _on_frame(self, conn: _Connection, frame: bytes):
         kind = frame[0]
@@ -870,6 +1099,10 @@ class Rpc:
         if uid == self._uid:
             conn.close()  # self-connection (reference src/rpc.cc:2209-2224)
             return
+        with self._state:
+            self._on_greeting_locked(conn, info, name, uid)
+
+    def _on_greeting_locked(self, conn: _Connection, info, name: str, uid: str):
         conn.peer_name = name
         conn.peer_uid = uid
         peer = self._peers.setdefault(name, _Peer(name))
@@ -912,57 +1145,71 @@ class Rpc:
     def _on_request(self, conn: _Connection, frame: bytes):
         rid, sender_timeout, fnlen = struct.unpack_from("<QIH", frame, 1)
         off = 1 + 8 + 4 + 2
-        fn_name = frame[off : off + fnlen].decode()
+        fn_name = bytes(frame[off : off + fnlen]).decode()
         off += fnlen
         # At-most-once window must outlive every possible resend by this
         # sender: size it from the *sender's* call timeout, not ours.
         dedup_ttl = max(2.0 * sender_timeout, 120.0)
-        peer = self._peers.get(conn.peer_name) if conn.peer_name else None
-        if peer is not None:
-            cached = peer.recent.get(rid)
-            if cached is not None:
-                try:
-                    conn.send_frame(cached[1])
-                except Exception:
-                    conn.close()
-                return
-            if rid in peer.executing:
-                return  # duplicate while still executing; response will go out
-            peer.executing.add(rid)
+        with self._state:
+            peer = self._peers.get(conn.peer_name) if conn.peer_name else None
+            if peer is not None:
+                cached = peer.recent.get(rid)
+                if cached is not None:
+                    try:
+                        conn.send_frame(cached[1])
+                    except Exception:
+                        conn.close()
+                    return
+                if rid in peer.executing:
+                    return  # duplicate while executing; response will go out
+                peer.executing.add(rid)
 
         def respond(value, error: Optional[str]):
-            def _send():
-                ser_fn = (
-                    serialization.serialize
-                    if (peer is None or peer.native_ok)
-                    else serialization._py_serialize
-                )
-                try:
-                    if error is not None:
-                        body = serialization.pack(ser_fn(error))
-                        chunks = [struct.pack("<BQ", KIND_ERROR, rid)] + body
-                    else:
-                        body = serialization.pack(ser_fn(value))
-                        chunks = [struct.pack("<BQ", KIND_RESPONSE, rid)] + body
-                except Exception as e:  # noqa: BLE001
-                    body = serialization.pack(
-                        serialization._py_serialize(f"response serialization error: {e}")
-                    )
+            # Serialize outside the state lock (can be large); then publish
+            # the dedup entry and send under it.
+            ser_fn = (
+                serialization.serialize
+                if (peer is None or peer.native_ok)
+                else serialization._py_serialize
+            )
+            try:
+                if error is not None:
+                    body = serialization.pack(ser_fn(error))
                     chunks = [struct.pack("<BQ", KIND_ERROR, rid)] + body
-                if peer is not None:
-                    peer.executing.discard(rid)
-                    peer.recent[rid] = (time.monotonic(), chunks, dedup_ttl)
-                # Respond over the best currently-alive connection to the peer;
-                # fall back to the connection the request came in on.
-                target = peer.best_connection(self._transport_order) if peer else None
-                if target is None or target.closed:
-                    target = conn
-                try:
-                    target.send_frame(chunks)
-                except Exception:
-                    target.close()
+                else:
+                    body = serialization.pack(ser_fn(value))
+                    chunks = [struct.pack("<BQ", KIND_RESPONSE, rid)] + body
+            except Exception as e:  # noqa: BLE001
+                body = serialization.pack(
+                    serialization._py_serialize(f"response serialization error: {e}")
+                )
+                chunks = [struct.pack("<BQ", KIND_ERROR, rid)] + body
 
-            self._call_in_loop(_send)
+            def _send():
+                with self._state:
+                    if peer is not None:
+                        peer.executing.discard(rid)
+                        peer.recent[rid] = (time.monotonic(), chunks, dedup_ttl)
+                    # Respond over the best currently-alive connection to the
+                    # peer; fall back to the one the request came in on.
+                    target = peer.best_connection(self._transport_order) if peer else None
+                    if target is None or target.closed:
+                        target = conn
+                    try:
+                        target.send_frame(chunks)
+                    except FrameTooLargeError:
+                        # Drop the response (caller times out); the link is
+                        # healthy and must not be closed.
+                        utils.log_error(
+                            "rpc: response for rid %s exceeds the frame limit", rid
+                        )
+                    except Exception:
+                        target.close()
+
+            if self._net is not None:
+                _send()  # native sends are thread-safe
+            else:
+                self._call_in_loop(_send)
 
         fdef = self._functions.get(fn_name)
         if fdef is None:
@@ -1020,7 +1267,9 @@ class Rpc:
                 except Exception:  # noqa: BLE001
                     respond(None, f"exception in {fdef.name!r}: {traceback.format_exc()}")
 
-            self._loop.create_task(run_async())
+            # May be reached from the epoll thread (native transport):
+            # _spawn marshals task creation onto the loop thread.
+            self._spawn(run_async)
             return
 
         def run_plain():
@@ -1033,13 +1282,18 @@ class Rpc:
 
     def _on_response(self, conn: _Connection, frame: bytes, is_error: bool):
         (rid,) = struct.unpack_from("<Q", frame, 1)
-        out = self._outgoing.pop(rid, None)
-        if out is None:
-            return  # late/duplicate response
-        if not out.resent:
-            # Resent requests give ambiguous RTTs (which send did this answer?)
-            rtt = time.monotonic() - out.sent_at
-            conn.latency = rtt if conn.latency is None else conn.latency * 0.9 + rtt * 0.1
+        with self._state:
+            out = self._outgoing.pop(rid, None)
+            if out is None:
+                return  # late/duplicate response
+            if not out.resent:
+                # Resent requests give ambiguous RTTs (which send answered?)
+                rtt = time.monotonic() - out.sent_at
+                conn.latency = (
+                    rtt if conn.latency is None else conn.latency * 0.9 + rtt * 0.1
+                )
+        # Deserialize + complete outside the lock: payloads can be large and
+        # future done-callbacks take caller locks.
         try:
             value = serialization.deserialize(serialization.unpack(frame, 9))
         except Exception as e:  # noqa: BLE001
@@ -1055,41 +1309,47 @@ class Rpc:
         while not self._closed:
             await asyncio.sleep(0.25)
             now = time.monotonic()
-            expired = [o for o in self._outgoing.values() if now >= o.deadline]
+            with self._state:
+                expired = [o for o in self._outgoing.values() if now >= o.deadline]
+                for out in expired:
+                    self._outgoing.pop(out.rid, None)
+            # Complete outside the lock (done-callbacks take caller locks).
             for out in expired:
-                self._outgoing.pop(out.rid, None)
                 out.future.set_exception(
                     RpcError(f"Call ({out.peer_name}::{out.fn_name}) timed out")
                 )
-            # Periodic resend of stale outstanding requests (the analogue of
-            # the reference's poke/nack cycle, src/rpc.cc:2526-2703): a
-            # response can die on a half-dead socket after our greeting-time
-            # resend; receiver dedup returns the cached response.
-            for out in list(self._outgoing.values()):
-                if now - out.sent_at > 3.0:
-                    out.resent = True  # RTT from this rid is no longer a sample
-                    self._try_send(out)
-                    out.sent_at = now
-            # Prune dead entries from pending queues (their futures already
-            # timed out); park flags reset so nothing leaks against a peer
-            # that never comes back.
-            for peer in self._peers.values():
-                if peer.pending:
-                    peer.pending = [
-                        o for o in peer.pending if o.rid in self._outgoing
-                    ]
-            # Retry unsent/parked requests whose peers got connected meanwhile,
-            # and resend periodically (at-most-once holds via receiver dedup).
-            # Dedup entries carry their own TTL (derived from each sender's
-            # call timeout at request time).
-            for peer in self._peers.values():
+            hunts = []
+            with self._state:
+                # Periodic resend of stale outstanding requests (the analogue
+                # of the reference's poke/nack cycle, src/rpc.cc:2526-2703): a
+                # response can die on a half-dead socket after our
+                # greeting-time resend; receiver dedup returns the cached
+                # response.
+                for out in list(self._outgoing.values()):
+                    if now - out.sent_at > 3.0:
+                        out.resent = True  # RTT no longer a clean sample
+                        self._try_send(out)
+                        out.sent_at = now
+                # Prune dead entries from pending queues (their futures
+                # already timed out); park flags reset so nothing leaks
+                # against a peer that never comes back.
+                for peer in self._peers.values():
+                    if peer.pending:
+                        peer.pending = [
+                            o for o in peer.pending if o.rid in self._outgoing
+                        ]
+                # Dedup entries carry their own TTL (derived from each
+                # sender's call timeout at request time).
                 now2 = time.monotonic()
-                peer.recent = {
-                    rid: v for rid, v in peer.recent.items() if now2 - v[0] < v[2]
-                }
-                # Keep hunting for peers with parked requests.
-                if peer.pending and not peer.connections:
-                    self._loop.create_task(self._find_peer(peer))
+                for peer in self._peers.values():
+                    peer.recent = {
+                        rid: v for rid, v in peer.recent.items() if now2 - v[0] < v[2]
+                    }
+                    # Keep hunting for peers with parked requests.
+                    if peer.pending and not peer.connections:
+                        hunts.append(peer)
+            for peer in hunts:
+                self._loop.create_task(self._find_peer(peer))
 
     def _find_peer_handler(self, target: str):
         peer = self._peers.get(target)

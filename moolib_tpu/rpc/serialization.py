@@ -128,7 +128,10 @@ def _materialize(ref: ArrayRef):
     if ref.kind == "jax":
         import jax.numpy as jnp
 
-        return jnp.asarray(arr)
+        # Copy off the (transient) receive buffer before device_put: jax can
+        # zero-copy alias host numpy buffers and keeps only the array object
+        # alive, not the buffer beneath a frombuffer view.
+        return jnp.asarray(arr.copy())
     # np.frombuffer gives a read-only view over the receive buffer; copy so
     # callers can mutate (the receive buffer is also about to be recycled).
     return arr.copy()

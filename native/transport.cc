@@ -1,0 +1,638 @@
+// Native socket transport: epoll event loop, TCP + Unix-domain streams,
+// length-prefixed frame protocol.
+//
+// TPU-native counterpart of the reference's socket layer
+// (src/transports/socket.{h,cc}: singleton PollThread with epoll, writev
+// scatter-gather, non-blocking accept/connect) and its ipc framing
+// (src/transports/ipc.cc). Re-designed, not translated: one engine instance
+// per Rpc, level-triggered epoll, a command ring woken by eventfd so any
+// thread can send/connect/close, and frames delivered whole to a single
+// callback (the Python engine keeps all protocol state on its own thread).
+//
+// Frame wire format matches the Python asyncio backend exactly
+// (moolib_tpu/rpc/core.py): 4-byte little-endian length + payload, so native
+// and asyncio peers interoperate frame-for-frame.
+//
+// C API only (ctypes binding; the image has no pybind11).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// Frame size cap = what the 4-byte length prefix can carry (parity with the
+// asyncio backend's u32 framing).
+constexpr uint64_t kMaxFrame = 0xFFFFFFFFull;
+constexpr size_t kReadChunk = 1024 * 1024;
+constexpr int kSockBuf = 4 * 1024 * 1024;  // loopback/DCN throughput
+
+typedef void (*accept_cb_t)(void* ud, int64_t conn_id, const char* transport);
+typedef void (*frame_cb_t)(void* ud, int64_t conn_id, const uint8_t* data,
+                           uint64_t len);
+typedef void (*close_cb_t)(void* ud, int64_t conn_id);
+typedef void (*connect_cb_t)(void* ud, int64_t req_id, int64_t conn_id);
+typedef void (*release_cb_t)(void* ud, int64_t token);
+
+// One outbound segment: either owned bytes (small chunks, coalesced) or a
+// borrowed buffer the caller pins until `token` is released (zero-copy send
+// of large arrays — the analogue of the reference's per-tensor iovecs).
+struct Seg {
+  std::string owned;
+  const uint8_t* ext = nullptr;
+  size_t ext_len = 0;
+  int64_t token = 0;  // nonzero on a frame's last segment: release when sent
+  const uint8_t* data() const {
+    return ext ? ext : reinterpret_cast<const uint8_t*>(owned.data());
+  }
+  size_t size() const { return ext ? ext_len : owned.size(); }
+};
+
+struct Conn {
+  int fd = -1;
+  int64_t id = 0;
+  bool connecting = false;   // non-blocking connect in flight
+  int64_t connect_req = 0;   // req_id to report when connect resolves
+  bool is_tcp = true;
+  bool closed = false;
+  bool want_write = false;
+  // Inbound reassembly buffer: [consumed, size) is live data.
+  std::vector<uint8_t> rd;
+  size_t consumed = 0;
+  // Outbound queue of segments; the first may be partially written (`sent`).
+  std::deque<Seg> outq;
+  size_t sent = 0;
+};
+
+struct Cmd {
+  enum Kind { kSend, kConnectTcp, kConnectUnix, kCloseConn, kStop } kind;
+  int64_t id = 0;      // conn id (kSend/kCloseConn) or req id (kConnect*)
+  std::string data;    // host/path (kConnect*)
+  std::vector<Seg> segs;  // frame segments (kSend)
+  int64_t token = 0;      // release token (kSend; 0 = none)
+  int port = 0;
+};
+
+struct Engine {
+  int epfd = -1;
+  int evfd = -1;
+  std::atomic<bool> stopping{false};
+  std::thread thread;
+
+  accept_cb_t on_accept;
+  frame_cb_t on_frame;
+  close_cb_t on_close;
+  connect_cb_t on_connect;
+  release_cb_t on_release;  // may be null
+  void* ud;
+
+  void release(int64_t token) {
+    if (token != 0 && on_release) on_release(ud, token);
+  }
+
+  std::mutex cmd_mu;
+  std::deque<Cmd> cmds;
+
+  std::atomic<int64_t> next_id{1};
+  // Touched only on the epoll thread:
+  std::unordered_map<int64_t, Conn*> conns;
+  std::unordered_map<int, Conn*> by_fd;
+  std::vector<int> listeners;            // listening fds
+  std::unordered_map<int, bool> lis_tcp; // listener fd -> is_tcp
+
+  void wake() {
+    uint64_t one = 1;
+    ssize_t r = write(evfd, &one, sizeof one);
+    (void)r;
+  }
+  void push(Cmd c) {
+    {
+      std::lock_guard<std::mutex> g(cmd_mu);
+      cmds.push_back(std::move(c));
+    }
+    wake();
+  }
+};
+
+void set_nonblock(int fd) { fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK); }
+
+void epoll_update(Engine* e, Conn* c, bool add) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->want_write || c->connecting ? EPOLLOUT : 0);
+  ev.data.fd = c->fd;
+  epoll_ctl(e->epfd, add ? EPOLL_CTL_ADD : EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void destroy_conn(Engine* e, Conn* c, bool notify) {
+  if (c->closed) return;
+  c->closed = true;
+  epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  e->by_fd.erase(c->fd);
+  e->conns.erase(c->id);
+  // Unpin every undelivered zero-copy buffer.
+  for (Seg& s : c->outq) e->release(s.token);
+  c->outq.clear();
+  if (notify && !e->stopping.load()) {
+    if (c->connecting)
+      e->on_connect(e->ud, c->connect_req, -1);
+    else
+      e->on_close(e->ud, c->id);
+  }
+  delete c;
+}
+
+Conn* add_conn(Engine* e, int fd, bool is_tcp) {
+  set_nonblock(fd);
+  if (is_tcp) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  }
+  int sz = kSockBuf;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof sz);
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof sz);
+  Conn* c = new Conn();
+  c->fd = fd;
+  c->id = e->next_id.fetch_add(1);
+  c->is_tcp = is_tcp;
+  e->conns[c->id] = c;
+  e->by_fd[fd] = c;
+  epoll_update(e, c, /*add=*/true);
+  return c;
+}
+
+// Flush as much of the out-queue as the socket accepts (writev batching —
+// the reference's scatter-gather send, src/transports/socket.cc).
+void flush_out(Engine* e, Conn* c) {
+  while (!c->outq.empty()) {
+    iovec iov[16];
+    int n = 0;
+    size_t skip = c->sent;
+    for (auto it = c->outq.begin(); it != c->outq.end() && n < 16; ++it) {
+      iov[n].iov_base = const_cast<uint8_t*>(it->data()) + skip;
+      iov[n].iov_len = it->size() - skip;
+      skip = 0;
+      ++n;
+    }
+    ssize_t w = writev(c->fd, iov, n);
+    if (w < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      destroy_conn(e, c, true);
+      return;
+    }
+    size_t left = static_cast<size_t>(w);
+    while (left > 0 && !c->outq.empty()) {
+      Seg& front = c->outq.front();
+      size_t avail = front.size() - c->sent;
+      if (left >= avail) {
+        left -= avail;
+        c->sent = 0;
+        e->release(front.token);  // frame fully on the wire: unpin
+        c->outq.pop_front();
+      } else {
+        c->sent += left;
+        left = 0;
+      }
+    }
+  }
+  bool want = !c->outq.empty();
+  if (want != c->want_write) {
+    c->want_write = want;
+    epoll_update(e, c, false);
+  }
+}
+
+void handle_readable(Engine* e, Conn* c) {
+  for (;;) {
+    size_t old = c->rd.size();
+    c->rd.resize(old + kReadChunk);
+    ssize_t r = read(c->fd, c->rd.data() + old, kReadChunk);
+    if (r < 0) {
+      c->rd.resize(old);
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      destroy_conn(e, c, true);
+      return;
+    }
+    if (r == 0) {
+      c->rd.resize(old);
+      destroy_conn(e, c, true);
+      return;
+    }
+    c->rd.resize(old + static_cast<size_t>(r));
+    // Deliver every complete frame in the buffer.
+    for (;;) {
+      size_t have = c->rd.size() - c->consumed;
+      if (have < 4) break;
+      const uint8_t* p = c->rd.data() + c->consumed;
+      uint32_t len = static_cast<uint32_t>(p[0]) | (uint32_t)p[1] << 8 |
+                     (uint32_t)p[2] << 16 | (uint32_t)p[3] << 24;
+      if (len > kMaxFrame) {
+        destroy_conn(e, c, true);
+        return;
+      }
+      if (have < 4 + static_cast<size_t>(len)) break;
+      if (!e->stopping.load()) e->on_frame(e->ud, c->id, p + 4, len);
+      c->consumed += 4 + static_cast<size_t>(len);
+      // The callback may have issued a close for this conn; it is routed
+      // through the command queue, so `c` stays valid here.
+    }
+    if (c->consumed == c->rd.size()) {
+      c->rd.clear();
+      c->consumed = 0;
+    } else if (c->consumed > (1u << 20) && c->consumed > c->rd.size() / 2) {
+      c->rd.erase(c->rd.begin(), c->rd.begin() + c->consumed);
+      c->consumed = 0;
+    }
+    if (static_cast<size_t>(r) < kReadChunk) break;  // drained the socket
+  }
+}
+
+void handle_accept(Engine* e, int lfd, bool is_tcp) {
+  for (;;) {
+    int fd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) return;  // EAGAIN or error: done for now
+    Conn* c = add_conn(e, fd, is_tcp);
+    if (!e->stopping.load())
+      e->on_accept(e->ud, c->id, is_tcp ? "tcp" : "ipc");
+  }
+}
+
+void run_cmds(Engine* e) {
+  std::deque<Cmd> batch;
+  {
+    std::lock_guard<std::mutex> g(e->cmd_mu);
+    batch.swap(e->cmds);
+  }
+  for (Cmd& cmd : batch) {
+    switch (cmd.kind) {
+      case Cmd::kSend: {
+        auto it = e->conns.find(cmd.id);
+        if (it == e->conns.end()) {
+          // Already closed: the pinned buffers must still be released.
+          e->release(cmd.token);
+          break;
+        }
+        Conn* c = it->second;
+        for (Seg& s : cmd.segs) c->outq.push_back(std::move(s));
+        if (!c->connecting) flush_out(e, c);  // else: flush after connect
+        break;
+      }
+      case Cmd::kConnectTcp: {
+        // Numeric addresses only (AI_NUMERICHOST): hostname resolution would
+        // block the IO thread — the Python binding resolves names first.
+        addrinfo hints{};
+        hints.ai_family = AF_UNSPEC;
+        hints.ai_socktype = SOCK_STREAM;
+        hints.ai_flags = AI_NUMERICHOST;
+        addrinfo* res = nullptr;
+        char portbuf[16];
+        snprintf(portbuf, sizeof portbuf, "%d", cmd.port);
+        if (getaddrinfo(cmd.data.c_str(), portbuf, &hints, &res) != 0 || !res) {
+          if (!e->stopping.load()) e->on_connect(e->ud, cmd.id, -1);
+          break;
+        }
+        int fd = socket(res->ai_family, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC,
+                        0);
+        if (fd < 0) {
+          freeaddrinfo(res);
+          if (!e->stopping.load()) e->on_connect(e->ud, cmd.id, -1);
+          break;
+        }
+        int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+        freeaddrinfo(res);
+        if (rc == 0 || errno == EINPROGRESS) {
+          Conn* c = add_conn(e, fd, true);
+          c->connecting = true;
+          c->connect_req = cmd.id;
+          epoll_update(e, c, false);  // arm EPOLLOUT for connect completion
+        } else {
+          close(fd);
+          if (!e->stopping.load()) e->on_connect(e->ud, cmd.id, -1);
+        }
+        break;
+      }
+      case Cmd::kConnectUnix: {
+        int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+        sockaddr_un sa{};
+        sa.sun_family = AF_UNIX;
+        strncpy(sa.sun_path, cmd.data.c_str(), sizeof(sa.sun_path) - 1);
+        int rc = fd < 0 ? -1 : connect(fd, (sockaddr*)&sa, sizeof sa);
+        if (fd >= 0 && (rc == 0 || errno == EINPROGRESS)) {
+          Conn* c = add_conn(e, fd, false);
+          c->connecting = true;
+          c->connect_req = cmd.id;
+          epoll_update(e, c, false);
+        } else {
+          if (fd >= 0) close(fd);
+          if (!e->stopping.load()) e->on_connect(e->ud, cmd.id, -1);
+        }
+        break;
+      }
+      case Cmd::kCloseConn: {
+        auto it = e->conns.find(cmd.id);
+        if (it != e->conns.end()) destroy_conn(e, it->second, false);
+        break;
+      }
+      case Cmd::kStop:
+        e->stopping.store(true);
+        break;
+    }
+  }
+}
+
+void resolve_connect(Engine* e, Conn* c) {
+  int err = 0;
+  socklen_t len = sizeof err;
+  getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &len);
+  int64_t req = c->connect_req;
+  if (err != 0) {
+    c->connecting = false;  // report as a failed connect, not a close
+    epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    e->by_fd.erase(c->fd);
+    e->conns.erase(c->id);
+    delete c;
+    if (!e->stopping.load()) e->on_connect(e->ud, req, -1);
+    return;
+  }
+  c->connecting = false;
+  epoll_update(e, c, false);
+  if (!e->stopping.load()) e->on_connect(e->ud, req, c->id);
+  flush_out(e, c);  // anything queued while connecting
+}
+
+void loop(Engine* e) {
+  epoll_event evs[64];
+  while (!e->stopping.load()) {
+    int n = epoll_wait(e->epfd, evs, 64, 500);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; ++i) {
+      int fd = evs[i].data.fd;
+      uint32_t mask = evs[i].events;
+      if (fd == e->evfd) {
+        uint64_t buf;
+        ssize_t r = read(e->evfd, &buf, sizeof buf);
+        (void)r;
+        run_cmds(e);
+        continue;
+      }
+      if (e->lis_tcp.count(fd)) {
+        handle_accept(e, fd, e->lis_tcp[fd]);
+        continue;
+      }
+      auto it = e->by_fd.find(fd);
+      if (it == e->by_fd.end()) continue;
+      Conn* c = it->second;
+      if (c->connecting) {
+        if (mask & (EPOLLOUT | EPOLLERR | EPOLLHUP)) resolve_connect(e, c);
+        continue;
+      }
+      if (mask & (EPOLLERR | EPOLLHUP)) {
+        // Drain pending inbound bytes first (peer may have sent then closed).
+        handle_readable(e, c);
+        auto again = e->by_fd.find(fd);
+        if (again != e->by_fd.end()) destroy_conn(e, again->second, true);
+        continue;
+      }
+      if (mask & EPOLLIN) {
+        handle_readable(e, c);
+        if (e->by_fd.find(fd) == e->by_fd.end()) continue;  // closed in read
+      }
+      if (mask & EPOLLOUT) flush_out(e, c);
+    }
+    // Commands can also arrive between wakeups (e.g. posted right before a
+    // timeout-driven iteration).
+    run_cmds(e);
+  }
+  // Teardown on the loop thread: unpin everything still queued; the release
+  // callback is the one callback that still fires while stopping (the owner
+  // must not leak pinned buffers).
+  for (auto& kv : e->conns) {
+    for (Seg& s : kv.second->outq) e->release(s.token);
+    close(kv.second->fd);
+    delete kv.second;
+  }
+  {
+    std::lock_guard<std::mutex> g(e->cmd_mu);
+    for (Cmd& cmd : e->cmds)
+      if (cmd.kind == Cmd::kSend) e->release(cmd.token);
+    e->cmds.clear();
+  }
+  e->conns.clear();
+  e->by_fd.clear();
+  for (int lfd : e->listeners) close(lfd);
+  e->listeners.clear();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* moolib_net_create(accept_cb_t acb, frame_cb_t fcb, close_cb_t ccb,
+                        connect_cb_t ncb, release_cb_t rcb, void* ud) {
+  Engine* e = new Engine();
+  e->on_accept = acb;
+  e->on_frame = fcb;
+  e->on_close = ccb;
+  e->on_connect = ncb;
+  e->on_release = rcb;
+  e->ud = ud;
+  e->epfd = epoll_create1(EPOLL_CLOEXEC);
+  e->evfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  if (e->epfd < 0 || e->evfd < 0) {
+    if (e->epfd >= 0) close(e->epfd);
+    if (e->evfd >= 0) close(e->evfd);
+    delete e;
+    return nullptr;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = e->evfd;
+  epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->evfd, &ev);
+  e->thread = std::thread(loop, e);
+  return e;
+}
+
+// Listen on host:port; returns the bound port, or -1. Called before the
+// engine handles traffic for this socket, but the epoll thread is already
+// running: registration order is safe because listeners are only read on
+// the epoll thread after the epoll_ctl ADD below publishes the fd, and
+// lis_tcp is written before that ADD.
+int moolib_net_listen_tcp(void* ctx, const char* host, int port) {
+  Engine* e = static_cast<Engine*>(ctx);
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (!host || !*host || strcmp(host, "0.0.0.0") == 0) {
+    sa.sin_addr.s_addr = INADDR_ANY;
+  } else if (inet_pton(AF_INET, host, &sa.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (bind(fd, (sockaddr*)&sa, sizeof sa) != 0 || listen(fd, 128) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t slen = sizeof sa;
+  getsockname(fd, (sockaddr*)&sa, &slen);
+  e->lis_tcp[fd] = true;
+  e->listeners.push_back(fd);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = fd;
+  epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev);
+  return ntohs(sa.sin_port);
+}
+
+int moolib_net_listen_unix(void* ctx, const char* path) {
+  Engine* e = static_cast<Engine*>(ctx);
+  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  sockaddr_un sa{};
+  sa.sun_family = AF_UNIX;
+  strncpy(sa.sun_path, path, sizeof(sa.sun_path) - 1);
+  unlink(path);
+  if (bind(fd, (sockaddr*)&sa, sizeof sa) != 0 || listen(fd, 128) != 0) {
+    close(fd);
+    return -1;
+  }
+  e->lis_tcp[fd] = false;
+  e->listeners.push_back(fd);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = fd;
+  epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev);
+  return 0;
+}
+
+void moolib_net_connect_tcp(void* ctx, int64_t req_id, const char* host,
+                            int port) {
+  Engine* e = static_cast<Engine*>(ctx);
+  Cmd c;
+  c.kind = Cmd::kConnectTcp;
+  c.id = req_id;
+  c.data = host ? host : "";
+  c.port = port;
+  e->push(std::move(c));
+}
+
+void moolib_net_connect_unix(void* ctx, int64_t req_id, const char* path) {
+  Engine* e = static_cast<Engine*>(ctx);
+  Cmd c;
+  c.kind = Cmd::kConnectUnix;
+  c.id = req_id;
+  c.data = path ? path : "";
+  e->push(std::move(c));
+}
+
+// Threshold above which a chunk rides zero-copy (pinned by the caller until
+// the release callback fires) instead of being memcpy'd into the queue.
+constexpr uint64_t kZeroCopyMin = 64 * 1024;
+
+// Queue one frame gathered from n chunks (length prefix added here). Small
+// chunks coalesce into one owned segment; chunks >= kZeroCopyMin are sent
+// zero-copy — the caller keeps them alive until release_cb(token) fires
+// (token 0 = everything was copied; no release will fire). Any thread.
+// Returns 1 if the frame pins caller buffers, 0 if fully copied, -1 on error.
+int moolib_net_send_iov(void* ctx, int64_t conn_id, const void* const* bufs,
+                        const uint64_t* lens, int32_t n, int64_t token) {
+  Engine* e = static_cast<Engine*>(ctx);
+  uint64_t total = 0;
+  for (int32_t i = 0; i < n; ++i) total += lens[i];
+  if (total > kMaxFrame) return -1;
+  Cmd c;
+  c.kind = Cmd::kSend;
+  c.id = conn_id;
+  Seg cur;
+  uint32_t l = static_cast<uint32_t>(total);
+  char hdr[4] = {static_cast<char>(l & 0xff), static_cast<char>((l >> 8) & 0xff),
+                 static_cast<char>((l >> 16) & 0xff),
+                 static_cast<char>((l >> 24) & 0xff)};
+  cur.owned.append(hdr, 4);
+  bool pinned = false;
+  for (int32_t i = 0; i < n; ++i) {
+    if (lens[i] >= kZeroCopyMin && token != 0) {
+      if (!cur.owned.empty()) {
+        c.segs.push_back(std::move(cur));
+        cur = Seg();
+      }
+      Seg ext;
+      ext.ext = static_cast<const uint8_t*>(bufs[i]);
+      ext.ext_len = lens[i];
+      c.segs.push_back(std::move(ext));
+      pinned = true;
+    } else {
+      cur.owned.append(static_cast<const char*>(bufs[i]), lens[i]);
+    }
+  }
+  if (!cur.owned.empty()) c.segs.push_back(std::move(cur));
+  if (pinned) {
+    c.segs.back().token = token;
+    c.token = token;
+  }
+  e->push(std::move(c));
+  return pinned ? 1 : 0;
+}
+
+// Queue one frame (length prefix added here, payload copied). Any thread.
+int moolib_net_send(void* ctx, int64_t conn_id, const void* data,
+                    uint64_t len) {
+  const void* bufs[1] = {data};
+  uint64_t lens[1] = {len};
+  int r = moolib_net_send_iov(ctx, conn_id, bufs, lens, 1, 0);
+  return r < 0 ? -1 : 0;
+}
+
+void moolib_net_close_conn(void* ctx, int64_t conn_id) {
+  Engine* e = static_cast<Engine*>(ctx);
+  Cmd c;
+  c.kind = Cmd::kCloseConn;
+  c.id = conn_id;
+  e->push(std::move(c));
+}
+
+void moolib_net_destroy(void* ctx) {
+  Engine* e = static_cast<Engine*>(ctx);
+  Cmd c;
+  c.kind = Cmd::kStop;
+  e->push(std::move(c));
+  // Callers bind this via ctypes, which releases the GIL during the call, so
+  // the epoll thread can finish an in-flight Python callback and exit.
+  if (e->thread.joinable()) e->thread.join();
+  close(e->epfd);
+  close(e->evfd);
+  delete e;
+}
+
+}  // extern "C"

@@ -28,6 +28,7 @@ from .. import Accumulator, Broker, EnvPool
 from ..envs import CartPoleEnv
 from ..models import ActorCriticNet
 from ..ops import discounted_returns, entropy_loss, softmax_cross_entropy
+from .common import finalize_flags
 
 
 def a2c_loss(params, model, batch, initial_core_state, discounting):
@@ -69,7 +70,7 @@ def make_flags(argv=None):
     p.add_argument("--log_interval", type=float, default=2.0)
     p.add_argument("--no_lstm", action="store_true")
     p.add_argument("--quiet", action="store_true")
-    return p.parse_args(argv)
+    return finalize_flags(p, argv)
 
 
 def train(flags, on_stats=None) -> dict:

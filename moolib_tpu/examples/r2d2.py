@@ -28,6 +28,7 @@ from .. import EnvPool
 from ..envs import CartPoleEnv
 from ..models.qnet import RecurrentQNet
 from ..replay import ReplayBuffer, ReplayClient, ReplayServer
+from .common import finalize_flags
 
 
 def make_flags(argv=None):
@@ -49,7 +50,7 @@ def make_flags(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log_interval", type=float, default=5.0)
     p.add_argument("--quiet", action="store_true")
-    return p.parse_args(argv)
+    return finalize_flags(p, argv)
 
 
 def td_loss(params, target_params, model, batch, discounting):

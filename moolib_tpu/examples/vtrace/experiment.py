@@ -69,7 +69,7 @@ def make_flags(argv=None):
     p.add_argument("--device", default=None, help="jax device str, e.g. 'tpu:0'")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
-    return p.parse_args(argv)
+    return common.finalize_flags(p, argv)
 
 
 def make_env_factory(flags):

@@ -1,0 +1,118 @@
+"""Config subsystem tests (YAML, interpolation, resolvers, CLI overrides) —
+covers the reference's hydra/omegaconf usage surface
+(examples/vtrace/experiment.py:214-224, config.yaml)."""
+
+import argparse
+
+import pytest
+
+from moolib_tpu.utils.config import Config, register_resolver
+from moolib_tpu.examples.common import finalize_flags
+
+
+def test_basic_access_and_nesting():
+    cfg = Config.from_dict({"a": 1, "b": {"c": "x", "d": [1, 2]}})
+    assert cfg.a == 1
+    assert cfg.b.c == "x"
+    assert cfg["b"]["d"] == [1, 2]
+    assert "a" in cfg and "z" not in cfg
+    assert cfg.get("z", 7) == 7
+    with pytest.raises(AttributeError):
+        cfg.missing
+
+
+def test_interpolation_and_resolvers():
+    cfg = Config.from_dict(
+        {
+            "batch": 32,
+            "virtual": "${batch}",
+            "name": "run-${batch}",
+            "uid1": "${uid:}",
+            "nested": {"ref": "${batch}"},
+        }
+    )
+    assert cfg.virtual == 32  # whole-string interp keeps the int type
+    assert cfg.name == "run-32"
+    assert len(cfg.uid1) == 16
+    assert cfg.nested.ref == 32
+    register_resolver("double", lambda arg: int(arg) * 2)
+    cfg2 = Config.from_dict({"x": "${double:21}"})
+    assert cfg2.x == 42
+
+
+def test_interpolation_cycle_detected():
+    cfg = Config.from_dict({"a": "${b}", "b": "${a}"})
+    with pytest.raises(ValueError, match="recursion"):
+        cfg.a
+
+
+def test_overrides_and_file(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("lr: 0.001\nopt:\n  name: adam\n  eps: 1.0e-8\n")
+    cfg = Config.load(
+        str(p),
+        overrides=["opt.name=sgd", "new.key=5", "flag=true"],
+        defaults={"lr": 1.0, "extra": "d"},
+    )
+    assert cfg.lr == 0.001  # file beats defaults
+    assert cfg.opt.name == "sgd"  # override beats file
+    assert cfg.opt.eps == 1e-8
+    assert cfg.new.key == 5 and cfg.flag is True
+    assert cfg.extra == "d"
+    # Round trip through yaml.
+    text = cfg.to_yaml()
+    assert "sgd" in text
+    d = cfg.to_dict()
+    assert d["opt"] == {"name": "sgd", "eps": 1e-8}
+
+
+def test_finalize_flags(tmp_path):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--total_steps", type=int, default=100)
+    parser.add_argument("--name", default="x")
+    cfgfile = tmp_path / "f.yaml"
+    cfgfile.write_text("name: fromfile\n")
+    flags = finalize_flags(
+        parser, ["--total_steps", "7", "--cfg", str(cfgfile), "total_steps=9"]
+    )
+    assert flags.name == "fromfile"
+    assert flags.total_steps == 9  # key=value override wins
+    flags2 = finalize_flags(parser, ["--total_steps", "7"])
+    assert flags2.total_steps == 7 and flags2.name == "x"
+    # Explicit CLI flags beat the config file; parser defaults do not.
+    cfgfile2 = tmp_path / "g.yaml"
+    cfgfile2.write_text("total_steps: 50\nname: filename\n")
+    flags3 = finalize_flags(parser, ["--total_steps", "7", "--cfg", str(cfgfile2)])
+    assert flags3.total_steps == 7  # typed by the user
+    assert flags3.name == "filename"  # left at default -> file wins
+
+
+def test_resolver_cached_and_errors_not_masked():
+    cfg = Config.from_dict({"train_id": "run-${uid:}", "also": "${uid:}"})
+    first = cfg.train_id
+    assert cfg.train_id == first  # stable across reads
+    assert cfg.also == first.removeprefix("run-")  # same resolver value
+    # A typo'd interpolation in a PRESENT key surfaces as the real error,
+    # not AttributeError (which get()/hasattr would silently swallow).
+    bad = Config.from_dict({"virtual": "${batch_sizee}", "batch_size": 8})
+    with pytest.raises(KeyError, match="batch_sizee"):
+        bad.virtual
+
+
+def test_defaults_not_mutated_by_overrides():
+    shared = {"opt": {"eps": 1}}
+    cfg = Config.load(None, overrides=["opt.eps=99"], defaults=shared)
+    assert cfg.opt.eps == 99
+    assert shared == {"opt": {"eps": 1}}  # caller's dict untouched
+
+
+def test_example_config_parses():
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "moolib_tpu", "examples", "vtrace", "config.yaml"
+    )
+    cfg = Config.load(path, overrides=["env=cartpole"])
+    assert cfg.env == "cartpole"
+    assert cfg.virtual_batch_size == cfg.batch_size
+    assert cfg.train_id.startswith("impala-") and len(cfg.train_id) > len("impala-")

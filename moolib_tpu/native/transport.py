@@ -90,6 +90,10 @@ def get_lib():
         ctypes.c_int64,
     ]
     lib.moolib_net_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.moolib_net_conn_rx.restype = ctypes.c_uint64
+    lib.moolib_net_conn_rx.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.moolib_net_conn_tx.restype = ctypes.c_uint64
+    lib.moolib_net_conn_tx.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.moolib_net_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
@@ -218,6 +222,18 @@ class NativeNet:
     def close_conn(self, conn_id: int) -> None:
         if self._ctx:
             self._lib.moolib_net_close_conn(self._ctx, conn_id)
+
+    def conn_rx(self, conn_id: int) -> int:
+        """Monotonic received-byte count for a live connection (0 if gone)."""
+        if not self._ctx:
+            return 0
+        return self._lib.moolib_net_conn_rx(self._ctx, conn_id)
+
+    def conn_tx(self, conn_id: int) -> int:
+        """Monotonic written-byte count for a live connection (0 if gone)."""
+        if not self._ctx:
+            return 0
+        return self._lib.moolib_net_conn_tx(self._ctx, conn_id)
 
     def destroy(self) -> None:
         ctx, self._ctx = self._ctx, None

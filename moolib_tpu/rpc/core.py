@@ -49,8 +49,10 @@ from ..utils import nest
 from . import serialization
 
 # Protocol signature; a peer greeting with a different signature is rejected
-# (reference kSignature, src/rpc.cc:810).
-SIGNATURE = 0x6D6F6F5450550001
+# (reference kSignature, src/rpc.cc:810). Bumped when wire behavior changes
+# incompatibly (0002: keepalive ping/pong + activity-based teardown — a
+# 0001 peer never pongs and would be torn down as unresponsive).
+SIGNATURE = 0x6D6F6F5450550002
 
 KIND_GREETING = 1
 KIND_REQUEST = 2
@@ -59,6 +61,13 @@ KIND_ERROR = 4
 KIND_KEEPALIVE = 5
 
 _DEFAULT_TIMEOUT = 120.0
+# Keepalive cadence (reference: keepalives after idle, teardown of
+# unresponsive connections, src/rpc.cc:1625-1665). A connection that has
+# received nothing for _CONN_DEAD seconds while we kept pinging it is torn
+# down; explicit connections then auto-reconnect.
+_KEEPALIVE_IDLE = 4.0
+_KEEPALIVE_INTERVAL = 2.0
+_CONN_DEAD = 16.0
 
 
 class RpcError(RuntimeError):
@@ -254,6 +263,7 @@ class _Connection:
         "latency",
         "created",
         "last_recv",
+        "last_keepalive",
         "closed",
         "inbound",
         "_explicit_addr",
@@ -271,6 +281,7 @@ class _Connection:
         self.latency: Optional[float] = None  # EMA seconds
         self.created = time.monotonic()
         self.last_recv = time.monotonic()
+        self.last_keepalive = 0.0
         self.closed = False
         self._explicit_addr: Optional[str] = None
 
@@ -312,13 +323,15 @@ class _NativeConnection(_Connection):
     and arrive via engine callbacks instead of an asyncio read loop.
     """
 
-    __slots__ = ("net", "conn_id", "rpc")
+    __slots__ = ("net", "conn_id", "rpc", "rx_seen", "tx_seen")
 
     def __init__(self, net, conn_id: int, transport: str, rpc, inbound: bool = False):
         super().__init__(transport, None, None, inbound=inbound)
         self.net = net
         self.conn_id = conn_id
         self.rpc = rpc
+        self.rx_seen = -1  # engine byte counters at last liveness check
+        self.tx_seen = -1
 
     def send_frame(self, chunks: List[bytes]) -> None:
         if sum(_chunk_len(c) for c in chunks) > 0xFFFFFFFF:
@@ -1055,7 +1068,20 @@ class Rpc:
             while not self._closed:
                 hdr = await conn.reader.readexactly(4)
                 (length,) = struct.unpack("<I", hdr)
-                frame = await conn.reader.readexactly(length)
+                if length <= 1 << 20:
+                    frame = await conn.reader.readexactly(length)
+                else:
+                    # Chunked read of large frames so last_recv reflects
+                    # byte-level progress (keepalive teardown must not kill
+                    # a link mid-way through a big transfer).
+                    buf = bytearray(length)
+                    got = 0
+                    while got < length:
+                        piece = await conn.reader.readexactly(min(1 << 20, length - got))
+                        buf[got : got + len(piece)] = piece
+                        got += len(piece)
+                        conn.last_recv = time.monotonic()
+                    frame = bytes(buf)
                 conn.recv_count += 1
                 conn.last_recv = time.monotonic()
                 self._on_frame(conn, frame)
@@ -1085,7 +1111,13 @@ class Rpc:
         elif kind in (KIND_RESPONSE, KIND_ERROR):
             self._on_response(conn, frame, kind == KIND_ERROR)
         elif kind == KIND_KEEPALIVE:
-            pass
+            # Ping (flag 0) wants a pong (flag 1) so the *sender's* last_recv
+            # refreshes too; pongs are not echoed (no ping-pong storm).
+            if len(frame) < 2 or frame[1] == 0:
+                try:
+                    conn.send_frame([struct.pack("<BB", KIND_KEEPALIVE, 1)])
+                except Exception:
+                    conn.close()
         else:
             utils.log_error("rpc: unknown frame kind %d", kind)
 
@@ -1348,6 +1380,45 @@ class Rpc:
                     # Keep hunting for peers with parked requests.
                     if peer.pending and not peer.connections:
                         hunts.append(peer)
+                # Keepalives + unresponsive-connection teardown (reference
+                # timeoutConnections, src/rpc.cc:1625-1665): ping idle
+                # connections; a link that stays silent while pinged is dead
+                # (no RST on a silently dropped path) — close it so explicit
+                # connections reconnect and requests fail over.
+                for conn in list(self._conns):
+                    if conn.closed:
+                        continue
+                    if isinstance(conn, _NativeConnection):
+                        # Byte-level liveness: a link mid-way through a huge
+                        # frame (no frame completion, but bytes moving) is
+                        # alive — don't tear it down. Inbound bytes are
+                        # definitive; outbound "progress" counts only when
+                        # substantial (a dead socket still absorbs small
+                        # writes — like our pings — into the kernel buffer).
+                        rx = conn.net.conn_rx(conn.conn_id)
+                        tx = conn.net.conn_tx(conn.conn_id)
+                        if rx != conn.rx_seen or (
+                            conn.tx_seen >= 0 and tx - conn.tx_seen >= 262144
+                        ):
+                            conn.last_recv = now2
+                        conn.rx_seen = rx
+                        conn.tx_seen = tx
+                    idle = now2 - conn.last_recv
+                    if idle > _CONN_DEAD:
+                        utils.log_verbose(
+                            "rpc: closing unresponsive %s connection to %s",
+                            conn.transport,
+                            conn.peer_name,
+                        )
+                        conn.close()
+                        self._detach_conn(conn)
+                    elif idle > _KEEPALIVE_IDLE and now2 - conn.last_keepalive > _KEEPALIVE_INTERVAL:
+                        conn.last_keepalive = now2
+                        try:
+                            conn.send_frame([struct.pack("<BB", KIND_KEEPALIVE, 0)])
+                        except Exception:
+                            conn.close()
+                            self._detach_conn(conn)
             for peer in hunts:
                 self._loop.create_task(self._find_peer(peer))
 

@@ -116,6 +116,22 @@ struct Engine {
   std::deque<Cmd> cmds;
 
   std::atomic<int64_t> next_id{1};
+  // Byte-level link activity per conn (rx reads / tx writev completions),
+  // readable from any thread: lets the owner distinguish "link moving a
+  // huge frame" from "link dead" when deciding keepalive teardown. rx and
+  // tx are kept separate — small tx "progress" is not a liveness signal
+  // (a dead socket still absorbs bytes into the kernel buffer).
+  std::mutex act_mu;
+  std::unordered_map<int64_t, std::pair<uint64_t, uint64_t>> activity;
+  void add_rx(int64_t id, uint64_t n) {
+    std::lock_guard<std::mutex> g(act_mu);
+    activity[id].first += n;
+  }
+  void add_tx(int64_t id, uint64_t n) {
+    std::lock_guard<std::mutex> g(act_mu);
+    activity[id].second += n;
+  }
+
   // Touched only on the epoll thread:
   std::unordered_map<int64_t, Conn*> conns;
   std::unordered_map<int, Conn*> by_fd;
@@ -155,6 +171,10 @@ void destroy_conn(Engine* e, Conn* c, bool notify) {
   // Unpin every undelivered zero-copy buffer.
   for (Seg& s : c->outq) e->release(s.token);
   c->outq.clear();
+  {
+    std::lock_guard<std::mutex> g(e->act_mu);
+    e->activity.erase(c->id);
+  }
   if (notify && !e->stopping.load()) {
     if (c->connecting)
       e->on_connect(e->ud, c->connect_req, -1);
@@ -203,6 +223,7 @@ void flush_out(Engine* e, Conn* c) {
       destroy_conn(e, c, true);
       return;
     }
+    if (w > 0) e->add_tx(c->id, static_cast<uint64_t>(w));
     size_t left = static_cast<size_t>(w);
     while (left > 0 && !c->outq.empty()) {
       Seg& front = c->outq.front();
@@ -243,6 +264,7 @@ void handle_readable(Engine* e, Conn* c) {
       return;
     }
     c->rd.resize(old + static_cast<size_t>(r));
+    e->add_rx(c->id, static_cast<uint64_t>(r));
     // Deliver every complete frame in the buffer.
     for (;;) {
       size_t have = c->rd.size() - c->consumed;
@@ -612,6 +634,22 @@ int moolib_net_send(void* ctx, int64_t conn_id, const void* data,
   uint64_t lens[1] = {len};
   int r = moolib_net_send_iov(ctx, conn_id, bufs, lens, 1, 0);
   return r < 0 ? -1 : 0;
+}
+
+// Bytes received / transmitted on a connection; monotonic while it lives.
+// Any thread.
+uint64_t moolib_net_conn_rx(void* ctx, int64_t conn_id) {
+  Engine* e = static_cast<Engine*>(ctx);
+  std::lock_guard<std::mutex> g(e->act_mu);
+  auto it = e->activity.find(conn_id);
+  return it == e->activity.end() ? 0 : it->second.first;
+}
+
+uint64_t moolib_net_conn_tx(void* ctx, int64_t conn_id) {
+  Engine* e = static_cast<Engine*>(ctx);
+  std::lock_guard<std::mutex> g(e->act_mu);
+  auto it = e->activity.find(conn_id);
+  return it == e->activity.end() ? 0 : it->second.second;
 }
 
 void moolib_net_close_conn(void* ctx, int64_t conn_id) {

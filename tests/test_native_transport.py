@@ -142,6 +142,77 @@ def test_backend_interop(free_port, monkeypatch):
         host.close()
 
 
+def test_keepalives_keep_idle_connection_alive(free_port, monkeypatch):
+    """An idle but healthy link must NOT be torn down: pings are answered
+    with pongs, refreshing both sides (reference keepalive cycle,
+    src/rpc.cc:1625-1665)."""
+    from moolib_tpu.rpc import core
+
+    monkeypatch.setattr(core, "_KEEPALIVE_IDLE", 0.4)
+    monkeypatch.setattr(core, "_KEEPALIVE_INTERVAL", 0.2)
+    monkeypatch.setattr(core, "_CONN_DEAD", 1.5)
+    host, client = Rpc(), Rpc()
+    try:
+        host.set_name("host")
+        client.set_name("client")
+        host.listen(f"127.0.0.1:{free_port}")
+        host.define("f", lambda: 1)
+        client.connect(f"127.0.0.1:{free_port}")
+        client.set_timeout(10)
+        assert client.sync("host", "f") == 1
+        conns_before = [c for c in client._conns if not c.closed]
+        assert conns_before
+        sent_before_idle = conns_before[0].send_count
+        time.sleep(3.0)  # idle for 2x the dead threshold
+        # Same connections, still alive, and keepalives flowed during the
+        # idle window (not just the greeting/request traffic before it).
+        alive = [c for c in client._conns if not c.closed]
+        assert alive and alive[0] is conns_before[0]
+        assert alive[0].send_count > sent_before_idle  # pings went out
+        assert client.sync("host", "f") == 1
+    finally:
+        client.close()
+        host.close()
+
+
+def test_unresponsive_connection_torn_down(free_port, monkeypatch):
+    """A link that answers nothing (no RST — just silence) is detected and
+    closed within the keepalive-dead window."""
+    import socket as socketlib
+
+    from moolib_tpu.rpc import core
+
+    monkeypatch.setattr(core, "_KEEPALIVE_IDLE", 0.3)
+    monkeypatch.setattr(core, "_KEEPALIVE_INTERVAL", 0.2)
+    monkeypatch.setattr(core, "_CONN_DEAD", 1.2)
+    # A server that accepts and then stays silent forever.
+    silent = socketlib.socket()
+    silent.bind(("127.0.0.1", free_port))
+    silent.listen(4)
+    rpc = Rpc()
+    try:
+        rpc.set_name("probe")
+        rpc.connect(f"127.0.0.1:{free_port}")
+        deadline = time.time() + 10
+        saw_conn = False
+        torn_down = False
+        first_conn = None
+        while time.time() < deadline:
+            conns = list(rpc._conns)
+            if conns and first_conn is None:
+                first_conn = conns[0]
+                saw_conn = True
+            if first_conn is not None and first_conn.closed:
+                torn_down = True
+                break
+            time.sleep(0.1)
+        assert saw_conn, "never connected to the silent server"
+        assert torn_down, "unresponsive connection was never torn down"
+    finally:
+        rpc.close()
+        silent.close()
+
+
 def test_asyncio_fallback_full_flow(free_port, monkeypatch):
     """The asyncio backend still carries the full RPC surface when the
     native engine is disabled."""

@@ -1,0 +1,86 @@
+"""EnvPool stepping throughput — the actor data path.
+
+Counterpart of the reference's EnvPool hot loop (fork-server + shared
+memory + double buffering, ``src/env.{h,cc}``): measures environment
+steps/second through the full shm round trip with ``num_batches`` in-flight
+batches overlapping stepping and acting (the reference's double-buffer
+pattern, ``examples/vtrace/experiment.py:480-529``).
+
+Usage: python benchmarks/envpool_bench.py [--env synthetic|catch|cartpole]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--env", default="catch", choices=["catch", "cartpole", "synthetic"])
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--num_processes", type=int, default=4)
+    p.add_argument("--num_batches", type=int, default=2)
+    p.add_argument("--steps", type=int, default=200, help="steps per batch slot")
+    args = p.parse_args()
+
+    # EnvPool forks; construct before heavy jax init (reference constraint,
+    # src/env.cc:149-169).
+    from moolib_tpu import EnvPool
+    from moolib_tpu.envs import CartPoleEnv, CatchEnv, SyntheticAtariEnv
+
+    make = {"catch": CatchEnv, "cartpole": CartPoleEnv, "synthetic": SyntheticAtariEnv}[
+        args.env
+    ]
+    pool = EnvPool(
+        make,
+        num_processes=args.num_processes,
+        batch_size=args.batch_size,
+        num_batches=args.num_batches,
+    )
+    rng = np.random.default_rng(0)
+    num_actions = make().num_actions
+
+    def acts():
+        return rng.integers(0, num_actions, size=(args.batch_size,), dtype=np.int64)
+
+    # Warm: one round trip per batch slot (envs instantiate lazily).
+    futs = [pool.step(i, acts()) for i in range(args.num_batches)]
+    obs = [f.result() for f in futs]
+    nbytes = sum(v.nbytes for v in obs[0].values())
+
+    t0 = time.perf_counter()
+    done = 0
+    # Double-buffer: always keep every slot in flight (act on one batch
+    # while the workers step the other).
+    futs = [pool.step(i, acts()) for i in range(args.num_batches)]
+    for _ in range(args.steps):
+        for i in range(args.num_batches):
+            futs[i].result()
+            futs[i] = pool.step(i, acts())
+            done += args.batch_size
+    for f in futs:
+        f.result()
+        done += args.batch_size
+    dt = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "env": args.env,
+                "batch_size": args.batch_size,
+                "num_processes": args.num_processes,
+                "num_batches": args.num_batches,
+                "env_steps_per_s": round(done / dt, 1),
+                "obs_mb_per_s": round(done / args.batch_size * nbytes / dt / 1e6, 1),
+            }
+        )
+    )
+    pool.close()
+
+
+if __name__ == "__main__":
+    main()

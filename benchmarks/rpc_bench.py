@@ -1,0 +1,100 @@
+"""RPC engine benchmark: call rates and tensor bandwidth per transport
+backend.
+
+Counterpart of the reference's speed canaries
+(``test/unit/test_tensors.py:46-85``: sync/async no-op call rates) plus a
+large-payload echo for wire bandwidth. Compares the native C++ epoll engine
+against the asyncio fallback (``--backend both``); the wire format is
+identical, so the delta is pure IO-engine overhead.
+
+Usage: python benchmarks/rpc_bench.py [--backend native|asyncio|both]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def run_one(backend: str, port: int) -> dict:
+    os.environ["MOOLIB_TPU_NATIVE_TRANSPORT"] = "1" if backend == "native" else "0"
+    import numpy as np
+
+    from moolib_tpu import Rpc
+
+    host, client = Rpc(), Rpc()
+    host.set_name("host")
+    client.set_name("client")
+    host.listen(f"127.0.0.1:{port}")
+    assert (host._net is not None) == (backend == "native")
+    host.define("noop", lambda: None)
+    host.define("echo", lambda t: t)
+    client.connect(f"127.0.0.1:{port}")
+    client.set_timeout(60)
+    client.sync("host", "noop")  # connect + warm
+
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        client.sync("host", "noop")
+    sync_rate = n / (time.perf_counter() - t0)
+
+    n = 10000
+    t0 = time.perf_counter()
+    futs = [client.async_("host", "noop") for _ in range(n)]
+    for f in futs:
+        f.result(60)
+    async_rate = n / (time.perf_counter() - t0)
+
+    arr = np.random.default_rng(0).random((16, 1024, 1024), np.float32)  # 64 MB
+    for _ in range(2):
+        client.sync("host", "echo", arr)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        client.sync("host", "echo", arr)
+    dt = (time.perf_counter() - t0) / iters
+    bw_gbs = 2 * arr.nbytes / dt / 1e9  # both directions
+
+    host.close()
+    client.close()
+    return {
+        "backend": backend,
+        "sync_noop_per_s": round(sync_rate, 1),
+        "async_noop_per_s": round(async_rate, 1),
+        "echo_64mb_gb_per_s": round(bw_gbs, 3),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--backend", default="both", choices=["native", "asyncio", "both"])
+    p.add_argument("--port", type=int, default=29811)
+    p.add_argument("--_child", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args()
+    if args._child:
+        print(json.dumps(run_one(args._child, args.port)))
+        return
+    backends = ["native", "asyncio"] if args.backend == "both" else [args.backend]
+    for i, b in enumerate(backends):
+        # Each backend in a fresh process: the transport choice is made at
+        # Rpc construction and native libs are cached per process.
+        out = subprocess.run(
+            [sys.executable, __file__, "--_child", b, "--port", str(args.port + i)],
+            capture_output=True,
+            text=True,
+            env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
+        )
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else None
+        if line is None:
+            print(f"{b}: FAILED\n{out.stderr[-2000:]}", file=sys.stderr)
+        else:
+            print(line)
+
+
+if __name__ == "__main__":
+    main()

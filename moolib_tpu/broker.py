@@ -47,6 +47,11 @@ class Broker:
     def set_name(self, name: str) -> None:
         self._rpc.set_name(name)
 
+    def connect(self, address: str) -> None:
+        """Connect the broker's Rpc to an existing peer/network (reference
+        ``Broker`` passthrough, ``src/broker.h:240-265``)."""
+        self._rpc.connect(address)
+
     def listen(self, address: str) -> None:
         self._rpc.listen(address)
 

@@ -43,16 +43,19 @@ class ChaosProxy:
             except OSError:
                 cli.close()
                 continue
-            self._links.append((cli, srv))
-            threading.Thread(target=self._pump, args=(cli, srv), daemon=True).start()
-            threading.Thread(target=self._pump, args=(srv, cli), daemon=True).start()
+            link = {"socks": (cli, srv), "blackhole": False}
+            self._links.append(link)
+            threading.Thread(target=self._pump, args=(cli, srv, link), daemon=True).start()
+            threading.Thread(target=self._pump, args=(srv, cli, link), daemon=True).start()
 
-    def _pump(self, a, b):
+    def _pump(self, a, b, link):
         try:
             while True:
                 data = a.recv(65536)
                 if not data:
                     break
+                if link["blackhole"]:
+                    continue  # silent drop: the link looks alive, goes nowhere
                 b.sendall(data)
         except OSError:
             pass
@@ -65,13 +68,19 @@ class ChaosProxy:
 
     def kill_links(self):
         links, self._links = self._links, []
-        for a, b in links:
-            for s in (a, b):
+        for link in links:
+            for s in link["socks"]:
                 try:
                     s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER, b"\x01\x00\x00\x00\x00\x00\x00\x00")
                     s.close()
                 except OSError:
                     pass
+
+    def blackhole_current(self):
+        """Silently drop all traffic on EXISTING links (sockets stay open —
+        no RST, no EOF); links created afterwards forward normally."""
+        for link in self._links:
+            link["blackhole"] = True
 
     def close(self):
         self._closed = True
@@ -146,6 +155,28 @@ def test_failover_to_advertised_address(chaos_pair):
     assert client.sync("host", "noop") == 7
     proxy.close()  # the original path is gone for good
     assert client.sync("host", "noop") == 7  # direct connection takes over
+
+
+def test_keepalive_recovers_blackholed_link(chaos_pair, monkeypatch):
+    """A silently-dropped path (no RST — the link just stops carrying
+    bytes) must be detected by the keepalive cycle and the in-flight call
+    must complete over a fresh connection, far sooner than the call
+    timeout (reference: keepalive teardown + resend, src/rpc.cc:1625-1665)."""
+    from moolib_tpu.rpc import core
+
+    monkeypatch.setattr(core, "_KEEPALIVE_IDLE", 0.3)
+    monkeypatch.setattr(core, "_KEEPALIVE_INTERVAL", 0.2)
+    monkeypatch.setattr(core, "_CONN_DEAD", 1.5)
+    host, client, proxy = chaos_pair
+    host.define("ping2", lambda x: x * 2)
+    assert client.sync("host", "ping2", 1) == 2
+    proxy.blackhole_current()
+    t0 = time.time()
+    fut = client.async_("host", "ping2", 21)
+    assert fut.result(25) == 42
+    # Recovery must come from teardown+reconnect (seconds), not the 30s
+    # call-timeout path.
+    assert time.time() - t0 < 15
 
 
 def test_timeout_when_peer_dead(chaos_pair):

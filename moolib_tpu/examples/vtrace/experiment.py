@@ -265,12 +265,26 @@ def train(flags, on_stats=None) -> dict:
     final_return = None
     start = time.time()
     cur = 0
+    # Graceful shutdown: SIGTERM (scheduler preemption) stops the loop so
+    # the finally block runs — leader checkpoints on the way out, exactly
+    # like SIGINT (reference signal handling, examples/vtrace/
+    # experiment.py:331-348). Restored on exit so nested runs are clean.
+    stop_requested = False
+
+    def _on_sigterm(signum, frame):
+        nonlocal stop_requested
+        stop_requested = True
+
+    import signal as _signal
+
+    prev_sigterm = _signal.signal(_signal.SIGTERM, _on_sigterm)
+
     # Kick off the first step of every actor batch (double buffering).
     for i, st in enumerate(env_states):
         st.future = envs[i].step(0, np.zeros(B, np.int64))
 
     try:
-        while stats["steps_done"].value < flags.total_steps:
+        while stats["steps_done"].value < flags.total_steps and not stop_requested:
             if broker is not None:
                 broker.update()
             rpc_group.update()
@@ -392,6 +406,7 @@ def train(flags, on_stats=None) -> dict:
                     "mean_episode_return", "mean_episode_step",
                 )
     finally:
+        _signal.signal(_signal.SIGTERM, prev_sigterm)
         if flags.checkpoint and accumulator.is_leader():
             save_checkpoint(
                 flags.checkpoint, params, opt_state,

@@ -1,0 +1,212 @@
+"""Hierarchical data parallelism: ICI psum inside the mesh x elastic RPC
+tree across hosts.
+
+This is the TPU mapping SURVEY.md §2.4 prescribes: the *data plane* for
+gradient sync inside a static device mesh is an XLA collective over ICI
+(psum, inserted by sharding the batch over dp in the jitted train step),
+while the *elastic plane* across hosts is the Accumulator's binary-tree
+allreduce over RPC/DCN (virtual batch sizes, leader election, join/leave).
+
+The test simulates 2 "hosts", each owning a disjoint 2-device slice of the
+8-device CPU mesh (a stand-in for that host's TPU chips):
+
+  host h:  grads_h = mean over its local mesh (psum over dp, via sharding)
+  cohort:  Accumulator tree-averages grads_h across hosts
+
+and checks the result equals the global-batch gradient computed directly —
+i.e. hierarchical reduce == flat reduce, the invariant that makes the
+hybrid design correct.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from moolib_tpu import Accumulator, Broker, parallel
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >=4 (virtual) devices"
+)
+
+
+def _loss_fn(params, batch, rng):
+    pred = batch["x"] @ params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+
+def pump(broker, accs, seconds, until=None):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        broker.update()
+        for a in accs:
+            a.update()
+            if a.wants_state():
+                a.set_state({})
+        if until is not None and until():
+            return True
+        time.sleep(0.02)
+    return until() if until is not None else None
+
+
+def test_hierarchical_equals_flat(free_port):
+    devices = jax.devices()[:4]
+    n_hosts, per_host = 2, 2
+    D, B = 8, 8  # feature dim, per-host batch
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=(D, D)).astype(np.float32)
+    xs = rng.normal(size=(n_hosts, B, D)).astype(np.float32)
+    ys = rng.normal(size=(n_hosts, B, D)).astype(np.float32)
+
+    # --- flat reference: global-batch gradient on one device -------------
+    flat_grads = jax.grad(lambda p, b: _loss_fn(p, b, None)[0])(
+        {"w": jnp.asarray(w0)},
+        {"x": jnp.asarray(xs.reshape(-1, D)), "y": jnp.asarray(ys.reshape(-1, D))},
+    )
+
+    # --- hierarchical: per-host sharded grad step + accumulator tree -----
+    # Each "host" computes its local-mean gradient with the batch sharded
+    # over its own 2-device dp mesh (the psum over ICI happens inside jit
+    # via the sharding), then contributes it to the elastic cohort.
+    host_grads = []
+    for h in range(n_hosts):
+        mesh = parallel.make_mesh({"dp": per_host}, devices=devices[h * per_host : (h + 1) * per_host])
+
+        def grad_step(params, batch):
+            return jax.grad(lambda p, b: _loss_fn(p, b, None)[0])(params, batch)
+
+        with mesh:
+            sharded = jax.jit(
+                grad_step,
+                in_shardings=(
+                    jax.sharding.NamedSharding(mesh, P()),
+                    jax.sharding.NamedSharding(mesh, P("dp")),
+                ),
+                out_shardings=jax.sharding.NamedSharding(mesh, P()),
+            )
+            g = sharded(
+                {"w": jnp.asarray(w0)},
+                {"x": jnp.asarray(xs[h]), "y": jnp.asarray(ys[h])},
+            )
+        host_grads.append(jax.device_get(g))
+
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    accs = []
+    for h in range(n_hosts):
+        acc = Accumulator(f"hier", {"w": w0.copy()})
+        acc._rpc.set_name(f"host{h}")
+        acc._rpc.listen("127.0.0.1:0")
+        acc.connect(addr)
+        accs.append(acc)
+    try:
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        for h, a in enumerate(accs):
+            a.reduce_gradients(B, host_grads[h])
+        assert pump(broker, accs, 15, until=lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            # Tree-average of the two host gradients == flat global gradient
+            # (each host's grad is already its local-batch mean over an
+            # equal share, so the cohort mean is the global mean).
+            np.testing.assert_allclose(
+                np.asarray(a.gradients()["w"]),
+                np.asarray(flat_grads["w"]),
+                rtol=2e-5,
+                atol=2e-5,
+            )
+    finally:
+        for a in accs:
+            a.close()
+        broker.close()
+
+
+def test_hierarchical_training_converges(free_port):
+    """Two mesh-sharded 'hosts' actually train together through the
+    accumulator and reach the same parameters (cohort consistency) with a
+    decreasing loss."""
+    devices = jax.devices()[:4]
+    n_hosts, per_host = 2, 2
+    D, B = 4, 16
+    rng = np.random.default_rng(1)
+    w_true = rng.normal(size=(D, D)).astype(np.float32)
+
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    opt = optax.sgd(0.1)
+    hosts = []
+    for h in range(n_hosts):
+        mesh = parallel.make_mesh({"dp": per_host}, devices=devices[h * per_host : (h + 1) * per_host])
+        params = {"w": jnp.zeros((D, D), jnp.float32)}
+        acc = Accumulator("train", params)
+        acc._rpc.set_name(f"host{h}")
+        acc._rpc.listen("127.0.0.1:0")
+        acc.connect(addr)
+        grad_fn = jax.jit(
+            jax.grad(lambda p, b: _loss_fn(p, b, None)[0]),
+            in_shardings=(
+                jax.sharding.NamedSharding(mesh, P()),
+                jax.sharding.NamedSharding(mesh, P("dp")),
+            ),
+            out_shardings=jax.sharding.NamedSharding(mesh, P()),
+        )
+        opt_state = opt.init(params)
+        hosts.append({"acc": acc, "grad_fn": grad_fn, "opt_state": opt_state, "rng": np.random.default_rng(10 + h)})
+    accs = [hh["acc"] for hh in hosts]
+    try:
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        # Fixed eval batch: per-step training batches are too noisy to
+        # assert improvement on.
+        ev = np.random.default_rng(99)
+        ex = ev.normal(size=(64, D)).astype(np.float32)
+        eval_batch = {"x": jnp.asarray(ex), "y": jnp.asarray(ex @ w_true)}
+
+        def eval_loss():
+            return float(_loss_fn(hosts[0]["acc"].parameters(), eval_batch, None)[0])
+
+        loss0 = eval_loss()
+        steps = 0
+        deadline = time.time() + 120
+        while steps < 16 and time.time() < deadline:
+            broker.update()
+            for hh in hosts:
+                a = hh["acc"]
+                a.update()
+                if a.wants_state():
+                    a.set_state({})
+                if a.has_gradients():
+                    g = a.gradients()
+                    p = a.parameters()
+                    updates, hh["opt_state"] = opt.update(g, hh["opt_state"], p)
+                    a.set_parameters(optax.apply_updates(p, updates))
+                    a.zero_gradients()
+                    if hh is hosts[0]:
+                        steps += 1
+                elif a.wants_gradients():
+                    r = hh["rng"]
+                    x = r.normal(size=(B, D)).astype(np.float32)
+                    batch = {"x": jnp.asarray(x), "y": jnp.asarray(x @ w_true)}
+                    p = a.parameters()
+                    a.reduce_gradients(B, jax.device_get(hh["grad_fn"](p, batch)))
+            time.sleep(0.005)
+        assert steps >= 16, f"only {steps} sgd steps"
+        # Both hosts hold identical parameters (cohort consistency)...
+        np.testing.assert_allclose(
+            np.asarray(hosts[0]["acc"].parameters()["w"]),
+            np.asarray(hosts[1]["acc"].parameters()["w"]),
+            rtol=1e-6,
+        )
+        # ...and the model is learning (fixed-batch eval).
+        loss1 = eval_loss()
+        assert loss1 < loss0 * 0.5, f"not converging: {loss0} -> {loss1}"
+    finally:
+        for a in accs:
+            a.close()
+        broker.close()

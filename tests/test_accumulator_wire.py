@@ -1,4 +1,4 @@
-"""Gradient wire compression (bf16 allreduce payloads)."""
+"""Gradient wire compression (bf16 / int8+error-feedback allreduce payloads)."""
 
 import time
 
@@ -6,6 +6,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from moolib_tpu import Accumulator, Broker
+from moolib_tpu.accumulator import _dequantize_q8, _q8_add, _quantize_q8
+
+
+def _pump(broker, accs, seconds, until):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        broker.update()
+        for a in accs:
+            a.update()
+            if a.wants_state():
+                a.set_state({})
+        if until():
+            return True
+        time.sleep(0.02)
+    return until()
 
 
 def test_bf16_wire_gradients(free_port):
@@ -22,29 +37,74 @@ def test_bf16_wire_gradients(free_port):
         acc.connect(addr)
         accs.append(acc)
     try:
-        deadline = time.time() + 30
-        while not all(a.connected() for a in accs) and time.time() < deadline:
-            broker.update()
-            for a in accs:
-                a.update()
-                if a.wants_state():
-                    a.set_state({})
-            time.sleep(0.02)
-        assert all(a.connected() for a in accs)
+        assert _pump(broker, accs, 30, lambda: all(a.connected() for a in accs))
         g = {"w": np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)}
         for a in accs:
             a.reduce_gradients(1, g)
-        deadline = time.time() + 15
-        while not all(a.has_gradients() for a in accs) and time.time() < deadline:
-            broker.update()
-            for a in accs:
-                a.update()
-            time.sleep(0.02)
+        assert _pump(broker, accs, 15, lambda: all(a.has_gradients() for a in accs))
         for a in accs:
             out = np.asarray(a.gradients()["w"], np.float32)
             assert out.dtype == np.float32
             # bf16 carries ~3 decimal digits: mean of identical grads = grads.
             np.testing.assert_allclose(out, [1, 2, 3, 4], rtol=0.01)
+    finally:
+        for a in accs:
+            a.close()
+        broker.close()
+
+
+def test_q8_quantize_roundtrip_and_error_feedback():
+    rng = np.random.default_rng(0)
+    g = {"w": rng.normal(size=(64,)).astype(np.float32), "b": np.zeros(3, np.float32)}
+    q, res = _quantize_q8(g, None)
+    deq = _dequantize_q8(q)
+    # <1% relative error on the large leaf; zeros stay exactly zero.
+    np.testing.assert_allclose(deq["w"], g["w"], atol=np.abs(g["w"]).max() / 100)
+    np.testing.assert_array_equal(deq["b"], 0.0)
+    # Error feedback: residual equals the quantization error and joins the
+    # next round, so two identical contributions average to (nearly) exact.
+    np.testing.assert_allclose(res["w"], g["w"] - deq["w"], atol=1e-6)
+    q2, _ = _quantize_q8(g, res)
+    two_round_mean = (_dequantize_q8(q)["w"] + _dequantize_q8(q2)["w"]) / 2
+    err0 = np.abs(deq["w"] - g["w"]).mean()
+    err2 = np.abs(two_round_mean - g["w"]).mean()
+    assert err2 < err0 * 0.75, (err0, err2)
+    # Hop-combining matches f32 addition within one quantization step.
+    both = _q8_add(q, q)
+    np.testing.assert_allclose(
+        _dequantize_q8(both)["w"], 2 * deq["w"], atol=2 * np.abs(g["w"]).max() / 127
+    )
+
+
+def test_int8_wire_gradients_cohort(free_port):
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    accs = []
+    for i in range(3):
+        acc = Accumulator("m", {"w": np.zeros((8,), np.float32)})
+        acc.set_name(f"p{i}")
+        acc.listen()
+        acc.set_wire_dtype("int8")
+        acc.connect(addr)
+        accs.append(acc)
+    try:
+        assert _pump(broker, accs, 30, lambda: all(a.connected() for a in accs))
+        rng = np.random.default_rng(1)
+        gs = [
+            {"w": rng.normal(size=(8,)).astype(np.float32) * (i + 1)} for i in range(3)
+        ]
+        for a, g in zip(accs, gs):
+            a.reduce_gradients(1, g)
+        assert _pump(broker, accs, 15, lambda: all(a.has_gradients() for a in accs))
+        expected = np.mean([g["w"] for g in gs], axis=0)
+        tol = max(np.abs(g["w"]).max() for g in gs) / 127 * 3
+        for a in accs:
+            out = np.asarray(a.gradients()["w"], np.float32)
+            assert out.dtype == np.float32
+            np.testing.assert_allclose(out, expected, atol=tol)
+            a.zero_gradients()
     finally:
         for a in accs:
             a.close()

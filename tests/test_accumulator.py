@@ -136,7 +136,9 @@ def test_virtual_batch_size(free_port):
 def test_late_joiner_gets_model(free_port):
     broker, accs = make_cohort(free_port, 2, versions=[3, 3])
     try:
-        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        assert pump(
+            broker, accs, 90, until=lambda: all(a.connected() for a in accs)
+        ), "initial cohort never connected"
         leader = [a for a in accs if a.is_leader()][0]
         leader.set_parameters({"w": np.full((2, 2), 9.0, np.float32), "b": np.zeros(2, np.float32)})
 
@@ -148,8 +150,13 @@ def test_late_joiner_gets_model(free_port):
         late._rpc.listen("127.0.0.1:0")
         late.connect(f"127.0.0.1:{free_port}")
         accs.append(late)
-        ok = pump(broker, accs, 30, until=lambda: late.connected())
-        assert ok, "late joiner never connected"
+        # Generous deadline: the suite runs on heavily-loaded single-core
+        # CI-style machines where broker epochs + model sync take a while.
+        ok = pump(broker, accs, 90, until=lambda: late.connected())
+        assert ok, (
+            f"late joiner never connected: leader={late.get_leader()} "
+            f"synced={late._epoch_synced} members={late._group.members()}"
+        )
         np.testing.assert_allclose(np.asarray(late.parameters()["w"]), 9.0)
         assert late.model_version() == leader.model_version()
         # And the cohort can still reduce together.

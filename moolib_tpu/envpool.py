@@ -31,6 +31,7 @@ from __future__ import annotations
 import ctypes
 import mmap
 import multiprocessing as mp
+import traceback
 from multiprocessing import shared_memory
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -188,7 +189,20 @@ class EnvRunner:
                 b = self._get_task()
                 if b is None or b == _SHUTDOWN:
                     break
-                self._step_batch(b, views[b], act_views[b])
+                try:
+                    self._step_batch(b, views[b], act_views[b])
+                except Exception:
+                    # Report the env traceback to the parent (result() polls
+                    # the pipe) before dying — a user env bug surfaces in
+                    # seconds with its real traceback, not as an opaque
+                    # 120 s step timeout.
+                    try:
+                        self.conn.send(
+                            ("step_error", self.worker_index, traceback.format_exc())
+                        )
+                    except Exception:
+                        pass
+                    raise
                 self.done_sems[b].release()
         finally:
             for seg in segs:
@@ -257,8 +271,18 @@ class EnvStepperFuture:
         if self._done:
             return self._stepper._views[self._batch_index]
         s = self._stepper
-        for _ in range(s._num_workers):
-            if not s._done_sems[self._batch_index].acquire(timeout=s._timeout):
+        import time as _time
+
+        deadline = _time.monotonic() + s._timeout
+        acquired = 0
+        while acquired < s._num_workers:
+            if s._done_sems[self._batch_index].acquire(timeout=0.5):
+                acquired += 1
+                continue
+            # Slow path: while waiting, surface worker failures promptly
+            # with the env's real traceback instead of a blind timeout.
+            s._pool._check_workers()
+            if _time.monotonic() > deadline:
                 raise TimeoutError(
                     f"EnvPool step batch {self._batch_index} timed out "
                     f"({s._timeout}s); an env worker may have died"
@@ -371,6 +395,7 @@ class EnvPool:
             ctx, num_processes, num_batches
         )
         self._procs: List = []
+        self._worker_conns: List = []
         per = batch_size // num_processes
         extra = batch_size % num_processes
         lo = 0
@@ -394,9 +419,27 @@ class EnvPool:
             p.start()
             pconn.send({"obs": layout_obs, "act": layout_act})
             self._procs.append(p)
+            self._worker_conns.append(pconn)
             lo = hi
         self._stepper = EnvStepper(self)
         self._closed = False
+
+    def _check_workers(self) -> None:
+        """Raise if a worker reported an env exception or died."""
+        for i, (p, conn) in enumerate(zip(self._procs, self._worker_conns)):
+            try:
+                while conn.poll():
+                    msg = conn.recv()
+                    if isinstance(msg, tuple) and msg and msg[0] == "step_error":
+                        raise RuntimeError(
+                            f"EnvPool worker {msg[1]} env exception:\n{msg[2]}"
+                        )
+            except (EOFError, OSError):
+                pass
+            if not p.is_alive():
+                raise RuntimeError(
+                    f"EnvPool worker {i} died (exit code {p.exitcode})"
+                )
 
     def step(self, batch_index: int, action) -> EnvStepperFuture:
         if not 0 <= batch_index < self._num_batches:

@@ -107,3 +107,32 @@ def test_bad_env_raises():
 
     with pytest.raises(RuntimeError, match="probe process"):
         EnvPool(make_bad, num_processes=1, batch_size=1, num_batches=1)
+
+
+class ExplodingEnv(FakeEnv):
+    """Steps fine twice, then raises — exercises mid-training env bugs."""
+
+    def step(self, action):
+        self._n = getattr(self, "_n", 0) + 1
+        if self._n > 2:
+            raise ValueError("env exploded mid-training")
+        return super().step(action)
+
+
+def test_env_exception_surfaces_fast():
+    """A user env raising inside a worker must surface promptly in
+    result() with the real traceback, not as a 120 s opaque timeout."""
+    import numpy as np
+    import time
+
+    pool = EnvPool(ExplodingEnv, num_processes=1, batch_size=2, num_batches=1)
+    try:
+        acts = np.zeros(2, np.int64)
+        pool.step(0, acts).result()
+        pool.step(0, acts).result()
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="env exploded mid-training"):
+            pool.step(0, acts).result()
+        assert time.monotonic() - t0 < 30  # prompt, not the 120 s timeout
+    finally:
+        pool.close()

@@ -1,0 +1,44 @@
+"""Smoke tests for the example tooling: launcher script generation and
+TSV plotting (reference counterparts: ``examples/sbatch_experiment.py``,
+``examples/plot.py``)."""
+
+import numpy as np
+
+from moolib_tpu.examples import launch, plot
+
+
+def test_sbatch_generation(capsys):
+    launch.main(["sbatch", "--num_peers", "3", "--job_name", "jt", "--",
+                 "python", "-m", "moolib_tpu.examples.vtrace.experiment"])
+    out = capsys.readouterr().out
+    assert "#SBATCH --job-name=jt" in out
+    assert "#SBATCH --ntasks=4" in out  # peers + broker
+    assert "moolib_tpu.broker" in out
+    assert "moolib_tpu.examples.vtrace.experiment" in out
+    assert "--connect" in out
+
+
+def test_pod_generation(capsys):
+    launch.main(["pod", "--broker_port", "5000"])
+    out = capsys.readouterr().out
+    assert "moolib_tpu.broker" in out and ":5000" in out
+    assert "initialize_distributed" in out
+
+
+def test_plot_tsv_roundtrip(tmp_path, capsys):
+    path = tmp_path / "logs.tsv"
+    rows = ["step\treturn"]
+    for i in range(50):
+        rows.append(f"{i * 100}\t{i * 2.0 + np.sin(i)}")
+    path.write_text("\n".join(rows) + "\n")
+    xs, ys = plot.read_tsv(str(path), "step", "return")
+    assert len(xs) == 50 and xs[0] == 0 and xs[-1] == 4900
+    sx, sy = plot.smooth(xs, ys, window=5)
+    assert len(sx) == len(sy) > 0
+    plot.ascii_plot(xs, ys, title="returns")  # prints the chart
+    art = capsys.readouterr().out
+    assert "returns" in art and len(art.splitlines()) > 5
+    # CLI end-to-end (ASCII mode prints the chart).
+    plot.main([str(path), "--xkey", "step", "--ykey", "return", "--ascii"])
+    out = capsys.readouterr().out
+    assert "return" in out

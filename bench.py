@@ -77,7 +77,9 @@ def main():
     opt = optax.rmsprop(1e-3, decay=0.99, eps=0.01)
     opt_state = opt.init(params)
 
-    @jax.jit
+    # Donate params/opt_state: the update happens in place in HBM instead of
+    # allocating fresh buffers every step (matters at Atari-model size).
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(partial(loss_fn, model=model))(params, batch)
         updates, opt_state = opt.update(grads, opt_state, params)

@@ -32,6 +32,7 @@ out-of-band buffer path (host staging), so handlers can freely pass
 from __future__ import annotations
 
 import asyncio
+import atexit
 import collections
 import concurrent.futures
 import itertools
@@ -433,6 +434,21 @@ class _FnDef:
 
 
 _live_rpcs: "weakref.WeakSet[Rpc]" = weakref.WeakSet()
+
+
+def _close_live_rpcs():
+    """atexit: close every Rpc the user leaked (reference leak tracking +
+    atexit cleanup, src/moolib.cc:127-183). Engines must stop BEFORE the
+    interpreter finalizes — a C++ epoll thread calling back into a
+    finalizing interpreter aborts."""
+    for rpc in list(_live_rpcs):
+        try:
+            rpc.close()
+        except Exception:  # noqa: BLE001 - best effort at shutdown
+            pass
+
+
+atexit.register(_close_live_rpcs)
 
 
 class Queue:

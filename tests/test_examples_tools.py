@@ -25,6 +25,42 @@ def test_pod_generation(capsys):
     assert "initialize_distributed" in out
 
 
+def test_tsv_logger(tmp_path):
+    from moolib_tpu.examples.common import TsvLogger
+
+    path = str(tmp_path / "run1" / "logs.tsv")
+    logger = TsvLogger(path, metadata={"run": "t"})
+    logger.log(step=1, ret=0.5)
+    logger.log(step=2, ret=1.5)
+    lines = open(path).read().splitlines()
+    assert lines[0].split("\t") == ["time", "step", "ret"]
+    assert len(lines) == 3
+    import json
+    import os
+
+    meta = json.load(open(os.path.join(os.path.dirname(path), "metadata.json")))
+    assert meta["run"] == "t" and "argv" in meta
+    assert os.path.islink(os.path.join(os.path.dirname(path), "latest.tsv"))
+    # Round trip through the plotter.
+    xs, ys = plot.read_tsv(path, "step", "ret")
+    assert xs == [1.0, 2.0] and ys == [0.5, 1.5]
+
+
+def test_batch_size_finder():
+    import jax.numpy as jnp
+
+    from moolib_tpu.utils.batchsize import find_batch_size
+
+    def fn(x):
+        return jnp.tanh(x @ x.T).sum()
+
+    def make_batch(n):
+        return (jnp.ones((n, 16), jnp.float32),)
+
+    best = find_batch_size(make_batch, fn, start=4, max_batch=64)
+    assert 4 <= best <= 64
+
+
 def test_plot_tsv_roundtrip(tmp_path, capsys):
     path = tmp_path / "logs.tsv"
     rows = ["step\treturn"]

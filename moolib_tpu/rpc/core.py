@@ -51,9 +51,10 @@ from . import serialization
 
 # Protocol signature; a peer greeting with a different signature is rejected
 # (reference kSignature, src/rpc.cc:810). Bumped when wire behavior changes
-# incompatibly (0002: keepalive ping/pong + activity-based teardown — a
-# 0001 peer never pongs and would be torn down as unresponsive).
-SIGNATURE = 0x6D6F6F5450550002
+# incompatibly (0002: keepalive ping/pong + activity-based teardown; 0003:
+# max-(initiator_uid, dial_seq) duplicate-connection tie-break — mixed
+# versions would deterministically keep DIFFERENT duplicates and flap).
+SIGNATURE = 0x6D6F6F5450550003
 
 KIND_GREETING = 1
 KIND_REQUEST = 2
@@ -267,6 +268,8 @@ class _Connection:
         "last_keepalive",
         "closed",
         "inbound",
+        "initiator_uid",
+        "conn_seq",
         "_explicit_addr",
     )
 
@@ -283,6 +286,11 @@ class _Connection:
         self.created = time.monotonic()
         self.last_recv = time.monotonic()
         self.last_keepalive = 0.0
+        # Duplicate-connection tie-break identity: who dialed, and that
+        # side's dial sequence number (set at dial for outbound, from the
+        # greeting for inbound). Both ends keep the max — deterministic.
+        self.initiator_uid: Optional[str] = None
+        self.conn_seq = 0
         self.closed = False
         self._explicit_addr: Optional[str] = None
 
@@ -557,6 +565,7 @@ class Rpc:
         self._listen_addrs: List[str] = []
         self._explicit: List[str] = []
         self._rid = itertools.count(1)
+        self._dial_seq = itertools.count(1)
         self._outgoing: Dict[int, _Outgoing] = {}
         self._closed = False
         self._functions["__moolib_find_peer"] = _FnDef(
@@ -893,6 +902,8 @@ class Rpc:
             with self._state:
                 addrs = list(peer.addresses)
             for addr in addrs:
+                if any(not c.closed for c in peer.connections.values()):
+                    return  # a dial (ours or another task's) just won
                 if await self._connect_once(addr):
                     return
             with self._state:
@@ -920,7 +931,7 @@ class Rpc:
 
     async def _retry_connect(self, peer: _Peer):
         for addr in list(peer.addresses):
-            if peer.connections:
+            if any(not c.closed for c in peer.connections.values()):
                 return
             await self._connect_once(addr)
 
@@ -937,6 +948,8 @@ class Rpc:
         except Exception:
             return False
         conn = _Connection(kind, reader, writer)
+        conn.initiator_uid = self._uid
+        conn.conn_seq = next(self._dial_seq)
         if explicit_addr is not None:
             # Tag so the reconnect task can see whether its address is live.
             conn._explicit_addr = explicit_addr
@@ -1050,6 +1063,8 @@ class Rpc:
             ok = conn_id >= 0
             if ok:
                 conn = _NativeConnection(self._net, conn_id, kind, self)
+                conn.initiator_uid = self._uid
+                conn.conn_seq = next(self._dial_seq)
                 if explicit_addr is not None:
                     conn._explicit_addr = explicit_addr
                 self._native_conns[conn_id] = conn
@@ -1068,6 +1083,9 @@ class Rpc:
                 "uid": self._uid,
                 "addrs": list(self._listen_addrs),
                 "native": serialization.native_available(),
+                # Dial sequence of this connection if WE initiated it (the
+                # acceptor learns it for the duplicate tie-break).
+                "seq": conn.conn_seq if not conn.inbound else 0,
             }
         )
         conn.send_frame([struct.pack("<B", KIND_GREETING), greeting])
@@ -1164,14 +1182,21 @@ class Rpc:
         for a in info.get("addrs", []):
             if a not in peer.addresses:
                 peer.addresses.append(a)
+        if conn.inbound:
+            conn.initiator_uid = uid
+            conn.conn_seq = int(info.get("seq", 0))
         old = peer.connections.get(conn.transport)
         if old is not None and old is not conn and not old.closed:
-            # Simultaneous-connect tie-break: both sides may have dialed each
-            # other at once. Deterministically keep the connection initiated
-            # by the peer with the smaller uid (same decision on both ends).
-            new_initiator = uid if conn.inbound else self._uid
-            old_initiator = uid if old.inbound else self._uid
-            if min(new_initiator, old_initiator) == old_initiator and new_initiator != old_initiator:
+            # Duplicate-connection tie-break. Duplicates happen two ways:
+            # simultaneous connect (each side dialed the other) and redundant
+            # dials from one side (reconnect task racing discovery before the
+            # first greeting lands). Keep the max (initiator_uid, dial_seq) —
+            # both ends compute the same winner regardless of the order the
+            # greetings arrived in, so they never keep different connections
+            # (which would look like the peer closing our healthy link).
+            new_key = (conn.initiator_uid or "", conn.conn_seq)
+            old_key = (old.initiator_uid or "", old.conn_seq)
+            if old_key >= new_key:
                 conn.close()
                 return
             old.close()
@@ -1393,8 +1418,11 @@ class Rpc:
                     peer.recent = {
                         rid: v for rid, v in peer.recent.items() if now2 - v[0] < v[2]
                     }
-                    # Keep hunting for peers with parked requests.
-                    if peer.pending and not peer.connections:
+                    # Keep hunting for peers with parked requests (a closed
+                    # conn pending detach does not count as connected).
+                    if peer.pending and not any(
+                        not c.closed for c in peer.connections.values()
+                    ):
                         hunts.append(peer)
                 # Keepalives + unresponsive-connection teardown (reference
                 # timeoutConnections, src/rpc.cc:1625-1665): ping idle

@@ -1,0 +1,33 @@
+"""Build and run the C++ harness tests for the native engine (the
+reference's C++ test pattern, test/test_rpc.cc + test/CMakeLists.txt)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_transport_cc(tmp_path):
+    binary = str(tmp_path / "test_transport")
+    build = subprocess.run(
+        [
+            "g++",
+            "-O1",
+            "-std=c++17",
+            "-pthread",
+            os.path.join(ROOT, "native", "test_transport.cc"),
+            "-o",
+            binary,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert build.returncode == 0, build.stderr[-3000:]
+    run = subprocess.run([binary], capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, (run.stdout + run.stderr)[-3000:]
+    assert "passed" in run.stdout

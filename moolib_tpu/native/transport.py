@@ -20,7 +20,12 @@ from .. import utils
 
 ACCEPT_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p)
 FRAME_CB = ctypes.CFUNCTYPE(
-    None, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_uint64
+    None,
+    ctypes.c_void_p,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_void_p),
+    ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int32,
 )
 CLOSE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int64)
 CONNECT_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64)
@@ -120,15 +125,21 @@ class NativeNet:
         def _accept(ud, conn_id, transport):
             on_accept(conn_id, transport.decode())
 
-        def _frame(ud, conn_id, data, length):
-            # Zero-copy view into the engine's read buffer. It is only valid
-            # for the duration of this callback — the consumer deserializes
-            # synchronously (array leaves are copied during materialization).
-            if length:
-                view = memoryview((ctypes.c_ubyte * length).from_address(data)).cast("B")
-            else:
-                view = memoryview(b"")
-            on_frame(conn_id, view)
+        def _frame(ud, conn_id, datas, lens, n):
+            # One callback per burst of frames (a single GIL acquisition
+            # covers the whole batch). Each view is zero-copy into the
+            # engine's read buffer, valid only for the duration of this
+            # callback — consumers deserialize synchronously (array leaves
+            # are copied during materialization).
+            for i in range(n):
+                length = lens[i]
+                if length:
+                    view = memoryview(
+                        (ctypes.c_ubyte * length).from_address(datas[i])
+                    ).cast("B")
+                else:
+                    view = memoryview(b"")
+                on_frame(conn_id, view)
 
         def _close(ud, conn_id):
             on_close(conn_id)

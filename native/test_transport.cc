@@ -40,10 +40,12 @@ struct Collector {
 void on_accept(void* ud, int64_t conn_id, const char*) {
   static_cast<Collector*>(ud)->accepted.store(conn_id);
 }
-void on_frame(void* ud, int64_t, const uint8_t* data, uint64_t len) {
+void on_frame(void* ud, int64_t, const uint8_t** datas, const uint64_t* lens,
+              int32_t n) {
   Collector* c = static_cast<Collector*>(ud);
   std::lock_guard<std::mutex> g(c->mu);
-  c->frames.emplace_back(reinterpret_cast<const char*>(data), len);
+  for (int32_t i = 0; i < n; i++)
+    c->frames.emplace_back(reinterpret_cast<const char*>(datas[i]), lens[i]);
 }
 void on_close(void* ud, int64_t) { static_cast<Collector*>(ud)->closes++; }
 void on_connect(void* ud, int64_t, int64_t conn_id) {

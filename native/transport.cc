@@ -50,8 +50,11 @@ constexpr size_t kReadChunk = 1024 * 1024;
 constexpr int kSockBuf = 4 * 1024 * 1024;  // loopback/DCN throughput
 
 typedef void (*accept_cb_t)(void* ud, int64_t conn_id, const char* transport);
-typedef void (*frame_cb_t)(void* ud, int64_t conn_id, const uint8_t* data,
-                           uint64_t len);
+// Frames are delivered in bursts: every complete frame parsed out of one
+// read pass arrives in a single callback (one GIL acquisition per burst
+// when the callback is Python).
+typedef void (*frame_cb_t)(void* ud, int64_t conn_id, const uint8_t** datas,
+                           const uint64_t* lens, int32_t n);
 typedef void (*close_cb_t)(void* ud, int64_t conn_id);
 typedef void (*connect_cb_t)(void* ud, int64_t req_id, int64_t conn_id);
 typedef void (*release_cb_t)(void* ud, int64_t token);
@@ -246,7 +249,13 @@ void flush_out(Engine* e, Conn* c) {
   }
 }
 
+constexpr int kFrameBurst = 128;
+
 void handle_readable(Engine* e, Conn* c) {
+  // Burst buffers: pointers stay valid until the rd buffer is compacted,
+  // which only happens after the flush below.
+  const uint8_t* datas[kFrameBurst];
+  uint64_t lens[kFrameBurst];
   for (;;) {
     size_t old = c->rd.size();
     c->rd.resize(old + kReadChunk);
@@ -265,7 +274,10 @@ void handle_readable(Engine* e, Conn* c) {
     }
     c->rd.resize(old + static_cast<size_t>(r));
     e->add_rx(c->id, static_cast<uint64_t>(r));
-    // Deliver every complete frame in the buffer.
+    // Parse every complete frame in the buffer; deliver them in bursts
+    // (one callback — one GIL acquisition — per batch of frames).
+    int n = 0;
+    bool dead = false;
     for (;;) {
       size_t have = c->rd.size() - c->consumed;
       if (have < 4) break;
@@ -273,14 +285,25 @@ void handle_readable(Engine* e, Conn* c) {
       uint32_t len = static_cast<uint32_t>(p[0]) | (uint32_t)p[1] << 8 |
                      (uint32_t)p[2] << 16 | (uint32_t)p[3] << 24;
       if (len > kMaxFrame) {
-        destroy_conn(e, c, true);
-        return;
+        dead = true;
+        break;
       }
       if (have < 4 + static_cast<size_t>(len)) break;
-      if (!e->stopping.load()) e->on_frame(e->ud, c->id, p + 4, len);
+      datas[n] = p + 4;
+      lens[n] = len;
+      ++n;
       c->consumed += 4 + static_cast<size_t>(len);
-      // The callback may have issued a close for this conn; it is routed
-      // through the command queue, so `c` stays valid here.
+      if (n == kFrameBurst) {
+        if (!e->stopping.load()) e->on_frame(e->ud, c->id, datas, lens, n);
+        n = 0;
+        // The callback may have issued a close for this conn; it is routed
+        // through the command queue, so `c` stays valid here.
+      }
+    }
+    if (n > 0 && !e->stopping.load()) e->on_frame(e->ud, c->id, datas, lens, n);
+    if (dead) {
+      destroy_conn(e, c, true);
+      return;
     }
     if (c->consumed == c->rd.size()) {
       c->rd.clear();

@@ -186,6 +186,56 @@ def test_queue_dynamic_batching(pair):
         np.testing.assert_allclose(np.asarray(fu.result()), np.full((2,), i * 10, np.float32))
 
 
+def test_queue_dynamic_batching_stress(free_port):
+    """Inference-serving shape: several client peers hammer one dynamic
+    batching queue concurrently; every call gets its own correct answer and
+    the server actually batches (fewer service iterations than calls)."""
+    host = Rpc()
+    host.set_name("server")
+    host.listen(f"127.0.0.1:{free_port}")
+    queue = host.define_queue("policy", batch_size=16, dynamic_batching=True)
+    n_clients, per_client = 3, 40
+    clients = []
+    for i in range(n_clients):
+        c = Rpc()
+        c.set_name(f"cl{i}")
+        c.set_timeout(60)
+        c.connect(f"127.0.0.1:{free_port}")
+        clients.append(c)
+    try:
+        futs = []
+        for ci, c in enumerate(clients):
+            for k in range(per_client):
+                val = ci * 1000 + k
+                futs.append(
+                    (val, c.async_("server", "policy", np.full((3,), val, np.float32)))
+                )
+        total = n_clients * per_client
+        iterations = 0
+
+        async def serve():
+            nonlocal iterations
+            served = 0
+            while served < total:
+                ret_cb, args, kwargs = await queue
+                x = np.asarray(args[0])
+                batch = x.shape[0] if x.ndim == 2 else 1
+                served += batch
+                iterations += 1
+                ret_cb(x + 0.5)
+
+        asyncio.run(asyncio.wait_for(serve(), 60))
+        for val, fu in futs:
+            np.testing.assert_allclose(
+                np.asarray(fu.result(60)), np.full((3,), val + 0.5, np.float32)
+            )
+        assert iterations < total, "dynamic batching never batched anything"
+    finally:
+        for c in clients:
+            c.close()
+        host.close()
+
+
 def test_future_await(pair):
     host, client = pair
     client.set_timeout(5)

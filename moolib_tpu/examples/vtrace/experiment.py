@@ -132,23 +132,41 @@ def compute_loss(params, model, batch, initial_core_state, flags):
     }
 
 
+def _use_checkpointer(path: str) -> bool:
+    """A ``.pkl`` path keeps the reference-style single-file pickle; any
+    other path is treated as a Checkpointer directory (orbax when
+    available: sharding-aware, retains history)."""
+    return not path.endswith(".pkl")
+
+
 def save_checkpoint(path, params, opt_state, steps, model_version):
+    state = {
+        "params": jax.device_get(params),
+        "opt_state": jax.device_get(opt_state),
+        "steps": steps,
+        "model_version": model_version,
+    }
+    if _use_checkpointer(path):
+        from ...checkpoint import Checkpointer
+
+        Checkpointer(path).save(int(steps), state)
+        return
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(tmp, "wb") as f:
-        pickle.dump(
-            {
-                "params": jax.device_get(params),
-                "opt_state": jax.device_get(opt_state),
-                "steps": steps,
-                "model_version": model_version,
-            },
-            f,
-        )
+        pickle.dump(state, f)
     os.replace(tmp, path)  # atomic tmp+rename like the reference (:186-204)
 
 
-def load_checkpoint(path):
+def load_checkpoint(path, target=None):
+    """``target`` is a template pytree (same treedef as what was saved) so
+    orbax restores container types — optax states are NamedTuples —
+    faithfully; the pickle path preserves types on its own."""
+    if _use_checkpointer(path):
+        from ...checkpoint import Checkpointer
+
+        ck = Checkpointer(path)
+        return ck.restore(target=target)
     with open(path, "rb") as f:
         return pickle.load(f)
 
@@ -203,9 +221,16 @@ def train(flags, on_stats=None) -> dict:
     model_version = 0
 
     if flags.checkpoint and os.path.exists(flags.checkpoint):
-        ck = load_checkpoint(flags.checkpoint)
-        params, opt_state = ck["params"], ck["opt_state"]
-        steps_done, model_version = ck["steps"], ck["model_version"]
+        template = {
+            "params": params,
+            "opt_state": opt_state,
+            "steps": 0,
+            "model_version": 0,
+        }
+        ck = load_checkpoint(flags.checkpoint, target=template)
+        if ck is not None:
+            params, opt_state = ck["params"], ck["opt_state"]
+            steps_done, model_version = ck["steps"], ck["model_version"]
 
     @jax.jit
     def act_step(params, inputs, core_state, rng_key):

@@ -67,6 +67,17 @@ def make_flags(argv=None):
     p.add_argument("--stats_interval", type=float, default=2.0)
     p.add_argument("--log_interval", type=float, default=5.0)
     p.add_argument("--device", default=None, help="jax device str, e.g. 'tpu:0'")
+    p.add_argument(
+        "--wire_dtype",
+        default=None,
+        choices=[None, "bf16", "int8"],
+        help="compress gradient allreduce payloads (bf16: 2x, int8+EF: 4x)",
+    )
+    p.add_argument(
+        "--trace_dir",
+        default=None,
+        help="capture a jax profiler trace of the first learner steps here",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
     return common.finalize_flags(p, argv)
@@ -261,6 +272,16 @@ def train(flags, on_stats=None) -> dict:
     )
     accumulator.set_virtual_batch_size(flags.virtual_batch_size)
     accumulator.set_model_version(model_version)
+    if flags.wire_dtype == "bf16":
+        accumulator.set_wire_dtype(jnp.bfloat16)
+    elif flags.wire_dtype == "int8":
+        accumulator.set_wire_dtype("int8")
+    if flags.trace_dir:
+        # Trace the first seconds of training (compile + early steps).
+        jax.profiler.start_trace(flags.trace_dir)
+        trace_stop_at = time.monotonic() + 30.0
+    else:
+        trace_stop_at = None
 
     stats = {
         "mean_episode_return": common.StatMean(),
@@ -333,6 +354,10 @@ def train(flags, on_stats=None) -> dict:
                 continue
 
             now = time.monotonic()
+            if trace_stop_at is not None and now > trace_stop_at:
+                trace_stop_at = None
+                jax.profiler.stop_trace()
+                print(f"profiler trace written to {flags.trace_dir}")
             if now - last_stats > flags.stats_interval:
                 last_stats = now
                 global_stats.reduce(stats)
@@ -431,6 +456,11 @@ def train(flags, on_stats=None) -> dict:
                     "mean_episode_return", "mean_episode_step",
                 )
     finally:
+        if trace_stop_at is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
         _signal.signal(_signal.SIGTERM, prev_sigterm)
         if flags.checkpoint and accumulator.is_leader():
             save_checkpoint(

@@ -65,6 +65,14 @@ class Broker:
     # service -----------------------------------------------------------------
     def _on_ping(self, group_name: str, peer_name: str, sort_order: int, client_sync_id):
         g = self._groups.setdefault(group_name, _BrokerGroup(group_name))
+        # Stateless restart safety: clients ignore epoch pushes that don't
+        # EXCEED their current sync_id, so a freshly-restarted broker must
+        # jump past any epoch still alive in the cohort. Wall-clock seeding
+        # usually guarantees that; a pinged-in higher sync_id (clock skew,
+        # regressed clock) covers the rest.
+        if client_sync_id is not None and client_sync_id > g.sync_id:
+            g.sync_id = int(client_sync_id) + 1
+            g.needs_update = True
         m = g.members.get(peer_name)
         if m is None:
             g.members[peer_name] = {"last_ping": time.monotonic(), "sort_order": sort_order}

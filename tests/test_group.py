@@ -189,6 +189,46 @@ def test_inflight_cancelled_on_group_change(free_port):
         close_all(broker, peers)
 
 
+def test_broker_restart_stateless(free_port):
+    """The broker is stateless-restartable (reference BrokerService design,
+    src/broker.h:99-237): kill it, start a fresh one on the same address,
+    and the cohort re-forms with a NEWER epoch and can reduce again."""
+    broker, peers = make_cohort(free_port, 3)
+    groups = [g for _, g in peers]
+    broker2 = None
+    try:
+        assert pump(broker, groups, 30, until=lambda: all(len(g.members()) == 3 for g in groups))
+        old_sync = groups[0].sync_id()
+        futs = [g.all_reduce("before", i) for i, g in enumerate(groups)]
+        assert pump(broker, groups, 10, until=lambda: all(f.done() for f in futs))
+        assert all(f.result(0) == 3 for f in futs)
+
+        broker.close()
+        broker2 = Broker()
+        broker2.set_name("broker")
+        broker2.set_timeout(5.0)
+        broker2.listen(f"127.0.0.1:{free_port}")
+        # Peers reconnect (explicit connect), ping the new broker, and get a
+        # fresh strictly-newer epoch with the full member list.
+        assert pump(
+            broker2,
+            groups,
+            60,
+            until=lambda: all(
+                len(g.members()) == 3 and g.sync_id() is not None and g.sync_id() > old_sync
+                for g in groups
+            ),
+        ), f"cohort never re-formed: {[ (g.sync_id(), g.members()) for g in groups ]}"
+        futs = [g.all_reduce("after_restart", 10 * (i + 1)) for i, g in enumerate(groups)]
+        assert pump(broker2, groups, 15, until=lambda: all(f.done() for f in futs))
+        assert all(f.result(0) == 60 for f in futs)
+    finally:
+        for rpc, _ in peers:
+            rpc.close()
+        if broker2 is not None:
+            broker2.close()
+
+
 def test_single_member_group(free_port):
     broker, peers = make_cohort(free_port, 1)
     try:

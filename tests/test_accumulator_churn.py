@@ -1,0 +1,94 @@
+"""Combined elastic stress: pipelined reductions x int8 wire compression x
+peer churn — the feature interactions (epoch cancel of in-flight pipelined
+rounds, EF residuals across cancels, rejoin model sync) all at once."""
+
+import time
+
+import numpy as np
+
+from moolib_tpu import Accumulator, Broker
+
+
+def pump_all(broker, accs):
+    broker.update()
+    for a in accs:
+        a.update()
+        if a.wants_state():
+            a.set_state({"tag": a._rpc.get_name()})
+
+
+def wait_until(broker, accs, seconds, cond):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        pump_all(broker, accs)
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def make_acc(name, addr, w0):
+    a = Accumulator("m", {"w": w0.copy()})
+    a._rpc.set_name(name)
+    a._rpc.set_timeout(10)
+    a._rpc.listen("127.0.0.1:0")
+    a.set_parallel_gradients(2)
+    a.set_wire_dtype("int8")
+    a.connect(addr)
+    return a
+
+
+def test_pipelined_int8_with_churn(free_port):
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.set_timeout(2.0)
+    broker.listen(addr)
+    w0 = np.full((16,), 5.0, np.float32)
+    accs = [make_acc(f"p{i}", addr, w0) for i in range(3)]
+    try:
+        assert wait_until(broker, accs, 40, lambda: all(a.connected() for a in accs))
+
+        # Drive a training-ish loop; after enough steps, kill one peer, keep
+        # looping, then add a fresh one. Gradient = current params (so the
+        # quadratic shrinks and any wire corruption shows up as divergence).
+        LR = 0.1
+        steps = {id(a): 0 for a in accs}
+        killed = rejoined = False
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            pump_all(broker, accs)
+            for a in list(accs):
+                if a.has_gradients():
+                    g = a.gradients()
+                    p = a.parameters()
+                    a.set_parameters({"w": p["w"] - LR * g["w"]})
+                    a.zero_gradients()
+                    steps[id(a)] = steps.get(id(a), 0) + 1
+                elif a.wants_gradients():
+                    a.reduce_gradients(1, {"w": a.parameters()["w"].copy()})
+            smin = min(steps.get(id(a), 0) for a in accs)
+            if not killed and smin >= 4:
+                victim = accs.pop()  # not necessarily the leader
+                victim.close()
+                killed = True
+            elif killed and not rejoined and smin >= 8:
+                fresh = make_acc("fresh", addr, np.zeros(16, np.float32))
+                accs.append(fresh)
+                steps[id(fresh)] = 0
+                rejoined = True
+            elif rejoined and min(steps.get(id(a), 0) for a in accs) >= 4:
+                break
+            time.sleep(0.005)
+        assert killed and rejoined, "churn phases never completed"
+        assert all(a.connected() for a in accs)
+        # Everyone (including the late joiner, which synced the model) holds
+        # identical parameters, and the quadratic went DOWN from the start.
+        w_ref = np.asarray(accs[0].parameters()["w"])
+        for a in accs[1:]:
+            np.testing.assert_allclose(np.asarray(a.parameters()["w"]), w_ref, rtol=1e-5)
+        assert float(np.abs(w_ref).max()) < 4.0, f"no descent: {w_ref[:4]}"
+    finally:
+        for a in accs:
+            a.close()
+        broker.close()

@@ -1,0 +1,32 @@
+"""Profiling utilities (SURVEY §5.1: the tracing/observability subsystem)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from moolib_tpu.utils.profiling import StepTimer, annotate, trace
+
+
+def test_step_timer_sections_and_report():
+    t = StepTimer(alpha=0.5)
+    for _ in range(3):
+        with t.section("act"):
+            time.sleep(0.002)
+        with t.section("learn"):
+            time.sleep(0.005)
+    s = t.summary()
+    assert set(s) == {"act", "learn"}
+    assert s["learn"] > s["act"] > 0
+    rep = t.report()
+    assert "learn=" in rep and "%" in rep
+
+
+def test_trace_and_annotate(tmp_path):
+    with trace(str(tmp_path)):
+        with annotate("matmul_region"):
+            x = jnp.ones((64, 64))
+            jax.block_until_ready(x @ x)
+    # A profile dump was produced.
+    dumped = list(tmp_path.rglob("*.pb")) + list(tmp_path.rglob("*.json.gz"))
+    assert dumped, f"no trace artifacts under {tmp_path}"

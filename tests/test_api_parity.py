@@ -1,0 +1,76 @@
+"""API-surface parity with the reference (py/moolib/__init__.py:2-22 export
+list and the pybind method surface, src/moolib.cc) — frozen as a test so the
+contract can't silently regress."""
+
+import moolib_tpu as m
+
+REF_EXPORTS = [
+    "Accumulator",
+    "AllReduce",
+    "Batcher",
+    "Broker",
+    "EnvPool",
+    "EnvRunner",
+    "EnvStepper",
+    "EnvStepperFuture",
+    "Future",
+    "Group",
+    "Queue",
+    "Rpc",
+    "RpcDeferredReturn",
+    "RpcError",
+    "create_uid",
+    "set_log_level",
+    "set_logging",
+    "set_max_threads",
+]
+
+REF_METHODS = {
+    "Rpc": [
+        "set_name", "get_name", "listen", "connect", "define",
+        "define_deferred", "define_queue", "undefine", "async_",
+        "async_callback", "sync", "set_timeout", "set_transports",
+        "debug_info",
+    ],
+    "Accumulator": [
+        "connect", "connected", "update", "is_leader", "get_leader",
+        "model_version", "set_model_version", "set_virtual_batch_size",
+        "set_parallel_gradients", "wants_state", "set_state",
+        "has_new_state", "state", "wants_gradients", "has_gradients",
+        "reduce_gradients", "skip_gradients", "zero_gradients",
+        "get_gradient_stats",
+    ],
+    "Group": [
+        "set_broker_name", "set_timeout", "set_sort_order", "members",
+        "sync_id", "name", "active", "all_reduce", "update",
+    ],
+    "Broker": ["set_name", "listen", "connect", "update"],
+    "Future": ["result", "wait", "done", "cancel", "exception"],
+    "Batcher": ["stack", "cat", "empty", "get", "size"],
+    "Queue": ["enqueue", "size"],
+    "EnvPool": ["step", "close"],
+    "EnvRunner": ["start", "running"],
+    "EnvStepper": ["step"],
+    "EnvStepperFuture": ["result"],
+}
+
+
+def test_reference_exports_present():
+    missing = [n for n in REF_EXPORTS if not hasattr(m, n)]
+    assert not missing, f"missing reference exports: {missing}"
+
+
+def test_reference_method_surface():
+    gaps = {}
+    for cls_name, methods in REF_METHODS.items():
+        cls = getattr(m, cls_name)
+        missing = [x for x in methods if not hasattr(cls, x)]
+        if missing:
+            gaps[cls_name] = missing
+    assert not gaps, f"missing reference methods: {gaps}"
+
+
+def test_futures_are_awaitable():
+    assert hasattr(m.Future, "__await__")
+    assert hasattr(m.Queue, "__await__")
+    assert issubclass(m.AllReduce, m.Future)

@@ -133,6 +133,7 @@ class Accumulator:
         self._has_gradients = False
         self._result_grads = None
         self._result_stats: Dict[str, int] = {}
+        self._result_epoch = None  # group sync_id the current result is from
 
         self._register_service()
 
@@ -253,12 +254,14 @@ class Accumulator:
         with self._lock:
             requesters, self._state_requesters = self._state_requesters, []
             params, buffers, version = self._params, self._buffers, self._model_version
+        epoch = self._group.sync_id()
         for peer in requesters:
             self._rpc.async_callback(
                 peer,
                 "__accum_model_update",
                 lambda r, e: None,
                 self._name,
+                epoch,
                 version,
                 params,
                 buffers,
@@ -413,6 +416,7 @@ class Accumulator:
                         lambda x: x / n, self._accum_grads
                     )
                 self._result_stats = dict(self._accum_stats)
+                self._result_epoch = self._group.sync_id()
                 self._accum_grads = None
                 self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
                 self._has_gradients = True
@@ -431,7 +435,22 @@ class Accumulator:
         with self._lock:
             self._has_gradients = False
             self._result_grads = None
-            self._model_version += 1
+            # Only bump the model version for a result produced under the
+            # CURRENT epoch. A result consumed across an epoch boundary was
+            # possibly seen by this peer alone (other peers' share of the
+            # round was cancelled); bumping would advance our version past
+            # the freshly-elected leader's and orphan us from the cohort —
+            # instead the version stays put and the leader's model sync
+            # reconverges us (full-reset semantics, reference
+            # src/accumulator.cc:555-626).
+            if self._result_epoch == self._group.sync_id():
+                self._model_version += 1
+            else:
+                utils.log_verbose(
+                    "accumulator %s: consumed a result from a dead epoch; "
+                    "model version not advanced",
+                    self._name,
+                )
             # Pipelined rounds that completed while the result was pending
             # consumption can now be applied.
             self._drain_rounds_locked()
@@ -449,17 +468,19 @@ class Accumulator:
             # Commit a staged model update (deferred so the user thread owns
             # the model, reference commitModelUpdate src/accumulator.cc:810-836).
             if self._staged_model is not None:
-                version, params, buffers, state = self._staged_model
+                epoch, version, params, buffers, state = self._staged_model
                 self._staged_model = None
-                self._params = params
-                if buffers is not None:
-                    self._buffers = buffers
-                self._model_version = version
-                if state is not None:
-                    self._received_state = state
-                    self._has_new_state = True
-                self._epoch_synced = True
-                synced = True
+                if epoch == self._group.sync_id():
+                    self._params = params
+                    if buffers is not None:
+                        self._buffers = buffers
+                    self._model_version = version
+                    if state is not None:
+                        self._received_state = state
+                        self._has_new_state = True
+                    self._epoch_synced = True
+                    synced = True
+                # else: staged under an epoch that died before commit — drop.
         # Non-leader that hasn't synced this epoch: (re-)request the model.
         if leader is not None and not is_leader and not synced:
             if now - self._last_model_request > _MODEL_REQUEST_RETRY:
@@ -535,11 +556,20 @@ class Accumulator:
                 self._state_requesters.append(requester)
         return True
 
-    def _on_model_update(self, version: int, params, buffers, state):
+    def _on_model_update(self, epoch, version: int, params, buffers, state):
         with self._lock:
-            if version < self._model_version:
+            # Pushes are epoch-stamped by the sender: a delayed push from a
+            # previous epoch's leader must never land in the new epoch.
+            if epoch != self._group.sync_id():
                 return False
-            self._staged_model = (version, params, buffers, state)
+            # Reject stale periodic pushes only once synced. An UNSYNCED peer
+            # adopts the elected leader's model even if its own version is
+            # higher: a round applied in the epoch-change window can orphan a
+            # local version the cohort never shared, and refusing the leader
+            # would wedge this peer out of the epoch forever.
+            if self._epoch_synced and version < self._model_version:
+                return False
+            self._staged_model = (epoch, version, params, buffers, state)
         return True
 
     def _on_buffers_update(self, version: int, buffers):
@@ -552,12 +582,14 @@ class Accumulator:
         with self._lock:
             members = [m for m in self._group.members() if m != self._rpc.get_name()]
             params, buffers, version = self._params, self._buffers, self._model_version
+            epoch = self._group.sync_id()
         for peer in members:
             self._rpc.async_callback(
                 peer,
                 "__accum_model_update",
                 lambda r, e: None,
                 self._name,
+                epoch,
                 version,
                 params,
                 buffers,

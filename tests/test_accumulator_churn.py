@@ -17,6 +17,17 @@ def pump_all(broker, accs):
             a.set_state({"tag": a._rpc.get_name()})
 
 
+LR = 0.1
+
+
+def apply_step(a):
+    """Consume a finished reduction: one SGD step + version bump."""
+    g = a.gradients()
+    p = a.parameters()
+    a.set_parameters({"w": p["w"] - LR * g["w"]})
+    a.zero_gradients()
+
+
 def wait_until(broker, accs, seconds, cond):
     deadline = time.time() + seconds
     while time.time() < deadline:
@@ -34,6 +45,10 @@ def make_acc(name, addr, w0):
     a._rpc.listen("127.0.0.1:0")
     a.set_parallel_gradients(2)
     a.set_wire_dtype("int8")
+    # A pipelined round whose peers stopped contributing resolves via the
+    # group op timeout (elastic semantics — same as the reference's
+    # allreduce timeouts). Default is 60 s; keep the test snappy.
+    a._group.set_timeout(8.0)
     a.connect(addr)
     return a
 
@@ -54,7 +69,6 @@ def test_pipelined_int8_with_churn(free_port):
         # Drive a training-ish loop; after enough steps, kill one peer, keep
         # looping, then add a fresh one. Gradient = current params (so the
         # quadratic shrinks and any wire corruption shows up as divergence).
-        LR = 0.1
         steps = {id(a): 0 for a in accs}
         killed = rejoined = False
         deadline = time.time() + 240
@@ -62,10 +76,7 @@ def test_pipelined_int8_with_churn(free_port):
             pump_all(broker, accs)
             for a in list(accs):
                 if a.has_gradients():
-                    g = a.gradients()
-                    p = a.parameters()
-                    a.set_parameters({"w": p["w"] - LR * g["w"]})
-                    a.zero_gradients()
+                    apply_step(a)
                     steps[id(a)] = steps.get(id(a), 0) + 1
                 elif a.wants_gradients():
                     a.reduce_gradients(1, {"w": a.parameters()["w"].copy()})
@@ -87,7 +98,27 @@ def test_pipelined_int8_with_churn(free_port):
             f"steps={[steps.get(id(a), 0) for a in accs]} "
             f"connected={[a.connected() for a in accs]}"
         )
-        assert all(a.connected() for a in accs)
+        # Settle: connected() is transiently false mid-epoch, and a peer may
+        # still hold an unapplied in-flight/pending round — drain everything
+        # (applying results, contributing nothing new) so every peer has
+        # applied the same round sequence before comparing parameters.
+        settle_deadline = time.time() + 60
+        def fully_settled():
+            return (
+                all(a.connected() for a in accs)
+                and not any(a._inflight for a in accs)
+                and not any(a.has_gradients() for a in accs)
+            )
+        while time.time() < settle_deadline and not fully_settled():
+            pump_all(broker, accs)
+            for a in accs:
+                if a.has_gradients():
+                    apply_step(a)
+            time.sleep(0.01)
+        assert fully_settled(), (
+            f"cohort never settled: connected={[a.connected() for a in accs]} "
+            f"inflight={[len(a._inflight) for a in accs]}"
+        )
         # Everyone (including the late joiner, which synced the model) holds
         # identical parameters, and the quadratic went DOWN from the start.
         w_ref = np.asarray(accs[0].parameters()["w"])

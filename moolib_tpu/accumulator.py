@@ -63,15 +63,29 @@ def _tree_zeros_like(t):
 
 
 class _Round:
-    """One in-flight gradient reduction round."""
+    """One in-flight reduction round.
 
-    __slots__ = ("future", "done", "result", "error")
+    ``kind`` is one of:
+      - ``"full"``  — single-phase: gradients + counts in one allreduce
+        (used when no virtual batch size is set: one round, fires directly).
+      - ``"count"`` — two-phase, phase 1: counts only (3 ints on the wire);
+        ``local`` holds this peer's f32 gradient contribution, folded into
+        the pending fire accumulator when the count result is applied.
+      - ``"grad"``  — two-phase, phase 2: the one gradient allreduce per
+        virtual batch; ``stats`` is the fire-time global-count snapshot
+        (identical on every peer — derived from identical count results).
+    """
 
-    def __init__(self, future):
+    __slots__ = ("future", "done", "result", "error", "kind", "local", "stats")
+
+    def __init__(self, future, kind="full", local=None, stats=None):
         self.future = future
         self.done = False
         self.result = None
         self.error = None
+        self.kind = kind
+        self.local = local
+        self.stats = stats
 
 
 class Accumulator:
@@ -106,6 +120,7 @@ class Accumulator:
         self._election_future = None
         self._epoch_synced = False  # got (or am serving) the model this epoch
         self._staged_model = None  # incoming model update awaiting commit
+        self._buffers_version = -1  # last applied buffers-push version
         self._last_model_request = 0.0
         self._last_model_push = 0.0
         self._last_buffers_push = 0.0
@@ -129,6 +144,10 @@ class Accumulator:
         self._inflight: collections.deque = collections.deque()
         self._accum_grads = None
         self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+        # Two-phase virtual batching (reference src/accumulator.cc:1005-1078):
+        # local f32 gradient sum + global counts pending the next fire.
+        self._fire_accum = None
+        self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
         self._grad_dtypes = None
         self._has_gradients = False
         self._result_grads = None
@@ -290,14 +309,31 @@ class Accumulator:
 
     def reduce_gradients(self, batch_size: int, gradients=None) -> None:
         """Contribute local gradients (a pytree) with their batch size and
-        start/continue the asynchronous cohort reduction."""
+        start/continue the asynchronous cohort reduction.
+
+        With a virtual batch size set, only the *count* (3 ints) goes on the
+        wire per contribution; gradients accumulate locally in f32 and ship in
+        ONE allreduce once the global count meets ``virtual_batch_size``
+        (reference two-phase protocol, ``src/accumulator.cc:1005-1078``).
+        """
         if gradients is None:
             raise ValueError(
                 "jax adaptation: pass the gradient pytree explicitly, "
                 "reduce_gradients(batch_size, gradients)"
             )
+        stats = {"num_gradients": 1, "num_skipped": 0, "batch_size": int(batch_size)}
+        if self._virtual_batch_size is not None:
+            # Remember the true dtypes so gradients() can restore them (local
+            # accumulation is in f32).
+            self._grad_dtypes = jax.tree_util.tree_map(
+                lambda g: np.asarray(g).dtype, gradients
+            )
+            local = jax.tree_util.tree_map(
+                lambda g: np.asarray(g, np.float32), gradients
+            )
+            self._start_round("count", stats, local)
+            return
         if self._wire_dtype is not None:
-            # Remember the true dtypes so gradients() can restore them.
             self._grad_dtypes = jax.tree_util.tree_map(
                 lambda g: np.asarray(g).dtype, gradients
             )
@@ -308,29 +344,15 @@ class Accumulator:
             gradients = jax.tree_util.tree_map(
                 lambda g: np.asarray(g).astype(wd), gradients
             )
-        self._start_round(
-            {
-                "num_gradients": 1,
-                "num_skipped": 0,
-                "batch_size": int(batch_size),
-                "wire": np.dtype(self._wire_dtype).name if self._wire_dtype else None,
-            },
-            gradients,
-        )
+        self._start_round("full", stats, gradients)
 
     def skip_gradients(self) -> None:
         """Participate in this reduction round without contributing data."""
-        self._start_round(
-            {
-                "num_gradients": 0,
-                "num_skipped": 1,
-                "batch_size": 0,
-                "wire": np.dtype(self._wire_dtype).name if self._wire_dtype else None,
-            },
-            None,
-        )
+        stats = {"num_gradients": 0, "num_skipped": 1, "batch_size": 0}
+        kind = "count" if self._virtual_batch_size is not None else "full"
+        self._start_round(kind, stats, None)
 
-    def _start_round(self, stats: Dict[str, int], gradients):
+    def _start_round(self, kind: str, stats: Dict[str, int], gradients):
         with self._lock:
             if not self.connected():
                 # The epoch can change between the caller's wants_gradients()
@@ -349,17 +371,60 @@ class Accumulator:
                 )
             if self._has_gradients:
                 raise RpcError("unconsumed gradients; call zero_gradients() first")
-            payload = {
-                "grads": gradients,
-                "num_gradients": stats["num_gradients"],
-                "num_skipped": stats["num_skipped"],
-                "batch_size": stats["batch_size"],
-                "wire": stats.get("wire"),
-            }
-            fut = self._group.all_reduce(f"__accum_grad:{self._name}", payload, op=_grad_reduce_op)
-            round_ = _Round(fut)
+            if kind == "count":
+                fut = self._group.all_reduce(
+                    f"__accum_count:{self._name}", dict(stats), op=_count_reduce_op
+                )
+                round_ = _Round(fut, kind="count", local=gradients)
+            else:
+                payload = {
+                    "grads": gradients,
+                    "num_gradients": stats["num_gradients"],
+                    "num_skipped": stats["num_skipped"],
+                    "batch_size": stats["batch_size"],
+                    "wire": np.dtype(self._wire_dtype).name if self._wire_dtype else None,
+                }
+                fut = self._group.all_reduce(
+                    f"__accum_grad:{self._name}",
+                    payload,
+                    op=_grad_reduce_op,
+                    finalize=_wire_finalize(payload["wire"]),
+                )
+                round_ = _Round(fut, kind="full")
             self._inflight.append(round_)
             fut.add_done_callback(lambda f, r=round_: self._on_round_done(r, f))
+
+    def _fire_grad_round_locked(self):
+        """Two-phase, phase 2: the global count met the virtual batch size —
+        ship the locally-accumulated gradient sum in ONE allreduce.  Every
+        peer reaches this decision at the same count-round index (the count
+        results are identical cohort-wide), so the op sequence matches."""
+        grads = self._fire_accum
+        wire_name = np.dtype(self._wire_dtype).name if self._wire_dtype is not None else None
+        if grads is not None:
+            if self._wire_q8:
+                grads, self._q_residual = _quantize_q8(grads, self._q_residual)
+            elif self._wire_dtype is not None:
+                wd = self._wire_dtype
+                grads = jax.tree_util.tree_map(lambda g: g.astype(wd), grads)
+        payload = {
+            "grads": grads,
+            "num_gradients": 0,
+            "num_skipped": 0,
+            "batch_size": 0,
+            "wire": wire_name,
+        }
+        fut = self._group.all_reduce(
+            f"__accum_grad:{self._name}",
+            payload,
+            op=_grad_reduce_op,
+            finalize=_wire_finalize(wire_name),
+        )
+        round_ = _Round(fut, kind="grad", stats=dict(self._fire_stats))
+        self._fire_accum = None
+        self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+        self._inflight.append(round_)
+        fut.add_done_callback(lambda f, r=round_: self._on_round_done(r, f))
 
     def _on_round_done(self, round_, fut):
         with self._lock:
@@ -388,13 +453,43 @@ class Accumulator:
                 break  # result pending consumption; apply after zero_gradients
             round_ = self._inflight.popleft()
             result = round_.result
-            # Accumulate across rounds until the virtual batch size is met
-            # (in f32 when wire compression is on, to avoid absorption).
-            rg = result["grads"]
-            if rg is not None and _is_q8(rg):
-                rg = _dequantize_q8(rg)
-            elif rg is not None and self._wire_dtype is not None:
-                rg = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), rg)
+            if round_.kind == "count":
+                # Phase 1 applied in issue order: fold this peer's local f32
+                # contribution and the cohort-wide counts; fire the single
+                # gradient allreduce once the virtual batch is met.
+                if round_.local is not None:
+                    if self._fire_accum is None:
+                        self._fire_accum = round_.local
+                    else:
+                        self._fire_accum = _tree_add(self._fire_accum, round_.local)
+                for k in ("num_gradients", "num_skipped", "batch_size"):
+                    self._fire_stats[k] += result[k]
+                target = self._virtual_batch_size or 1
+                if (
+                    self._fire_stats["batch_size"] >= target
+                    and self._fire_stats["num_gradients"] > 0
+                ):
+                    self._fire_grad_round_locked()
+                continue
+            if round_.kind == "grad":
+                # Phase 2 result: the cohort gradient sum for one virtual batch.
+                rg = _grads_to_f32(result)
+                n = round_.stats["num_gradients"]
+                if rg is not None:
+                    if self._grad_dtypes is not None:
+                        self._result_grads = jax.tree_util.tree_map(
+                            lambda x, dt: (x / n).astype(dt), rg, self._grad_dtypes
+                        )
+                    else:
+                        self._result_grads = jax.tree_util.tree_map(lambda x: x / n, rg)
+                    self._result_stats = dict(round_.stats)
+                    self._result_epoch = self._group.sync_id()
+                    self._has_gradients = True
+                continue
+            # kind == "full": single-phase — accumulate across rounds until
+            # the (trivial) target is met, in f32 when compression is on
+            # (_grads_to_f32 also dequantizes q8 payloads).
+            rg = _grads_to_f32(result) if result.get("wire") else result["grads"]
             if self._accum_grads is None and rg is not None:
                 self._accum_grads = rg
             elif rg is not None:
@@ -474,6 +569,7 @@ class Accumulator:
                     self._params = params
                     if buffers is not None:
                         self._buffers = buffers
+                        self._buffers_version = version
                     self._model_version = version
                     if state is not None:
                         self._received_state = state
@@ -510,11 +606,14 @@ class Accumulator:
             self._is_leader = False
             self._epoch_synced = False
             self._staged_model = None
+            self._buffers_version = -1
             # Old-epoch rounds are dead; their futures error via the Group's
             # cancel, but the records must go now so new rounds can start.
             self._inflight.clear()
             self._accum_grads = None
             self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+            self._fire_accum = None
+            self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
             if not self._group.active():
                 return
             fut = self._group.all_reduce(
@@ -572,10 +671,20 @@ class Accumulator:
             self._staged_model = (epoch, version, params, buffers, state)
         return True
 
-    def _on_buffers_update(self, version: int, buffers):
+    def _on_buffers_update(self, epoch, version: int, buffers):
         with self._lock:
+            # Stamped like model pushes: a delayed periodic push from a
+            # previous epoch's leader (or a stale in-flight push during
+            # leader change) must not overwrite newer buffers. The guard
+            # compares against the last *applied* buffers version, not our
+            # model version — the follower's own counter can transiently run
+            # ahead of the leader's (it consumed a result first), and that
+            # must not reject fresh same-epoch pushes.
+            if epoch != self._group.sync_id() or version < self._buffers_version:
+                return False
             if buffers is not None:
                 self._buffers = buffers
+                self._buffers_version = version
         return True
 
     def _broadcast_model(self):
@@ -600,12 +709,14 @@ class Accumulator:
         with self._lock:
             members = [m for m in self._group.members() if m != self._rpc.get_name()]
             buffers, version = self._buffers, self._model_version
+            epoch = self._group.sync_id()
         for peer in members:
             self._rpc.async_callback(
                 peer,
                 "__accum_buffers_update",
                 lambda r, e: None,
                 self._name,
+                epoch,
                 version,
                 buffers,
             )
@@ -670,48 +781,77 @@ def _q8_add(a, b):
     return _quantize_q8(_tree_add(_dequantize_q8(a), _dequantize_q8(b)), None)[0]
 
 
+def _count_reduce_op(a, b):
+    """Two-phase phase-1 op: sum the three count fields (3 ints on the wire
+    per contribution — the reference's cheap count allreduce,
+    ``src/accumulator.cc:1035-1078``)."""
+    return {k: a[k] + b[k] for k in ("num_gradients", "num_skipped", "batch_size")}
+
+
+def _grads_to_f32(p):
+    """The gradient tree of a payload/partial, as float32 (None for skips)."""
+    g = p.get("grads")
+    if g is None:
+        return None
+    if _is_q8(g):
+        return _dequantize_q8(g)
+    return jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), g)
+
+
+def _wire_finalize(wire):
+    """Group ``finalize`` hook: re-round a node's f32 partial sum to the wire
+    dtype once per hop.  Together with ``_grad_reduce_op`` accumulating in
+    f32, this gives log2(n) roundings instead of n-1 lossy adds, so small
+    contributions are never absorbed by a large running sum (the documented
+    wire-compression contract).  Returns None (no hook) when uncompressed."""
+    if wire is None:
+        return None
+    wd = np.dtype(wire)
+
+    def finalize(p):
+        if not (isinstance(p, dict) and p.get("fmt") == "f32"):
+            return p  # leaf pass-through: already in wire format
+        p = dict(p)
+        p.pop("fmt")
+        g = p.get("grads")
+        if g is not None:
+            if wd == np.int8:
+                p["grads"] = _quantize_q8(g, None)[0]
+            else:
+                p["grads"] = jax.tree_util.tree_map(lambda x: x.astype(wd), g)
+        return p
+
+    return finalize
+
+
 def _grad_reduce_op(a, b):
     """Reduce two gradient-round payloads: counts add, grad pytrees add
     (None = a skip contribution).
 
-    Wire compression: leaves arrive in the wire dtype (e.g. bf16/int8) but
-    each hop accumulates in float32 and re-rounds the partial sum to the
-    wire dtype before it travels on — log2(n) roundings instead of n-1
-    lossy adds, so small contributions are never absorbed by a large
-    running sum.
+    Wire compression: leaves arrive in the wire dtype (e.g. bf16/int8); the
+    partial sum is kept in float32 (marked ``fmt: "f32"``) while the node
+    reduces, and ``_wire_finalize`` re-rounds it to the wire dtype before it
+    travels on.  ml_dtypes' bfloat16 has dtype kind 'V', so the gate is
+    "wire set" rather than any dtype-kind test.
     """
     if isinstance(a, dict) and "num_gradients" in a:
-        ga, gb = a.get("grads"), b.get("grads")
         wire = a.get("wire") or b.get("wire")
-        if ga is None:
-            grads = gb
-        elif gb is None:
-            grads = ga
-        elif _is_q8(ga) and _is_q8(gb):
-            grads = _q8_add(ga, gb)
-        else:
-            # Mixed wire configs in one elastic cohort (e.g. one peer on
-            # int8, one uncompressed): fall back to f32 — never cast an
-            # unscaled sum to int8.
-            if _is_q8(ga):
-                ga = _dequantize_q8(ga)
-            if _is_q8(gb):
-                gb = _dequantize_q8(gb)
-            if wire is not None and np.dtype(wire).kind == "f":
-                grads = jax.tree_util.tree_map(
-                    lambda x, y: (
-                        np.asarray(x, np.float32) + np.asarray(y, np.float32)
-                    ).astype(np.dtype(wire)),
-                    ga,
-                    gb,
-                )
-            else:
-                grads = _tree_add(ga, gb)
-        return {
-            "grads": grads,
+        out = {
             "num_gradients": a["num_gradients"] + b["num_gradients"],
             "num_skipped": a["num_skipped"] + b["num_skipped"],
             "batch_size": a["batch_size"] + b["batch_size"],
             "wire": wire,
         }
+        if wire is not None:
+            # Accumulate in f32; finalize re-rounds once per hop. Mixed wire
+            # configs in one elastic cohort also land here (never cast an
+            # unscaled sum to int8 — q8 re-quantization carries its scale).
+            fa, fb = _grads_to_f32(a), _grads_to_f32(b)
+            grads = fa if fb is None else (fb if fa is None else _tree_add(fa, fb))
+            out["grads"] = grads
+            out["fmt"] = "f32"
+        else:
+            ga, gb = a.get("grads"), b.get("grads")
+            out["grads"] = ga if gb is None else (gb if ga is None else _tree_add(ga, gb))
+        return out
     return a + b

@@ -13,6 +13,7 @@ Run standalone with ``python -m moolib_tpu.broker``.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Optional
 
@@ -40,6 +41,10 @@ class Broker:
         self._rpc = rpc if rpc is not None else Rpc()
         self._groups: Dict[str, _BrokerGroup] = {}
         self._timeout = 10.0
+        # _on_ping/_on_resync run on the Rpc handler thread pool, concurrently
+        # with update() on the caller thread; all group/member/sync_id state is
+        # guarded here (push RPCs are issued outside the lock).
+        self._lock = threading.Lock()
         self._rpc.define("__broker_ping", self._on_ping)
         self._rpc.define("__broker_resync", self._on_resync)
 
@@ -64,71 +69,79 @@ class Broker:
 
     # service -----------------------------------------------------------------
     def _on_ping(self, group_name: str, peer_name: str, sort_order: int, client_sync_id):
-        g = self._groups.setdefault(group_name, _BrokerGroup(group_name))
-        # Stateless restart safety: clients ignore epoch pushes that don't
-        # EXCEED their current sync_id, so a freshly-restarted broker must
-        # jump past any epoch still alive in the cohort. Wall-clock seeding
-        # usually guarantees that; a pinged-in higher sync_id (clock skew,
-        # regressed clock) covers the rest.
-        if client_sync_id is not None and client_sync_id > g.sync_id:
-            g.sync_id = int(client_sync_id) + 1
-            g.needs_update = True
-        m = g.members.get(peer_name)
-        if m is None:
-            g.members[peer_name] = {"last_ping": time.monotonic(), "sort_order": sort_order}
-            g.needs_update = True
-        else:
-            m["last_ping"] = time.monotonic()
-            m["sort_order"] = sort_order
-        return {"sync_id": g.sync_id, "timeout": self._timeout}
+        with self._lock:
+            g = self._groups.setdefault(group_name, _BrokerGroup(group_name))
+            # Stateless restart safety: clients ignore epoch pushes that don't
+            # EXCEED their current sync_id, so a freshly-restarted broker must
+            # jump past any epoch still alive in the cohort. Wall-clock seeding
+            # usually guarantees that; a pinged-in higher sync_id (clock skew,
+            # regressed clock) covers the rest.
+            if client_sync_id is not None and client_sync_id > g.sync_id:
+                g.sync_id = int(client_sync_id) + 1
+                g.needs_update = True
+            m = g.members.get(peer_name)
+            if m is None:
+                g.members[peer_name] = {"last_ping": time.monotonic(), "sort_order": sort_order}
+                g.needs_update = True
+            else:
+                m["last_ping"] = time.monotonic()
+                m["sort_order"] = sort_order
+            return {"sync_id": g.sync_id, "timeout": self._timeout}
 
     def _on_resync(self, group_name: str, peer_name: str):
         """A client whose sync_id went stale asks for the member list again."""
-        g = self._groups.get(group_name)
-        if g is None:
-            return None
-        self._push_to(g, peer_name)
-        return {"sync_id": g.sync_id}
+        with self._lock:
+            g = self._groups.get(group_name)
+            if g is None:
+                return None
+            push = (g.name, g.sync_id, list(g.active_members))
+        self._push_to(peer_name, *push)
+        return {"sync_id": push[1]}
 
     # pump --------------------------------------------------------------------
     def update(self) -> None:
         """Evict silent peers and push membership epochs. Call regularly
         (~0.25 s cadence, reference ``py/moolib/broker.py:31-36``)."""
         now = time.monotonic()
-        for g in self._groups.values():
-            evicted = [
-                name
-                for name, m in g.members.items()
-                if now - m["last_ping"] > self._timeout
-            ]
-            for name in evicted:
-                del g.members[name]
-                g.needs_update = True
-            # Rate-limit epoch bumps (reference: 2 s; we use 0.5 s so tests
-            # with churn settle fast).
-            if g.needs_update and now - g.last_update > 0.5:
-                g.needs_update = False
-                g.last_update = now
-                g.sync_id += 1
-                g.active_members = sorted(
-                    g.members, key=lambda n: (g.members[n]["sort_order"], n)
-                )
-                utils.log_info(
-                    "broker: group %s sync_id=%d members=%s",
-                    g.name,
-                    g.sync_id,
-                    g.active_members,
-                )
-                for name in g.active_members:
-                    self._push_to(g, name)
+        pushes = []
+        with self._lock:
+            for g in self._groups.values():
+                evicted = [
+                    name
+                    for name, m in g.members.items()
+                    if now - m["last_ping"] > self._timeout
+                ]
+                for name in evicted:
+                    del g.members[name]
+                    g.needs_update = True
+                # Rate-limit epoch bumps (reference: 2 s; we use 0.5 s so tests
+                # with churn settle fast).
+                if g.needs_update and now - g.last_update > 0.5:
+                    g.needs_update = False
+                    g.last_update = now
+                    g.sync_id += 1
+                    g.active_members = sorted(
+                        g.members, key=lambda n: (g.members[n]["sort_order"], n)
+                    )
+                    utils.log_info(
+                        "broker: group %s sync_id=%d members=%s",
+                        g.name,
+                        g.sync_id,
+                        g.active_members,
+                    )
+                    members = list(g.active_members)
+                    for name in members:
+                        pushes.append((name, g.name, g.sync_id, members))
+        for push in pushes:
+            self._push_to(*push)
 
-    def _push_to(self, g: _BrokerGroup, peer_name: str) -> None:
+    def _push_to(self, peer_name: str, group_name: str, sync_id: int, members: list) -> None:
         def _ignore(result, error):
             if error is not None:
                 utils.log_verbose("broker: push to %s failed: %s", peer_name, error)
 
         self._rpc.async_callback(
-            peer_name, "__group_update", _ignore, g.name, g.sync_id, list(g.active_members)
+            peer_name, "__group_update", _ignore, group_name, sync_id, members
         )
 
     def close(self) -> None:

@@ -56,12 +56,13 @@ class AllReduce(Future):
 
 
 class _Op:
-    __slots__ = ("key", "value", "op", "future", "contribs", "sent_up", "started_at")
+    __slots__ = ("key", "value", "op", "finalize", "future", "contribs", "sent_up", "started_at")
 
-    def __init__(self, key, value, op, future):
+    def __init__(self, key, value, op, finalize, future):
         self.key = key
         self.value = value
         self.op = op
+        self.finalize = finalize
         self.future = future
         self.contribs: List[Any] = []
         self.sent_up = False
@@ -243,9 +244,15 @@ class Group:
         return idx, parent, children
 
     # -------------------------------------------------------------- allreduce
-    def all_reduce(self, name: str, value, op="sum") -> AllReduce:
+    def all_reduce(self, name: str, value, op="sum", finalize=None) -> AllReduce:
         """Start an allreduce of ``value`` under ``name``; all active members
-        must call with the same name (and call order per name)."""
+        must call with the same name (and call order per name).
+
+        ``finalize``, if given, is applied to a tree node's reduced partial
+        before it travels on the wire (and to the root's final result).  This
+        lets an op accumulate in a wide dtype at each hop and re-round only
+        once per hop — the Accumulator's wire-compression contract.
+        """
         future = AllReduce()
         reduce_fn = _resolve_op(op)
         with self._lock:
@@ -259,7 +266,7 @@ class Group:
             if len(self._members) == 1:
                 future.set_result(value)
                 return future
-            opstate = _Op(key, value, reduce_fn, future)
+            opstate = _Op(key, value, reduce_fn, finalize, future)
             self._ops[key] = opstate
             parked = self._parked.pop(key, [])
             opstate.contribs.extend(parked)
@@ -291,6 +298,8 @@ class Group:
         total = op.value
         for c in op.contribs[: len(children)]:
             total = op.op(total, c)
+        if op.finalize is not None:
+            total = op.finalize(total)
         op.sent_up = True
         if parent is None:
             # Root: reduction complete — share down the tree.

@@ -233,3 +233,32 @@ def test_leader_death_reelection(free_port):
         assert len(leaders) == 1
     finally:
         close_all(broker, accs)
+
+
+def test_stale_buffers_push_rejected(free_port):
+    """ADVICE round-1 (low): buffers pushes are epoch+version stamped; a
+    delayed push from a previous epoch's leader must not overwrite newer
+    buffers."""
+    broker, accs = make_cohort(free_port, 2)
+    try:
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        follower = [a for a in accs if not a.is_leader()][0]
+        follower.set_buffers({"bn": np.ones(2, np.float32)})
+        epoch = follower._group.sync_id()
+        # Stale epoch: dropped.
+        assert follower._on_buffers_update(epoch - 1, 7, {"bn": np.zeros(2)}) is False
+        np.testing.assert_allclose(follower.buffers()["bn"], 1.0)
+        # Fresh push: applied (guard tracks the last APPLIED buffers version,
+        # not our model version — the follower's counter can transiently run
+        # ahead of the leader's after consuming a result first).
+        follower._model_version = 99
+        assert follower._on_buffers_update(epoch, 7, {"bn": np.full(2, 3.0, np.float32)}) is True
+        np.testing.assert_allclose(follower.buffers()["bn"], 3.0)
+        # Older than the applied one: dropped.
+        assert follower._on_buffers_update(epoch, 6, {"bn": np.zeros(2)}) is False
+        np.testing.assert_allclose(follower.buffers()["bn"], 3.0)
+        # Same-version periodic re-push: applied (leader re-sends every 12 s).
+        assert follower._on_buffers_update(epoch, 7, {"bn": np.full(2, 4.0, np.float32)}) is True
+        np.testing.assert_allclose(follower.buffers()["bn"], 4.0)
+    finally:
+        close_all(broker, accs)

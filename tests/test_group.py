@@ -238,3 +238,54 @@ def test_single_member_group(free_port):
         assert f.result(5) == 42
     finally:
         close_all(broker, peers)
+
+
+def test_broker_concurrent_ping_update_hammer():
+    """ADVICE round-1 (high): _on_ping/_on_resync run on the Rpc executor pool
+    concurrently with update() on the caller thread; without the broker lock
+    this raises 'dictionary changed size during iteration' and can lose the
+    strictly-newer-epoch guarantee."""
+    import threading
+
+    from moolib_tpu.broker import Broker
+
+    broker = Broker.__new__(Broker)  # no Rpc: drive handlers directly
+    broker._groups = {}
+    broker._timeout = 0.05  # evict aggressively so update() mutates members
+    broker._lock = threading.Lock()
+    pushes = []
+    broker._push_to = lambda *a: pushes.append(a)
+
+    stop = threading.Event()
+    errors = []
+
+    def pinger(tid):
+        i = 0
+        try:
+            while not stop.is_set():
+                broker._on_ping("g", f"peer{tid}_{i % 50}", tid, None)
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=pinger, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 2.0
+        last_sync = None
+        while time.time() < deadline:
+            broker.update()
+            g = broker._groups.get("g")
+            if g is not None:
+                # Epoch must be monotonic under concurrency.
+                if last_sync is not None:
+                    assert g.sync_id >= last_sync
+                last_sync = g.sync_id
+    except Exception as e:  # noqa: BLE001
+        errors.append(e)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors

@@ -53,14 +53,23 @@ from . import serialization
 # (reference kSignature, src/rpc.cc:810). Bumped when wire behavior changes
 # incompatibly (0002: keepalive ping/pong + activity-based teardown; 0003:
 # max-(initiator_uid, dial_seq) duplicate-connection tie-break — mixed
-# versions would deterministically keep DIFFERENT duplicates and flap).
-SIGNATURE = 0x6D6F6F5450550003
+# versions would deterministically keep DIFFERENT duplicates and flap;
+# 0004: poke/ack/nack fast recovery frames).
+SIGNATURE = 0x6D6F6F5450550004
 
 KIND_GREETING = 1
 KIND_REQUEST = 2
 KIND_RESPONSE = 3
 KIND_ERROR = 4
 KIND_KEEPALIVE = 5
+# Fast recovery (reference poke/ack/nack, src/rpc.cc:2526-2703): after a
+# short silence the sender POKEs ("do you have rid X?"); the receiver
+# re-sends the cached response, ACKs ("executing"), or NACKs ("never saw
+# it") — a NACK triggers an immediate resend, so a dropped frame recovers at
+# RTT scale instead of blind-resend scale.
+KIND_POKE = 6
+KIND_ACK = 7
+KIND_NACK = 8
 
 _DEFAULT_TIMEOUT = 120.0
 # Keepalive cadence (reference: keepalives after idle, teardown of
@@ -70,6 +79,11 @@ _DEFAULT_TIMEOUT = 120.0
 _KEEPALIVE_IDLE = 4.0
 _KEEPALIVE_INTERVAL = 2.0
 _CONN_DEAD = 16.0
+# Fast-recovery cadence: poke a silent rid after _POKE_AFTER; blind-resend
+# the full request only if nothing (ack/nack/response) came back for
+# _RESEND_BLIND — the fallback for lost control frames.
+_POKE_AFTER = 0.75
+_RESEND_BLIND = 9.0
 
 
 class RpcError(RuntimeError):
@@ -412,6 +426,8 @@ class _Outgoing:
         "timeout_s",
         "resent",
         "parked",
+        "last_probe",
+        "acked_at",
     )
 
     def __init__(self, rid, peer_name, fn_name, chunks, payload_obj, future, deadline):
@@ -427,6 +443,8 @@ class _Outgoing:
         self.timeout_s = _DEFAULT_TIMEOUT
         self.resent = False  # RTT samples from resent requests are ambiguous
         self.parked = False  # already waiting in peer.pending
+        self.last_probe = 0.0  # last POKE sent for this rid
+        self.acked_at = 0.0  # receiver confirmed it is executing
 
 
 class _FnDef:
@@ -567,6 +585,7 @@ class Rpc:
         self._rid = itertools.count(1)
         self._dial_seq = itertools.count(1)
         self._outgoing: Dict[int, _Outgoing] = {}
+        self._nacks_recovered = 0  # requests resent on receiver NACK
         self._closed = False
         self._functions["__moolib_find_peer"] = _FnDef(
             "__moolib_find_peer", self._find_peer_handler, "plain"
@@ -779,7 +798,10 @@ class Rpc:
                     f"    {t}: sent={c.send_count} recv={c.recv_count} latency={lat}"
                     f" age={time.monotonic()-c.created:.1f}s closed={c.closed}"
                 )
-        lines.append(f"  outstanding={len(self._outgoing)} functions={list(self._functions)}")
+        lines.append(
+            f"  outstanding={len(self._outgoing)} nacks_recovered={self._nacks_recovered}"
+            f" functions={list(self._functions)}"
+        )
         return "\n".join(lines)
 
     def close(self) -> None:
@@ -852,6 +874,18 @@ class Rpc:
                     self._try_send(out)
 
         self._call_in_loop(_do)
+
+    def _send_poke(self, out: _Outgoing):
+        # Caller holds self._state. Pokes are best-effort: if there is no
+        # live connection, the greeting-time resend path owns recovery.
+        peer = self._peers.get(out.peer_name)
+        conn = peer.best_connection(self._transport_order) if peer else None
+        if conn is None:
+            return
+        try:
+            conn.send_frame([struct.pack("<BQ", KIND_POKE, out.rid)])
+        except Exception:
+            conn.close()
 
     def _try_send(self, out: _Outgoing):
         # Caller holds self._state.
@@ -1152,8 +1186,52 @@ class Rpc:
                     conn.send_frame([struct.pack("<BB", KIND_KEEPALIVE, 1)])
                 except Exception:
                     conn.close()
+        elif kind == KIND_POKE:
+            self._on_poke(conn, frame)
+        elif kind == KIND_ACK:
+            self._on_ack(frame)
+        elif kind == KIND_NACK:
+            self._on_nack(frame)
         else:
             utils.log_error("rpc: unknown frame kind %d", kind)
+
+    def _on_poke(self, conn: _Connection, frame: bytes):
+        """Receiver side of fast recovery: the sender suspects loss on rid.
+        Cached response → resend it; executing → ACK; unknown → NACK (the
+        request frame died — sender resends immediately)."""
+        (rid,) = struct.unpack_from("<Q", frame, 1)
+        reply = None
+        with self._state:
+            peer = self._peers.get(conn.peer_name) if conn.peer_name else None
+            if peer is not None:
+                cached = peer.recent.get(rid)
+                if cached is not None:
+                    reply = cached[1]
+                elif rid in peer.executing:
+                    reply = [struct.pack("<BQ", KIND_ACK, rid)]
+                else:
+                    reply = [struct.pack("<BQ", KIND_NACK, rid)]
+        if reply is not None:
+            try:
+                conn.send_frame(reply)
+            except Exception:
+                conn.close()
+
+    def _on_ack(self, frame: bytes):
+        (rid,) = struct.unpack_from("<Q", frame, 1)
+        with self._state:
+            out = self._outgoing.get(rid)
+            if out is not None:
+                out.acked_at = time.monotonic()
+
+    def _on_nack(self, frame: bytes):
+        (rid,) = struct.unpack_from("<Q", frame, 1)
+        with self._state:
+            out = self._outgoing.get(rid)
+            if out is not None:
+                self._nacks_recovered += 1
+                out.resent = True
+                self._try_send(out)
 
     def _on_greeting(self, conn: _Connection, frame: bytes):
         info = serialization.loads(memoryview(frame)[1:])
@@ -1393,16 +1471,22 @@ class Rpc:
                 )
             hunts = []
             with self._state:
-                # Periodic resend of stale outstanding requests (the analogue
-                # of the reference's poke/nack cycle, src/rpc.cc:2526-2703): a
-                # response can die on a half-dead socket after our
-                # greeting-time resend; receiver dedup returns the cached
-                # response.
+                # Fast recovery (reference poke/ack/nack, src/rpc.cc:2526-2703):
+                # after _POKE_AFTER of silence on a rid, send a tiny POKE; a
+                # NACK resends immediately (RTT-scale recovery), an ACK means
+                # the handler is still running, a cached response is re-sent
+                # by the receiver. The blind full resend remains as a fallback
+                # for the case where the poke/nack frames themselves died.
                 for out in list(self._outgoing.values()):
-                    if now - out.sent_at > 3.0:
+                    if now - out.sent_at > _RESEND_BLIND:
                         out.resent = True  # RTT no longer a clean sample
                         self._try_send(out)
                         out.sent_at = now
+                        continue
+                    last = max(out.sent_at, out.last_probe, out.acked_at)
+                    if now - last > _POKE_AFTER:
+                        out.last_probe = now
+                        self._send_poke(out)
                 # Prune dead entries from pending queues (their futures
                 # already timed out); park flags reset so nothing leaks
                 # against a peer that never comes back.

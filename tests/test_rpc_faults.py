@@ -189,3 +189,84 @@ def test_timeout_when_peer_dead(chaos_pair):
     fut = client.async_("host", "noop")
     with pytest.raises(RpcError, match="timed out"):
         fut.result()
+
+
+def test_nack_fast_recovery_of_dropped_request(free_port):
+    """VERDICT round-1 ask #6: a dropped request frame recovers at poke/nack
+    scale (sub-second cadence), not blind-resend/timeout scale. The first
+    REQUEST frame for the call is swallowed at the sender's connection; the
+    POKE then draws a NACK from the receiver and the resend completes the
+    call well before the 9 s blind-resend fallback."""
+    from moolib_tpu.rpc import core as rpc_core
+
+    host, client = Rpc(), Rpc()
+    host.set_name("host")
+    client.set_name("client")
+    client.set_timeout(30)
+    host.define("echo", lambda x: x + 1)
+    host.listen(f"127.0.0.1:{free_port}")
+    client.connect(f"127.0.0.1:{free_port}")
+    try:
+        assert client.sync("host", "echo", 1) == 2  # link + fid warm
+
+        # Swallow exactly one outgoing REQUEST frame on the live connection
+        # (slotted class: patch at class level, filter to this instance).
+        conn = client._peers["host"].best_connection(client._transport_order)
+        dropped = {"n": 0}
+        cls = type(conn)
+        orig_send = cls.send_frame
+
+        def lossy_send(self, chunks):
+            if (
+                self is conn
+                and chunks
+                and bytes(chunks[0][:1])[0] == rpc_core.KIND_REQUEST
+                and dropped["n"] == 0
+            ):
+                dropped["n"] += 1
+                return  # the frame vanishes; the socket stays healthy
+            return orig_send(self, chunks)
+
+        cls.send_frame = lossy_send
+        try:
+            t0 = time.monotonic()
+            assert client.sync("host", "echo", 41) == 42
+            elapsed = time.monotonic() - t0
+        finally:
+            cls.send_frame = orig_send
+        assert dropped["n"] == 1, "fault never injected"
+        assert client._nacks_recovered >= 1, "recovery did not go through NACK"
+        # Poke fires at 0.75 s; allow generous slack for a loaded box but
+        # stay far below the 9 s blind resend and the 30 s call timeout.
+        assert elapsed < 6.0, f"recovery took {elapsed:.1f}s"
+    finally:
+        host.close()
+        client.close()
+
+
+def test_poke_while_executing_gets_ack_not_reexecution(free_port):
+    """A slow handler must not be re-executed by fast recovery: pokes during
+    execution draw ACKs, and the call completes exactly once."""
+    host, client = Rpc(), Rpc()
+    host.set_name("host")
+    client.set_name("client")
+    client.set_timeout(30)
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    def slow(x):
+        with lock:
+            calls["n"] += 1
+        time.sleep(2.5)  # several poke periods
+        return x * 10
+
+    host.define("slow", slow)
+    host.listen(f"127.0.0.1:{free_port}")
+    client.connect(f"127.0.0.1:{free_port}")
+    try:
+        assert client.sync("host", "slow", 7) == 70
+        assert calls["n"] == 1
+        assert client._nacks_recovered == 0
+    finally:
+        host.close()
+        client.close()

@@ -68,6 +68,14 @@ def make_flags(argv=None):
     p.add_argument("--log_interval", type=float, default=5.0)
     p.add_argument("--device", default=None, help="jax device str, e.g. 'tpu:0'")
     p.add_argument(
+        "--mesh",
+        default=None,
+        help='device mesh for the learner step, e.g. "dp=2,tp=2": the batch '
+        "shards over dp, params TP-shard over tp (+FSDP over dp for big "
+        "leaves), and XLA all-reduces gradients over ICI inside the jitted "
+        "step; the Accumulator then only reduces across hosts",
+    )
+    p.add_argument(
         "--wire_dtype",
         default=None,
         choices=[None, "bf16", "int8"],
@@ -103,7 +111,7 @@ def make_model(flags, num_actions, obs_shape):
     return ActorCriticNet(num_actions=num_actions, use_lstm=flags.use_lstm)
 
 
-def compute_loss(params, model, batch, initial_core_state, flags):
+def compute_loss(params, batch, initial_core_state, model, flags):
     """V-trace actor-critic loss over a [T+1, B] learner batch (reference
     ``experiment.py:103-155``)."""
     learner_outputs, _ = model.apply(params, batch, initial_core_state)
@@ -248,9 +256,60 @@ def train(flags, on_stats=None) -> dict:
         out, new_core = model.apply(params, inputs, core_state, sample_rng=rng_key)
         return out, new_core
 
-    grad_fn = jax.jit(
-        jax.value_and_grad(partial(compute_loss, model=model, flags=flags), has_aux=True)
+    # Learner step: plain jit, or sharded over a dp×tp mesh (one mesh, one
+    # jit — VERDICT round-1 ask #5; same shardings as dryrun_multichip).
+    raw_grad = jax.value_and_grad(
+        partial(compute_loss, model=model, flags=flags), has_aux=True
     )
+    mesh = None
+    batch_sharding = None
+    core_sharding = None
+    opt_apply = None
+    if flags.mesh:
+        from ... import parallel
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axes = {}
+        for part in flags.mesh.split(","):
+            k, _, v = part.partition("=")
+            axes[k.strip()] = int(v)
+        need = int(np.prod(list(axes.values())))
+        mesh_devices = jax.devices()[:need]
+        if len(mesh_devices) < need:
+            raise ValueError(f"--mesh {flags.mesh} needs {need} devices, have {len(jax.devices())}")
+        mesh = parallel.make_mesh(axes, devices=mesh_devices)
+        if flags.batch_size % mesh.shape.get("dp", 1):
+            raise ValueError("the dp mesh axis size must divide --batch_size")
+        param_sh = parallel.auto_shardings(params, mesh)
+        rep = parallel.replicated(mesh)
+        batch_sharding = NamedSharding(mesh, P(None, "dp"))  # [T+1, B, ...]
+        core_sharding = NamedSharding(mesh, P("dp"))  # [B, ...]
+        params = jax.device_put(params, param_sh)
+        # Optimizer moments follow the same TP/FSDP layout as the params
+        # (auto_shardings is shape-driven, so same-shaped leaves get the
+        # same specs) — without this they'd sit whole on one device and
+        # defeat the FSDP memory win.
+        opt_sh = parallel.auto_shardings(opt_state, mesh)
+        opt_state = jax.device_put(opt_state, opt_sh)
+        grad_fn = jax.jit(
+            raw_grad,
+            in_shardings=(param_sh, batch_sharding, core_sharding),
+            out_shardings=((rep, rep), param_sh),
+        )
+
+        def _opt_apply(p, o, g):
+            updates, o = opt.update(g, o, p)
+            return optax.apply_updates(p, updates), o
+
+        # No donation: the Accumulator retains references to the previous
+        # params tree for model sync; donating would invalidate them.
+        opt_apply = jax.jit(
+            _opt_apply,
+            in_shardings=(param_sh, opt_sh, param_sh),
+            out_shardings=(param_sh, opt_sh),
+        )
+    else:
+        grad_fn = jax.jit(raw_grad)
 
     # --- cohort wiring ---------------------------------------------------
     broker: Optional[Broker] = None
@@ -300,10 +359,22 @@ def train(flags, on_stats=None) -> dict:
     env_states = [
         common.EnvBatchState(B, T, model) for _ in range(flags.num_actor_batches)
     ]
-    learn_batcher = Batcher(flags.batch_size, device=device, dim=1)
+    # With a mesh, the Batcher lands batches pre-sharded (device_put accepts
+    # a NamedSharding target): [T+1, B] over (∅, dp).
+    learn_batcher = Batcher(
+        flags.batch_size, device=batch_sharding if mesh is not None else device, dim=1
+    )
     # Initial LSTM states ride a parallel batcher (batch axis 0) so they
     # split/merge across learner batches exactly like the unrolls do.
-    core_batcher = Batcher(flags.batch_size, device=device, dim=0) if flags.use_lstm else None
+    core_batcher = (
+        Batcher(
+            flags.batch_size,
+            device=core_sharding if mesh is not None else device,
+            dim=0,
+        )
+        if flags.use_lstm
+        else None
+    )
 
     last_stats = time.monotonic()
     last_log = time.monotonic()
@@ -374,17 +445,18 @@ def train(flags, on_stats=None) -> dict:
 
             if accumulator.has_gradients():
                 grads = accumulator.gradients()
-                updates, opt_state = opt.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                if opt_apply is not None:
+                    params, opt_state = opt_apply(params, opt_state, grads)
+                else:
+                    updates, opt_state = opt.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
                 accumulator.set_parameters(params)
                 accumulator.zero_gradients()
                 stats["sgd_steps"] += 1
             elif not learn_batcher.empty() and accumulator.wants_gradients():
                 batch = learn_batcher.get()
                 initial_core = core_batcher.get() if core_batcher is not None else ()
-                (loss, aux), grads = grad_fn(
-                    params, batch=batch, initial_core_state=initial_core
-                )
+                (loss, aux), grads = grad_fn(params, batch, initial_core)
                 stats["loss"] += float(loss)
                 stats["pg_loss"] += float(aux["pg_loss"])
                 stats["entropy_loss"] += float(aux["entropy_loss"])

@@ -24,6 +24,7 @@ def pipeline_apply(
     microbatches: jax.Array,
     mesh: Mesh,
     axis_name: str = "pp",
+    data_axis: str = None,
 ):
     """Run ``y_m = stage_{S-1}(... stage_0(x_m))`` for every microbatch.
 
@@ -32,10 +33,19 @@ def pipeline_apply(
         same shape (activation shape is uniform across stages).
       stage_params: pytree whose leaves have a leading stage axis of size S
         (sharded over ``axis_name`` inside the mapped region).
-      microbatches: [M, ...] array of microbatch inputs.
-      mesh: mesh with an ``axis_name`` axis of size S.
+      microbatches: [M, B, ...] array of microbatch inputs.
+      mesh: mesh with an ``axis_name`` axis of size S.  The mesh may carry
+        other axes (dp/tp): pass ``data_axis="dp"`` to also shard the
+        microbatch batch dim (axis 1) over it — a data-parallel pipeline in
+        ONE mesh, each dp slice streaming its own microbatches.
+      data_axis: optional mesh axis for the batch dim of ``microbatches``.
 
-    Returns: [M, ...] outputs from the final stage.
+    Returns: [M, B, ...] outputs from the final stage.
+
+    The tick loop is a ``lax.scan``, so the whole schedule is
+    reverse-differentiable: ``jax.grad`` through ``pipeline_apply`` yields
+    GPipe training (scan stashes the per-tick activations for the backward
+    pass — the classic GPipe memory profile).
     """
     S = mesh.shape[axis_name]
     M = microbatches.shape[0]
@@ -47,11 +57,13 @@ def pipeline_apply(
         stage = jax.lax.axis_index(axis_name)
         act_shape = xs.shape[1:]
         # Mark the loop buffers as varying over the pipeline axis (their
-        # updates depend on axis_index, so the carry type must match).
-        carry = jax.lax.pcast(jnp.zeros(act_shape, xs.dtype), axis_name, to="varying")
+        # updates depend on axis_index, so the carry type must match) — and
+        # over the data axis too when microbatches are sharded across it.
+        carry_axes = (axis_name,) if data_axis is None else (axis_name, data_axis)
+        carry = jax.lax.pcast(jnp.zeros(act_shape, xs.dtype), carry_axes, to="varying")
         outs = jax.lax.pcast(jnp.zeros_like(xs), axis_name, to="varying")
 
-        def tick(i, state):
+        def tick(state, i):
             carry, outs = state
             # Stage 0 ingests microbatch i (when still filling); others take
             # the activation handed over the ring.
@@ -69,9 +81,9 @@ def pipeline_apply(
             # Hand activations to the next stage (ring step).
             perm = [(j, (j + 1) % S) for j in range(S)]
             carry = jax.lax.ppermute(y, axis_name, perm)
-            return carry, outs
+            return (carry, outs), None
 
-        _, outs = jax.lax.fori_loop(0, M + S - 1, tick, (carry, outs))
+        (_, outs), _ = jax.lax.scan(tick, (carry, outs), jnp.arange(M + S - 1))
         # Results live on the last stage; share them with everyone.
         outs = jax.lax.psum(
             jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), axis_name
@@ -79,11 +91,12 @@ def pipeline_apply(
         return outs
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+    xs_spec = P(None, data_axis) if data_axis is not None else P()
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, xs_spec),
+        out_specs=xs_spec,
     )
     sharded_params = jax.tree_util.tree_map(
         lambda p: jax.device_put(p, NamedSharding(mesh, P(axis_name))), stage_params

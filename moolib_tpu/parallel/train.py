@@ -52,6 +52,46 @@ def param_shardings(
     raise ValueError(f"unknown mode {mode!r}")
 
 
+def auto_shardings(
+    params,
+    mesh: Mesh,
+    tp_axis: str = "tp",
+    dp_axis: str = "dp",
+    tp_min: int = 16,
+    fsdp_min: int = 2**12,
+):
+    """Pytree of NamedShardings composing TP and FSDP on ONE mesh: tensor
+    parallelism on the last axis of ≥2-D kernels (output features — Dense and
+    conv kernels alike) when it divides the ``tp`` size, then FSDP over
+    ``dp`` on the largest remaining divisible axis of big leaves.  Used by
+    both the flagship agent (``--mesh dp=N,tp=M``) and ``dryrun_multichip``
+    so the dry run exercises the exact sharding the agent trains with."""
+    has_tp = tp_axis in mesh.axis_names and mesh.shape[tp_axis] > 1
+    has_dp = dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1
+
+    def spec_of(x):
+        shape = np.shape(x)
+        spec = [None] * len(shape)
+        if (
+            has_tp
+            and len(shape) >= 2
+            and shape[-1] >= tp_min
+            and shape[-1] % mesh.shape[tp_axis] == 0
+        ):
+            spec[-1] = tp_axis
+        if has_dp and np.prod(shape) >= fsdp_min:
+            cand = max(
+                (d for d in range(len(shape)) if spec[d] is None),
+                key=lambda d: shape[d],
+                default=None,
+            )
+            if cand is not None and shape[cand] % mesh.shape[dp_axis] == 0:
+                spec[cand] = dp_axis
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map(spec_of, params)
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,

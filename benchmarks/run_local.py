@@ -1,0 +1,68 @@
+"""Collect the CPU-side microbenchmarks into one committed artifact.
+
+VERDICT round-1 ask #9: commit RPC/codec/allreduce numbers each round so perf
+regressions stay visible between rounds even when the TPU is unavailable.
+Writes ``BENCH_LOCAL.json`` at the repo root:
+
+    python benchmarks/run_local.py
+
+Caveat recorded in the artifact: this box has one CPU core, so call-rate
+numbers are noisy (thread-handoff order inverts under load); bandwidth
+numbers are the trustworthy ones.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, timeout=600):
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, cwd=ROOT, capture_output=True, text=True, timeout=timeout
+        )
+        return {
+            "cmd": " ".join(cmd[1:]),
+            "rc": proc.returncode,
+            "seconds": round(time.time() - t0, 1),
+            "stdout": proc.stdout.strip().splitlines(),
+            "stderr": proc.stderr.strip().splitlines()[-5:] if proc.returncode else [],
+        }
+    except subprocess.TimeoutExpired:
+        return {"cmd": " ".join(cmd[1:]), "rc": -1, "error": f"timeout {timeout}s"}
+
+
+def main():
+    env_note = {
+        "host": platform.node(),
+        "cpus": os.cpu_count(),
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "caveat": "single-core box: rates are noisy, bandwidths are meaningful",
+    }
+    py = sys.executable
+    results = {
+        "env": env_note,
+        "rpc": _run([py, "benchmarks/rpc_bench.py", "--backend", "both"]),
+        "allreduce_rpc": _run([py, "benchmarks/allreduce_bench.py", "rpc"]),
+        "allreduce_ici": _run([py, "benchmarks/allreduce_bench.py", "ici"]),
+        "envpool": _run([py, "benchmarks/envpool_bench.py"]),
+    }
+    out = os.path.join(ROOT, "BENCH_LOCAL.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {out}")
+    for k, v in results.items():
+        if isinstance(v, dict) and "rc" in v:
+            print(f"  {k}: rc={v['rc']} ({v.get('seconds', '?')}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -148,6 +148,12 @@ class Accumulator:
         # local f32 gradient sum + global counts pending the next fire.
         self._fire_accum = None
         self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+        # ICI backend (SURVEY §7 stage 5b): XLA psum over the device mesh
+        # instead of the RPC tree, when the cohort is the static process set.
+        self._use_ici = False
+        self._ici_fns: Dict = {}
+        self._ici_executor = None  # lazily-created single-thread FIFO
+        self._ici_reduces = 0  # observability: rounds that rode ICI
         self._grad_dtypes = None
         self._has_gradients = False
         self._result_grads = None
@@ -245,6 +251,30 @@ class Accumulator:
             self._wire_q8 = False
         self._q_residual = None
 
+    def set_ici_backend(self, enabled: bool = True) -> None:
+        """Reduce gradients with an XLA collective over the device mesh (ICI
+        data plane) instead of the RPC tree (DCN), when the cohort spans
+        exactly the ``jax.distributed`` process set (SURVEY §7 stage 5: the
+        north-star hybrid — collectives for the gradient data plane, RPC for
+        elasticity/election/model sync).
+
+        The collective is synchronous across processes: every member's train
+        loop calls ``reduce_gradients``/``skip_gradients`` in lockstep (which
+        the wants/has protocol already guarantees).  If the cohort shrinks
+        or grows (epoch change), reduction transparently falls back to the
+        elastic RPC tree until the cohort matches the process set again.
+        Assumes a uniform local device count per process (jax requires this
+        on TPU slices).
+        """
+        self._use_ici = bool(enabled)
+
+    def _ici_eligible(self) -> bool:
+        if not self._use_ici:
+            return False
+        if not self._group.active():
+            return False
+        return len(self._group.members()) == jax.process_count()
+
     def parameters(self):
         """Current synced parameter pytree (jax adaptation of the reference's
         in-place tensor updates)."""
@@ -322,6 +352,12 @@ class Accumulator:
                 "reduce_gradients(batch_size, gradients)"
             )
         stats = {"num_gradients": 1, "num_skipped": 0, "batch_size": int(batch_size)}
+        if self._ici_eligible():
+            # ICI data plane: one synchronous XLA psum over the mesh; wire
+            # compression and the two-phase count protocol are DCN
+            # optimizations and don't apply here.
+            self._ici_round(stats, gradients)
+            return
         if self._virtual_batch_size is not None:
             # Remember the true dtypes so gradients() can restore them (local
             # accumulation is in f32).
@@ -349,6 +385,15 @@ class Accumulator:
     def skip_gradients(self) -> None:
         """Participate in this reduction round without contributing data."""
         stats = {"num_gradients": 0, "num_skipped": 1, "batch_size": 0}
+        if self._ici_eligible():
+            # The collective program must be identical on every process:
+            # a skip contributes zeros shaped like the parameters (gradient
+            # trees match the param tree by construction).
+            zeros = jax.tree_util.tree_map(
+                lambda p: np.zeros_like(np.asarray(p)), self._params
+            )
+            self._ici_round(stats, zeros)
+            return
         kind = "count" if self._virtual_batch_size is not None else "full"
         self._start_round(kind, stats, None)
 
@@ -393,6 +438,147 @@ class Accumulator:
                 round_ = _Round(fut, kind="full")
             self._inflight.append(round_)
             fut.add_done_callback(lambda f, r=round_: self._on_round_done(r, f))
+
+    def _ici_round(self, stats: Dict[str, int], gradients) -> None:
+        """One reduction round over the ICI data plane: psum gradients and
+        counts across every device in one jitted collective, then feed the
+        result through the same application logic as an RPC round.
+
+        The collective runs on a dedicated FIFO thread so the caller's train
+        loop keeps pumping (broker pings must not stall while peers
+        rendezvous — a blocked loop would get the peer evicted and wedge the
+        cohort).  One thread per accumulator keeps rounds in issue order,
+        which is identical on every peer (wants/has lockstep)."""
+        with self._lock:
+            if not self.connected():
+                utils.log_verbose(
+                    "accumulator %s: dropping gradient contribution (not connected)",
+                    self._name,
+                )
+                return
+            if self._has_gradients:
+                raise RpcError("unconsumed gradients; call zero_gradients() first")
+            if len(self._inflight) >= self._parallel_gradients:
+                raise RpcError(
+                    f"{len(self._inflight)} gradient reductions already in flight "
+                    f"(parallel_gradients={self._parallel_gradients})"
+                )
+            self._grad_dtypes = jax.tree_util.tree_map(
+                lambda g: np.asarray(g).dtype, gradients
+            )
+            if self._ici_executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._ici_executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix=f"ici-{self._name}"
+                )
+            round_ = _Round(None, kind="full")
+            self._inflight.append(round_)
+        leaves, treedef = jax.tree_util.tree_flatten(gradients)
+        # The epoch tag rides inside the collective: XLA/gloo rendezvous has
+        # no notion of membership epochs, so a contribution stranded from a
+        # cancelled epoch could pair with a fresh one. Every process
+        # contributes its sync_id (mod 2^20: f32-exact); if the reduced mean
+        # doesn't equal the local epoch, every participant sees the same
+        # mismatch and errors the round — wants_gradients() returns and the
+        # train loop re-contributes in the settled epoch.
+        # Mod 8191 (13 bits) keeps the f32 SUM of tags exact for up to ~2^11
+        # devices (partial sums stay under 2^24); adjacent epochs still map
+        # to distinct tags.
+        epoch_tag = int(self._group.sync_id() or 0) % 8191
+        counts = np.array(
+            [stats["num_gradients"], stats["num_skipped"], stats["batch_size"], epoch_tag],
+            np.float32,
+        )
+        arrays = [np.asarray(g, np.float32) for g in leaves] + [counts]
+        self._ici_executor.submit(self._ici_execute, round_, arrays, treedef, epoch_tag)
+
+    def _ici_execute(self, round_: _Round, arrays, treedef, epoch_tag: int) -> None:
+        try:
+            summed = self._ici_allreduce(arrays)
+            ndl = jax.local_device_count()
+            counts_tot = summed[-1] / ndl
+            nproc = jax.process_count()
+            epoch_mean = float(counts_tot[3]) / nproc
+            if abs(epoch_mean - epoch_tag) > 1e-3:
+                raise RpcError(
+                    f"ici reduction spanned mixed membership epochs "
+                    f"(mean tag {epoch_mean} != local {epoch_tag}); retrying"
+                )
+            result = {
+                "grads": jax.tree_util.tree_unflatten(
+                    treedef, [x / ndl for x in summed[:-1]]
+                ),
+                "num_gradients": int(round(float(counts_tot[0]))),
+                "num_skipped": int(round(float(counts_tot[1]))),
+                "batch_size": int(round(float(counts_tot[2]))),
+                "wire": None,
+            }
+            with self._lock:
+                self._ici_reduces += 1
+                round_.done = True
+                round_.result = result
+                self._drain_rounds_locked()
+        except Exception as e:  # noqa: BLE001 — surfaced via the round error
+            with self._lock:
+                round_.done = True
+                round_.error = e
+                self._drain_rounds_locked()
+
+    def _ici_allreduce(self, arrays: List[np.ndarray]) -> List[np.ndarray]:
+        """Sum each array across all jax devices (every process contributes
+        its value duplicated over its local devices; the sum is divided by
+        ``local_device_count`` by the caller).
+
+        First use of a shape set compiles eagerly, then runs an RPC-tree
+        barrier before the first execution: the gloo/ICI rendezvous window is
+        short (~30 s), and per-process compile-time skew must not eat it.
+        """
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        key = tuple((a.shape, str(a.dtype)) for a in arrays)
+        cached = self._ici_fns.get(key)
+        warm = cached is None
+        if warm:
+            devs = np.array(jax.devices())
+            mesh = Mesh(devs, ("r",))
+            sh = NamedSharding(mesh, PartitionSpec("r"))
+            rep = NamedSharding(mesh, PartitionSpec())
+            fn = jax.jit(
+                lambda xs: [x.sum(axis=0) for x in xs],
+                out_shardings=[rep] * len(arrays),
+            )
+        else:
+            fn, sh, ndev = cached
+        ndl = jax.local_device_count()
+        if warm:
+            ndev = len(jax.devices())
+
+        def to_global(a):
+            return jax.make_array_from_process_local_data(
+                sh,
+                np.ascontiguousarray(np.broadcast_to(a[None], (ndl,) + a.shape)),
+                (ndev,) + a.shape,
+            )
+
+        global_arrays = [to_global(a) for a in arrays]
+        if warm:
+            # AOT-compile and keep the executable (jit's call cache is NOT
+            # populated by lower().compile() — calling fn afterwards would
+            # re-compile, after the barrier, defeating it).
+            compiled = fn.lower(global_arrays).compile()
+            if jax.process_count() > 1:
+                # All peers compiled; synchronize entry into the first run so
+                # compile-time skew can't eat the rendezvous window. An
+                # allreduce completes only when EVERY member contributes, so
+                # barrier outcomes are symmetric: all peers pass together or
+                # fail together (epoch cancel) — which is why the warm cache
+                # is only written after success (an asymmetric cache would
+                # leave one peer barriering against nobody on retry).
+                self._group.all_reduce(f"__accum_ici_warm:{self._name}", 1).result(120)
+            fn = compiled
+            self._ici_fns[key] = (compiled, sh, ndev)
+        return [np.asarray(x) for x in fn(global_arrays)]
 
     def _fire_grad_round_locked(self):
         """Two-phase, phase 2: the global count met the virtual batch size —
@@ -499,8 +685,10 @@ class Accumulator:
             target = self._virtual_batch_size or 1
             if self._accum_stats["batch_size"] >= target and self._accum_stats["num_gradients"] > 0:
                 n = self._accum_stats["num_gradients"]
-                if self._wire_dtype is not None and self._grad_dtypes is not None:
-                    # Restore each leaf's original dtype (averaging in f32).
+                if self._grad_dtypes is not None:
+                    # Restore each leaf's original dtype (averaging in f32);
+                    # set whenever leaves were converted on the way in (wire
+                    # compression or the ICI f32 staging).
                     self._result_grads = jax.tree_util.tree_map(
                         lambda x, dt: (np.asarray(x, np.float32) / n).astype(dt),
                         self._accum_grads,
@@ -722,6 +910,8 @@ class Accumulator:
             )
 
     def close(self) -> None:
+        if self._ici_executor is not None:
+            self._ici_executor.shutdown(wait=False)
         if self._standalone:
             self._rpc.close()
 

@@ -32,12 +32,26 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
 ) -> None:
-    """Multi-host bring-up (control plane: DCN; data plane: ICI)."""
+    """Multi-host bring-up (control plane: DCN; data plane: ICI).
+
+    On the CPU backend, cross-process collectives silently hang unless a
+    collectives implementation is selected — pin gloo before the backend
+    initializes (this was the round-1 "cross-process CPU collectives hang":
+    XLA:CPU defaults to no cross-process implementation at all).
+    """
+    try:
+        platforms = jax.config.jax_platforms or ""
+        if "cpu" in platforms or platforms == "":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — older jax without the option
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        local_device_ids=local_device_ids,
     )
 
 

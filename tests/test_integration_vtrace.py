@@ -103,3 +103,32 @@ def test_impala_learns_from_pixels(free_port):
     assert out["sgd_steps"] > 100
     assert out["mean_episode_return"] is not None
     assert out["mean_episode_return"] > 0.0, f"no pixel learning: {out}"
+
+
+def test_impala_ici_backend_smoke(free_port):
+    """The flagship agent reduces gradients over the ICI data plane when
+    --ici is set (single process here: psum over local devices; the
+    multi-process path is tests/test_distributed_multihost.py)."""
+    flags = make_flags(
+        [
+            "--env",
+            "catch",
+            "--total_steps",
+            "3000",
+            "--actor_batch_size",
+            "8",
+            "--batch_size",
+            "2",
+            "--virtual_batch_size",
+            "2",
+            "--num_env_processes",
+            "1",
+            "--address",
+            f"127.0.0.1:{free_port}",
+            "--ici",
+            "--quiet",
+        ]
+    )
+    out = train(flags)
+    assert out["steps"] >= 3000
+    assert out["sgd_steps"] > 5

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ... import Accumulator, Batcher, Broker, EnvPool, Group, Rpc
+from ... import Accumulator, Batcher, Broker, EnvPool, Group, Rpc, utils
 from ...envs import CartPoleEnv, CatchEnv, SyntheticAtariEnv
 from ...models import ActorCriticNet, ImpalaNet
 from ...ops import entropy_loss, softmax_cross_entropy, vtrace
@@ -104,6 +104,18 @@ def make_flags(argv=None):
         "--trace_dir",
         default=None,
         help="capture a jax profiler trace of the first learner steps here",
+    )
+    p.add_argument(
+        "--localdir",
+        default=None,
+        help="write stats rows to <localdir>/logs.tsv with latest symlink + "
+        "metadata.json (reference examples/common/record.py)",
+    )
+    p.add_argument(
+        "--wandb",
+        action="store_true",
+        help="log stats to wandb when the package is installed (gated no-op "
+        "otherwise — reference experiment.py:269-276 opt-in)",
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
@@ -393,6 +405,21 @@ def train(flags, on_stats=None) -> dict:
     stats["steps_done"] += steps_done
     global_stats = common.GlobalStatsAccumulator(rpc_group, stats)
 
+    tsv = None
+    if flags.localdir:
+        tsv = common.TsvLogger(
+            os.path.join(flags.localdir, "logs.tsv"),
+            metadata={"train_id": flags.train_id, "env": flags.env},
+        )
+    wandb_run = None
+    if flags.wandb:
+        try:
+            import wandb
+
+            wandb_run = wandb.init(project=flags.train_id, config=flags.to_dict())
+        except Exception as e:  # noqa: BLE001 — gated: package absent or offline
+            utils.log_error("wandb requested but unavailable: %s", e)
+
     env_states = [
         common.EnvBatchState(B, T, model) for _ in range(flags.num_actor_batches)
     ]
@@ -552,8 +579,18 @@ def train(flags, on_stats=None) -> dict:
                         f"loss={stats['loss'].result()}",
                         flush=True,
                     )
-                if on_stats is not None:
-                    on_stats({k: v.result() if hasattr(v, "result") else v for k, v in stats.items()})
+                if on_stats is not None or tsv is not None or wandb_run is not None:
+                    row = {
+                        k: v.result() if hasattr(v, "result") else v
+                        for k, v in stats.items()
+                    }
+                    if on_stats is not None:
+                        on_stats(row)
+                    row = dict(row, sps=round(sps, 1))
+                    if tsv is not None:
+                        tsv.log(**row)
+                    if wandb_run is not None:
+                        wandb_run.log(row)
                 last_return = stats["mean_episode_return"].result()
                 if last_return is not None:
                     final_return = last_return
@@ -582,6 +619,11 @@ def train(flags, on_stats=None) -> dict:
         rpc.close()
         if broker is not None:
             broker.close()
+        if wandb_run is not None:
+            try:
+                wandb_run.finish()
+            except Exception:  # noqa: BLE001
+                pass
 
     recent = stats["mean_episode_return"].result()
     return {

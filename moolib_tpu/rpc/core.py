@@ -36,6 +36,7 @@ import atexit
 import collections
 import concurrent.futures
 import itertools
+import math
 import os
 import random
 import struct
@@ -280,6 +281,8 @@ class _Connection:
         "send_count",
         "recv_count",
         "latency",
+        "bandit",
+        "bandit_t",
         "created",
         "last_recv",
         "last_keepalive",
@@ -300,6 +303,13 @@ class _Connection:
         self.send_count = 0
         self.recv_count = 0
         self.latency: Optional[float] = None  # EMA seconds
+        # Bandit value in [-1, 1] (reference banditValue, src/rpc.cc:640-716):
+        # nudged up when this transport currently has the peer's best latency,
+        # down otherwise, with time decay; transport choice is a softmax over
+        # exp(bandit * 4), so the loser still gets occasional probe traffic
+        # and can win back after a regime change.
+        self.bandit = 0.0
+        self.bandit_t = 0.0
         self.created = time.monotonic()
         self.last_recv = time.monotonic()
         self.last_keepalive = 0.0
@@ -418,18 +428,52 @@ class _Peer:
         self.find_inflight = False
 
     def best_connection(self, order: List[str]) -> Optional[_Connection]:
+        """Pick the transport for one message: softmax over per-connection
+        bandit values (reference banditSend, ``src/rpc.cc:640-716``) —
+        mostly-exploit with a sliver of exploration so a transport that went
+        bad (or got one unlucky sample) keeps producing fresh latency data.
+        """
         conns = [c for c in self.connections.values() if not c.closed]
         if not conns:
             return None
-        # Prefer measured latency; fall back to configured transport order
-        # (ipc beats tcp locally).  This is the lightweight analogue of the
-        # reference's softmax bandit over per-transport latency EMAs.
-        def key(c: _Connection):
-            lat = c.latency if c.latency is not None else 1e-3
-            pref = order.index(c.transport) if c.transport in order else len(order)
-            return (lat, pref)
+        if len(conns) == 1:
+            return conns[0]
+        # Unmeasured connections start at the configured preference order
+        # (ipc beats tcp locally) via a small bandit prior.
+        def weight(c: _Connection):
+            prior = 0.0
+            if c.latency is None and c.transport in order:
+                prior = 0.25 * (len(order) - order.index(c.transport)) / len(order)
+            return math.exp((c.bandit + prior) * 4.0)
 
-        return min(conns, key=key)
+        ws = [weight(c) for c in conns]
+        t = random.random() * sum(ws)
+        for c, w in zip(conns, ws):
+            t -= w
+            if t <= 0:
+                return c
+        return conns[-1]
+
+    def note_latency(self, conn: _Connection, rtt: float) -> None:
+        """Fold one RTT sample into the connection's EMA and re-score the
+        bandit values of every live connection to this peer (the analogue of
+        the reference's addLatency, ``src/rpc.cc:2448-2486``)."""
+        conn.latency = rtt if conn.latency is None else conn.latency * 0.9 + rtt * 0.1
+        measured = [
+            c
+            for c in self.connections.values()
+            if not c.closed and c.latency is not None
+        ]
+        if len(measured) < 2:
+            return
+        best = min(measured, key=lambda c: c.latency)
+        now = time.monotonic()
+        for c in measured:
+            dt = now - (c.bandit_t or now)
+            c.bandit *= 0.9375 ** min(dt, 60.0)
+            c.bandit += 0.125 if c is best else -0.125
+            c.bandit = max(-1.0, min(1.0, c.bandit))
+            c.bandit_t = now
 
 
 class _Outgoing:
@@ -816,6 +860,7 @@ class Rpc:
                 lat = f"{c.latency*1e6:.0f}us" if c.latency is not None else "?"
                 lines.append(
                     f"    {t}: sent={c.send_count} recv={c.recv_count} latency={lat}"
+                    f" bandit={c.bandit:+.2f}"
                     f" age={time.monotonic()-c.created:.1f}s closed={c.closed}"
                 )
         lines.append(
@@ -1464,9 +1509,13 @@ class Rpc:
             if not out.resent:
                 # Resent requests give ambiguous RTTs (which send answered?)
                 rtt = time.monotonic() - out.sent_at
-                conn.latency = (
-                    rtt if conn.latency is None else conn.latency * 0.9 + rtt * 0.1
-                )
+                peer = self._peers.get(conn.peer_name) if conn.peer_name else None
+                if peer is not None:
+                    peer.note_latency(conn, rtt)
+                else:
+                    conn.latency = (
+                        rtt if conn.latency is None else conn.latency * 0.9 + rtt * 0.1
+                    )
         # Deserialize + complete outside the lock: payloads can be large and
         # future done-callbacks take caller locks.
         try:

@@ -262,3 +262,38 @@ def test_stale_buffers_push_rejected(free_port):
         np.testing.assert_allclose(follower.buffers()["bn"], 4.0)
     finally:
         close_all(broker, accs)
+
+
+def test_two_phase_with_pipelined_contributions(free_port):
+    """Virtual batching composed with set_parallel_gradients(2): count
+    rounds overlap on the wire, local contributions fold in issue order,
+    and the single gradient allreduce fires with the right totals."""
+    broker, accs = make_cohort(free_port, 2, virtual_batch_size=16)
+    try:
+        for a in accs:
+            a.set_parallel_gradients(2)
+        assert pump(broker, accs, 30, until=lambda: all(a.connected() for a in accs))
+        # Two back-to-back contributions per peer (both slots used), then a
+        # final pair that crosses the 16 threshold.
+        for v in (1.0, 3.0):
+            g = {"w": np.full((2, 2), v, np.float32), "b": np.zeros(2, np.float32)}
+            for a in accs:
+                a.reduce_gradients(3, g)
+        assert pump(broker, accs, 15, until=lambda: all(not a._inflight for a in accs))
+        assert not any(a.has_gradients() for a in accs)  # 12 < 16
+        g = {"w": np.full((2, 2), 5.0, np.float32), "b": np.zeros(2, np.float32)}
+        for a in accs:
+            a.reduce_gradients(2, g)
+        assert pump(broker, accs, 15, until=lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            stats = a.get_gradient_stats()
+            assert stats == {"num_gradients": 6, "num_skipped": 0, "batch_size": 16}, stats
+            # mean of (1, 3, 5) per peer, same on both peers
+            np.testing.assert_allclose(np.asarray(a.gradients()["w"]), 3.0)
+            a.zero_gradients()
+        # Wire-level: exactly ONE gradient allreduce went out.
+        sid = accs[0]._group.sync_id()
+        assert accs[0]._group._seq[(sid, "__accum_grad:model")] == 1
+        assert accs[0]._group._seq[(sid, "__accum_count:model")] == 3
+    finally:
+        close_all(broker, accs)

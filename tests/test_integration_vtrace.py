@@ -105,12 +105,15 @@ def test_impala_learns_from_pixels(free_port):
     assert out["mean_episode_return"] > 0.0, f"no pixel learning: {out}"
 
 
-def test_impala_ici_backend_smoke(free_port):
+def test_impala_ici_backend_smoke(free_port, tmp_path):
     """The flagship agent reduces gradients over the ICI data plane when
     --ici is set (single process here: psum over local devices; the
-    multi-process path is tests/test_distributed_multihost.py)."""
+    multi-process path is tests/test_distributed_multihost.py). Also
+    exercises --localdir TSV/metadata recording."""
     flags = make_flags(
         [
+            "--localdir",
+            str(tmp_path),
             "--env",
             "catch",
             "--total_steps",
@@ -132,3 +135,12 @@ def test_impala_ici_backend_smoke(free_port):
     out = train(flags)
     assert out["steps"] >= 3000
     assert out["sgd_steps"] > 5
+    # --localdir wrote the reference-style record artifacts.
+    import os
+
+    assert os.path.exists(tmp_path / "logs.tsv")
+    assert os.path.exists(tmp_path / "metadata.json")
+    assert os.path.islink(tmp_path / "latest.tsv")
+    with open(tmp_path / "logs.tsv") as f:
+        lines = f.read().strip().splitlines()
+    assert len(lines) >= 2 and lines[0].startswith("time\t")

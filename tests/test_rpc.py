@@ -315,3 +315,38 @@ def test_define_collision(pair):
 def test_create_uid():
     uid = moolib_tpu.create_uid()
     assert len(uid) == 16 and uid != moolib_tpu.create_uid()
+
+
+def test_bandit_transport_selection_softmax():
+    """Transport choice is a softmax over bandit values (reference
+    banditSend): the better transport dominates, but the loser keeps a
+    nonzero share of traffic (exploration), and repeated latency samples
+    drive the bandit toward the faster connection."""
+    from moolib_tpu.rpc.core import _Connection, _Peer
+
+    peer = _Peer("p")
+    fast = _Connection("ipc", None, None)
+    slow = _Connection("tcp", None, None)
+    peer.connections = {"ipc": fast, "tcp": slow}
+
+    # Equal (fresh) bandits: both get traffic.
+    counts = {"ipc": 0, "tcp": 0}
+    for _ in range(2000):
+        counts[peer.best_connection(["ipc", "tcp"]).transport] += 1
+    assert counts["ipc"] > 200 and counts["tcp"] > 200, counts
+
+    # Feed samples: ipc consistently 10x faster -> its bandit saturates up.
+    for _ in range(50):
+        peer.note_latency(fast, 0.001)
+        peer.note_latency(slow, 0.010)
+    assert fast.bandit > 0.9 and slow.bandit < -0.9, (fast.bandit, slow.bandit)
+    counts = {"ipc": 0, "tcp": 0}
+    for _ in range(2000):
+        counts[peer.best_connection(["ipc", "tcp"]).transport] += 1
+    assert counts["ipc"] > 1900, counts
+
+    # Regime change: tcp becomes the fast one; the bandit follows.
+    for _ in range(80):
+        peer.note_latency(fast, 0.050)
+        peer.note_latency(slow, 0.002)
+    assert slow.bandit > 0.5 > fast.bandit, (fast.bandit, slow.bandit)

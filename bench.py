@@ -125,15 +125,27 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
 
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, batch)
-    jax.block_until_ready(loss)
+    float(loss)  # force the warmup chain (block_until_ready can lie on tunneled backends)
 
     t0 = time.perf_counter()
     if max_seconds is None:
-        # Pipelined: XLA dispatch is async; block once at the end.
-        for _ in range(iters):
-            params, opt_state, loss = step(params, opt_state, batch)
-        jax.block_until_ready(loss)
-        done = iters
+        # Remote/tunneled TPU backends have a large fixed dispatch+fetch
+        # overhead and block_until_ready can return before execution — so
+        # time two chain lengths (steps are chained through donated params)
+        # and take the marginal cost, forcing each chain with a scalar fetch.
+        def run(n):
+            nonlocal params, opt_state
+            t = time.perf_counter()
+            for _ in range(n):
+                params, opt_state, loss = step(params, opt_state, batch)
+            float(loss)
+            return time.perf_counter() - t
+
+        iters = max(iters, 4)
+        n1 = max(1, iters // 4)
+        t1, t2 = run(n1), run(iters)
+        dt = max(t2 - t1, 1e-9)
+        timed = iters - n1
     else:
         # Time-boxed (CPU fallback on slow boxes): block per step so the
         # elapsed check is accurate; stop after max_seconds or iters.
@@ -144,10 +156,10 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
             done += 1
             if time.perf_counter() - t0 > max_seconds:
                 break
-    dt = time.perf_counter() - t0
-    iters = done
+        dt = time.perf_counter() - t0
+        timed = done
 
-    sps = T * B * iters / dt
+    sps = T * B * timed / dt
     out = {
         "metric": "impala_learner_sps",
         "value": round(sps, 1),
@@ -155,13 +167,13 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
         "vs_baseline": round(sps / REALTIME_FLOOR_SPS, 3),
         "platform": device.platform,
         "device_kind": device.device_kind,
-        "step_ms": round(dt / iters * 1000, 2),
+        "step_ms": round(dt / timed * 1000, 2),
     }
     if flops_per_step:
         out["model_tflops_per_step"] = round(flops_per_step / 1e12, 4)
         peak = _peak_for(device.device_kind)
         if peak:
-            out["mfu"] = round(flops_per_step * iters / dt / peak, 4)
+            out["mfu"] = round(flops_per_step * timed / dt / peak, 4)
     return out
 
 

@@ -9,9 +9,13 @@ backend exists; on CPU it refuses (interpret-mode timings are meaningless).
 
 from __future__ import annotations
 
-import time
+import os
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import chain_elapsed, marginal_time  # noqa: E402
 
 
 def main():
@@ -33,18 +37,32 @@ def main():
         dense = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))
         flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
 
-        def timeit(fn):
-            fn(q, k, v).block_until_ready()  # compile
-            iters = 20 if T <= 2048 else 5
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(q, k, v)
-            out.block_until_ready()
-            return (time.perf_counter() - t0) / iters * 1e3
+        sumf = jax.jit(lambda o: jnp.sum(o.astype(jnp.float32)))
 
-        d_ms = timeit(dense)
+        def timeit(fn):
+            # See benchmarks/timing.py for why: data-dependent chain, scalar
+            # fetch, marginal cost between two chain lengths.
+            def run(iters):
+                return chain_elapsed(
+                    lambda out: fn(out, k, v), q, iters, lambda out: float(sumf(out))
+                )
+            n1, n2 = (8, 40) if T <= 2048 else (4, 16)
+            return marginal_time(run, n1, n2) * 1e3
+
+        # Dense materializes the full [B,H,T,T] score matrix and runs out of
+        # HBM at long T (the problem flash attention solves) — report that as
+        # a result, not a crash.
+        try:
+            d_ms = timeit(dense)
+        except Exception as e:  # noqa: BLE001 — XLA raises backend-specific OOM types
+            if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e).lower():
+                raise
+            d_ms = None
         f_ms = timeit(flash)
-        print(f"{T:>6} {d_ms:>9.3f} {f_ms:>9.3f} {d_ms / f_ms:>8.2f}x")
+        if d_ms is None:
+            print(f"{T:>6} {'OOM':>9} {f_ms:>9.3f} {'inf':>8}")
+        else:
+            print(f"{T:>6} {d_ms:>9.3f} {f_ms:>9.3f} {d_ms / f_ms:>8.2f}x")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,47 @@
+"""Honest device timing through remote/tunneled backends.
+
+Two failure modes make naive timing lie on a tunneled TPU backend (measured
+on the axon v5e tunnel): independent identical dispatches can be elided or
+overlapped by the remote runtime, and ``block_until_ready`` can return before
+execution.  The honest recipe is therefore:
+
+1. make iterations data-dependent (chain each output into the next input),
+2. force the chain by fetching a scalar reduction to the host,
+3. time two chain lengths and take the *marginal* cost, cancelling the fixed
+   dispatch/fetch overhead (~65 ms through the tunnel).
+
+``bench.py`` at the repo root implements the same recipe inline — it must
+stay a single self-contained file because the driver executes it standalone
+(and it re-executes itself as a subprocess by absolute path).  Any fix to the
+methodology here should be mirrored there.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+def marginal_time(run: Callable[[int], float], n1: int, n2: int) -> float:
+    """Seconds per iteration from the marginal cost between two chain lengths.
+
+    ``run(n)`` must execute an n-iteration *data-dependent* chain, force it
+    with a scalar fetch, and return its elapsed wall time.  ``run`` is called
+    once for warmup/compile before the timed pair.
+    """
+    if n2 <= n1:
+        raise ValueError(f"need n2 > n1, got {n1=} {n2=}")
+    run(2)  # compile + warm
+    t1, t2 = run(n1), run(n2)
+    return max(t2 - t1, 1e-9) / (n2 - n1)
+
+
+def chain_elapsed(fn, x0, n: int, force) -> float:
+    """Elapsed seconds for ``x = fn(x)`` applied ``n`` times, forced by
+    ``force(x)`` (e.g. a jitted scalar sum fetched with ``float``)."""
+    t0 = time.perf_counter()
+    x = x0
+    for _ in range(n):
+        x = fn(x)
+    force(x)
+    return time.perf_counter() - t0

@@ -81,8 +81,11 @@ def train(flags, on_stats=None) -> dict:
             axes[k.strip()] = int(v)
         need = int(np.prod(list(axes.values())))
         mesh = parallel.make_mesh(axes, devices=jax.devices()[:need])
-        if flags.attention == "ring" and flags.seq_len % axes.get("sp", 1):
-            raise ValueError("--seq_len must divide the sp axis")
+        if flags.attention == "ring":
+            if "sp" not in axes:
+                raise ValueError("attention='ring' needs an sp axis in --mesh")
+            if flags.seq_len % axes["sp"]:
+                raise ValueError("the sp axis size must divide --seq_len")
         if flags.batch_size % axes.get("dp", 1):
             raise ValueError("the dp axis size must divide --batch_size")
     elif flags.attention == "ring":
@@ -136,6 +139,10 @@ def train(flags, on_stats=None) -> dict:
         )
         put = lambda x: jax.device_put(x, tok_sharding)
 
+    # Compile outside the clock (jit time would dominate tokens_per_s on
+    # short runs); the warmup step's outputs are discarded.
+    _, _, wl, _ = jstep(params, opt_state, put(tokens0))
+    float(wl)
     start = time.time()
     loss = acc = None
     for i in range(flags.steps):
@@ -147,11 +154,13 @@ def train(flags, on_stats=None) -> dict:
                 print(f"step={i + 1} loss={loss_v:.4f} acc={acc_v:.3f}", flush=True)
             if on_stats is not None:
                 on_stats({"step": i + 1, "loss": loss_v, "acc": acc_v})
+    loss_v, acc_v = float(loss), float(acc)  # force the chain before reading the clock
+    elapsed = time.time() - start
     return {
         "steps": flags.steps,
-        "loss": float(loss),
-        "acc": float(acc),
-        "tokens_per_s": flags.steps * flags.batch_size * flags.seq_len / (time.time() - start),
+        "loss": loss_v,
+        "acc": acc_v,
+        "tokens_per_s": flags.steps * flags.batch_size * flags.seq_len / elapsed,
     }
 
 

@@ -38,9 +38,12 @@ def full_attention(q, k, v, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, axis_name: str = "sp", causal: bool = True):
+def ring_attention_sharded(
+    q, k, v, axis_name: str = "sp", causal: bool = True,
+    batch_axis: Optional[str] = None,
+):
     """Per-shard body: call inside ``shard_map`` with T sharded on
-    ``axis_name``. q/k/v: [B, T_local, H, D]."""
+    ``axis_name`` (and B on ``batch_axis``, if any). q/k/v: [B, T_local, H, D]."""
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
@@ -48,11 +51,13 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp", causal: bool = True):
     Tk = k.shape[1]
     qf = q.astype(jnp.float32)
 
-    # Mark the accumulators as varying over the ring axis so the fori_loop
-    # carry type matches after the axis_index-dependent updates inside.
-    o = jax.lax.pcast(jnp.zeros((B, H, Tq, D), jnp.float32), axis_name, to='varying')
-    l = jax.lax.pcast(jnp.zeros((B, H, Tq), jnp.float32), axis_name, to='varying')
-    m = jax.lax.pcast(jnp.full((B, H, Tq), _NEG_INF, jnp.float32), axis_name, to='varying')
+    # Mark the accumulators as varying over every axis the inputs vary over
+    # (the ring axis, plus the batch axis when B is sharded too) so the
+    # fori_loop carry type matches after the updates inside.
+    axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
+    o = jax.lax.pcast(jnp.zeros((B, H, Tq, D), jnp.float32), axes, to='varying')
+    l = jax.lax.pcast(jnp.zeros((B, H, Tq), jnp.float32), axes, to='varying')
+    m = jax.lax.pcast(jnp.full((B, H, Tq), _NEG_INF, jnp.float32), axes, to='varying')
 
     q_pos = my * Tq + jnp.arange(Tq)
 
@@ -84,13 +89,30 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp", causal: bool = True):
 
 
 def ring_attention(
-    q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = True
+    q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = True,
+    batch_axis: Optional[str] = "auto",
 ):
     """Global entry point: q/k/v are [B, T, H, D] jax arrays (any sharding);
-    runs ring attention with T sharded over ``mesh``'s ``axis_name``."""
-    spec = P(None, axis_name, None, None)
+    runs ring attention with T sharded over ``mesh``'s ``axis_name``.
+
+    ``batch_axis``: mesh axis to shard B over ("auto" = use ``dp`` when the
+    mesh has one).  Without it, a dp×sp mesh would all-gather q/k/v over dp
+    and replicate the attention compute on every dp replica."""
+    if batch_axis == "auto":
+        ok = (
+            "dp" in mesh.axis_names
+            and "dp" != axis_name
+            and q.shape[0] % mesh.shape["dp"] == 0
+        )
+        batch_axis = "dp" if ok else None
+    spec = P(batch_axis, axis_name, None, None)
     fn = jax.shard_map(
-        partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
+        partial(
+            ring_attention_sharded,
+            axis_name=axis_name,
+            causal=causal,
+            batch_axis=batch_axis,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

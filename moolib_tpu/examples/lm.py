@@ -54,6 +54,14 @@ def make_flags(argv=None):
         help='axes for the train step, e.g. "dp=2,sp=4" (ring attention '
         "shards T over sp); empty string = single device + dense",
     )
+    p.add_argument(
+        "--moe_experts",
+        type=int,
+        default=0,
+        help="if >0, every other block uses a SwitchMoE FFN with this many "
+        "experts; add an ep axis to --mesh to shard them (expert parallelism)",
+    )
+    p.add_argument("--moe_aux_weight", type=float, default=0.01)
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--learning_rate", type=float, default=3e-3)
     p.add_argument("--log_interval", type=int, default=50)
@@ -90,6 +98,10 @@ def train(flags, on_stats=None) -> dict:
             raise ValueError("the dp axis size must divide --batch_size")
     elif flags.attention == "ring":
         raise ValueError("attention='ring' needs --mesh with an sp axis")
+    if flags.moe_experts and flags.layers < 2:
+        # MoE lands on every 2nd block (TransformerLM.moe_every); with a
+        # single layer no expert would ever be created.
+        raise ValueError("--moe_experts needs --layers >= 2")
 
     model = TransformerLM(
         vocab_size=flags.vocab,
@@ -98,6 +110,7 @@ def train(flags, on_stats=None) -> dict:
         num_heads=flags.heads,
         max_len=flags.seq_len,
         attention=flags.attention,
+        moe_num_experts=flags.moe_experts,
     )
     rng = np.random.default_rng(flags.seed)
     tokens0 = jnp.asarray(make_batch(rng, flags))
@@ -109,7 +122,17 @@ def train(flags, on_stats=None) -> dict:
     half = flags.seq_len // 2
 
     def loss_fn(params, tokens):
-        logits = model.apply(params, tokens, **apply_kwargs)  # [B, T, V]
+        if flags.moe_experts:
+            logits, col = model.apply(
+                params, tokens, mutable=["losses"], **apply_kwargs
+            )
+            aux = sum(
+                jnp.sum(jnp.asarray(v))
+                for v in jax.tree_util.tree_leaves(col.get("losses", {}))
+            )
+        else:
+            logits = model.apply(params, tokens, **apply_kwargs)  # [B, T, V]
+            aux = 0.0
         # Next-token prediction, scored only where the answer is half a
         # sequence away: positions half-1 .. T-2 predict the repeated half.
         pred = logits[:, half - 1 : -1]
@@ -117,7 +140,7 @@ def train(flags, on_stats=None) -> dict:
         logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
         ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
         acc = (pred.argmax(-1) == tgt).mean()
-        return -ll.mean(), acc
+        return -ll.mean() + flags.moe_aux_weight * aux, acc
 
     def step(params, opt_state, tokens):
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, tokens)
@@ -132,10 +155,16 @@ def train(flags, on_stats=None) -> dict:
 
         rep = parallel.replicated(mesh)
         tok_sharding = NamedSharding(mesh, P("dp", None))
+        # Expert weights shard over ep when the mesh has that axis (EP);
+        # the rest of the params stay replicated.
+        if flags.moe_experts and "ep" in mesh.axis_names:
+            p_sh = parallel.moe_shardings(params, mesh, "ep")
+        else:
+            p_sh = jax.tree_util.tree_map(lambda _: rep, params)
         jstep = jax.jit(
             step,
-            in_shardings=(rep, rep, tok_sharding),
-            out_shardings=(rep, rep, rep, rep),
+            in_shardings=(p_sh, None, tok_sharding),
+            out_shardings=(p_sh, None, rep, rep),
         )
         put = lambda x: jax.device_put(x, tok_sharding)
 

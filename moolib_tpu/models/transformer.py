@@ -10,6 +10,12 @@ has no attention models at all, SURVEY.md §5.7). Attention selection:
   passed at apply time (``model.apply(params, tokens, mesh=mesh)``), for
   sequences longer than one chip's HBM.
 
+Sparse capacity via ``moe_num_experts > 0``: every ``moe_every``-th block
+swaps its dense FFN for a :class:`..parallel.moe.SwitchMoE` whose expert
+weights shard over an ``ep`` mesh axis (``parallel.moe_shardings``).  The
+router's load-balancing aux losses are sowed into the ``losses`` collection:
+``logits, col = model.apply(params, tokens, mutable=["losses"])``.
+
 bfloat16 compute, f32 params/logits; pre-LN blocks.
 """
 
@@ -27,6 +33,8 @@ class Block(nn.Module):
     num_heads: int
     attention: str
     dtype: Any
+    moe_num_experts: int = 0  # 0 = dense FFN; >0 = SwitchMoE FFN (EP-shardable)
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, mesh=None):
@@ -55,9 +63,24 @@ class Block(nn.Module):
         x = x + nn.Dense(D, dtype=self.dtype, name="proj")(att)
 
         y = nn.LayerNorm(dtype=jnp.float32)(x)
-        y = nn.Dense(4 * D, dtype=self.dtype)(y)
-        y = nn.gelu(y)
-        y = nn.Dense(D, dtype=self.dtype)(y)
+        if self.moe_num_experts:
+            from ..parallel.moe import SwitchMoE
+
+            y, aux = SwitchMoE(
+                num_experts=self.moe_num_experts,
+                ffn_dim=4 * D,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype,
+                residual=False,
+                name="moe",
+            )(y)
+            # Collected by callers via apply(..., mutable=["losses"]) and
+            # added to the task loss (Switch Transformer eq. 4 weight ~1e-2).
+            self.sow("losses", "moe_aux", aux)
+        else:
+            y = nn.Dense(4 * D, dtype=self.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(D, dtype=self.dtype)(y)
         return x + y
 
 
@@ -69,6 +92,9 @@ class TransformerLM(nn.Module):
     max_len: int = 8192
     attention: str = "flash"  # dense | flash | ring
     dtype: Any = jnp.bfloat16
+    moe_num_experts: int = 0  # >0: MoE FFN on every ``moe_every``-th block
+    moe_every: int = 2  # blocks i with i % moe_every == moe_every - 1 use MoE
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, tokens: jax.Array, mesh=None) -> jax.Array:
@@ -79,8 +105,15 @@ class TransformerLM(nn.Module):
         )
         x = x + pos
         for i in range(self.num_layers):
+            use_moe = self.moe_num_experts and i % self.moe_every == self.moe_every - 1
             x = Block(
-                self.d_model, self.num_heads, self.attention, self.dtype, name=f"block{i}"
+                self.d_model,
+                self.num_heads,
+                self.attention,
+                self.dtype,
+                moe_num_experts=self.moe_num_experts if use_moe else 0,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name=f"block{i}",
             )(x, mesh=mesh)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(

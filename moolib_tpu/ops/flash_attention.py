@@ -96,13 +96,15 @@ def flash_attention(
     # Defaults from a block sweep on TPU v5e (T=4096, causal): 128x128 blocks
     # leave grid overhead dominant (32k tiny steps, 7.7 ms); 512x1024 runs the
     # same shape in 1.8 ms while q+k+v+s blocks stay well under VMEM.  Use the
-    # largest divisor of T up to the tuned size so lengths like 1536 or 2560
-    # still ride the kernel instead of the dense fallback.
+    # largest 128-multiple divisor of T up to the tuned size so lengths like
+    # 1536 or 2560 still ride the kernel; T without such a divisor (e.g. 250,
+    # or 160 < 2*128) takes the dense fallback rather than handing Mosaic a
+    # non-tile-aligned block.
     def _largest_divisor(t, cap):
-        b = min(cap, t)
-        while b > 1 and t % b:
-            b //= 2
-        return b
+        for b in range(min(cap, t) // 128 * 128, 0, -128):
+            if t % b == 0:
+                return b
+        return 0
 
     if block_q is None:
         block_q = _largest_divisor(Tq, 512)

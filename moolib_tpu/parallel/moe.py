@@ -34,6 +34,9 @@ class SwitchMoE(nn.Module):
     ffn_dim: int
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
+    residual: bool = True  # False: return only the expert output (caller
+    # owns the residual — e.g. a pre-LN transformer block whose skip
+    # connection starts from the un-normalized activations)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -82,7 +85,9 @@ class SwitchMoE(nn.Module):
         aux_loss = E * jnp.sum(density * density_proxy)
 
         out = out.astype(x.dtype).reshape(orig_shape)
-        return x + out, aux_loss  # residual catches dropped tokens
+        if self.residual:
+            return x + out, aux_loss  # residual catches dropped tokens
+        return out, aux_loss  # dropped tokens contribute zero
 
 
 def moe_param_spec(ep_axis: str = "ep"):
@@ -92,3 +97,40 @@ def moe_param_spec(ep_axis: str = "ep"):
         "w_in": P(ep_axis, None, None),
         "w_out": P(ep_axis, None, None),
     }
+
+
+def moe_shardings(params, mesh, ep_axis: str = "ep", base=None):
+    """NamedShardings for a *whole model's* param tree with SwitchMoE layers
+    inside: expert weights (leaves named ``w_in``/``w_out`` with a leading
+    expert axis divisible by the ``ep_axis`` size) shard over ``ep_axis``;
+    everything else gets ``base`` (default: replicated).
+
+    ``base`` may be a single sharding or a pytree matching ``params`` (e.g.
+    the output of :func:`..train.auto_shardings` to compose EP with TP/FSDP
+    on one mesh).
+    """
+    from jax.sharding import NamedSharding, Sharding
+
+    from .mesh import replicated
+
+    if base is None:
+        base = replicated(mesh)
+    ep = mesh.shape[ep_axis]
+
+    def expert_spec(path, x):
+        keys = {str(getattr(p, "key", getattr(p, "name", ""))) for p in path}
+        if (
+            ("w_in" in keys or "w_out" in keys)
+            and getattr(x, "ndim", 0) == 3
+            and x.shape[0] % ep == 0
+        ):
+            return NamedSharding(mesh, P(ep_axis, None, None))
+        return None
+
+    overlay = jax.tree_util.tree_map_with_path(expert_spec, params)
+    if isinstance(base, Sharding):
+        base = jax.tree_util.tree_map(lambda _: base, params)
+    return jax.tree_util.tree_map(
+        lambda o, b: b if o is None else o, overlay, base,
+        is_leaf=lambda x: x is None or isinstance(x, Sharding),
+    )

@@ -27,6 +27,31 @@ def test_lm_trains_with_ring_attention_over_dp_sp_mesh():
     assert out["loss"] < 0.5, out
 
 
+def test_lm_trains_moe_over_dp_ep_mesh():
+    """Expert parallelism end to end: SwitchMoE FFN blocks, experts sharded
+    over ep, router aux loss in the objective — and the model still learns."""
+    out = train(
+        make_flags(
+            [
+                "--mesh",
+                "dp=2,ep=4",
+                "--attention",
+                "dense",
+                "--moe_experts",
+                "4",
+                "--seq_len",
+                "32",
+                "--batch_size",
+                "16",
+                "--steps",
+                "200",
+                "--quiet",
+            ]
+        )
+    )
+    assert out["acc"] > 0.8, out
+
+
 def test_lm_trains_dense_single_device():
     out = train(
         make_flags(

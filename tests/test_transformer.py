@@ -52,6 +52,43 @@ def test_ring_attention_model_on_mesh():
     np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_r), rtol=2e-4, atol=2e-4)
 
 
+def test_moe_forward_sows_aux_loss():
+    model = TransformerLM(
+        vocab_size=64, d_model=64, num_heads=2, num_layers=2,
+        attention="dense", dtype=jnp.float32, moe_num_experts=4,
+    )
+    tokens = jax.random.randint(jax.random.key(0), (2, 32), 0, 64)
+    params = model.init(jax.random.key(1), tokens)
+    # block1 (every 2nd) has a SwitchMoE FFN; block0 keeps the dense FFN.
+    assert "moe" in params["params"]["block1"]
+    assert "moe" not in params["params"]["block0"]
+    logits, col = model.apply(params, tokens, mutable=["losses"])
+    assert np.isfinite(np.asarray(logits)).all()
+    aux = jax.tree_util.tree_leaves(col["losses"])
+    assert aux and float(sum(jnp.sum(a) for a in aux)) > 0.0  # ~E*sum(d*p) >= 1
+
+
+def test_moe_sharded_over_ep_matches_single_device():
+    mesh = parallel.make_mesh({"dp": 2, "ep": 4})
+    model = TransformerLM(
+        vocab_size=64, d_model=64, num_heads=2, num_layers=2,
+        attention="dense", dtype=jnp.float32, moe_num_experts=4,
+    )
+    tokens = jax.random.randint(jax.random.key(0), (4, 32), 0, 64)
+    params = model.init(jax.random.key(1), tokens)
+    ref = model.apply(params, tokens)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    p_sh = parallel.moe_shardings(params, mesh, "ep")
+    # Expert leaves got the ep spec, the rest stayed replicated.
+    moe_sh = params["params"]["block1"]["moe"]
+    assert parallel.moe_shardings(moe_sh, mesh, "ep")["w_in"].spec == P("ep", None, None)
+    tok_sh = NamedSharding(mesh, P("dp", None))
+    out = jax.jit(model.apply, in_shardings=(p_sh, tok_sh))(params, tokens)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
+
+
 def test_find_batch_size_runs():
     def make_batch(n):
         return (jnp.zeros((n, 16), jnp.float32),)

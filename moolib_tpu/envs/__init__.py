@@ -1,13 +1,18 @@
 """Self-contained environments with the gym step/reset protocol.
 
 The reference depends on external ``gym``/ALE for its examples and tests
-(``examples/atari/environment.py:19-40``); this image has neither, so the
-framework ships its own envs: CartPole (classic control, used by the A2C
-example like the reference's CartPole-v1), Catch (a minimal *learnable*
-pixel game standing in for Atari in IMPALA integration tests), and a
-synthetic Atari-shaped env for throughput benchmarking.
+(``examples/atari/environment.py:19-40``).  This package ships self-contained
+envs — CartPole (classic control, used by the A2C example like the
+reference's CartPole-v1), Catch (a minimal *learnable* pixel game standing
+in for Atari in IMPALA integration tests), and a synthetic Atari-shaped env
+for throughput benchmarking — plus ``atari.py``: the reference's full Atari
+preprocessing stack (frameskip/max-pool, grayscale, 84x84, sticky actions,
+frame stack) over any gymnasium-API env, a :class:`GymEnv` protocol adapter
+for gymnasium ids, and an ALE factory (``create_env``) that needs ale_py
+(not in this image; the preprocessing itself is tested without it).
 """
 
+from .atari import AtariPreprocessing, GymEnv, create_env  # noqa: F401
 from .cartpole import CartPoleEnv  # noqa: F401
 from .catch import CatchEnv  # noqa: F401
 from .synthetic import SyntheticAtariEnv  # noqa: F401

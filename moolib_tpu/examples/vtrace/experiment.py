@@ -47,7 +47,8 @@ def make_flags(argv=None):
     p.add_argument(
         "--env",
         default="catch",
-        choices=["catch", "pixel_catch", "cartpole", "synthetic"],
+        help="catch | pixel_catch | cartpole | synthetic | atari:<Game> "
+        "(needs ale_py) | gym:<gymnasium id> (Discrete actions)",
     )
     p.add_argument("--total_steps", type=int, default=500_000)
     p.add_argument("--actor_batch_size", type=int, default=32)
@@ -137,6 +138,32 @@ def make_env_factory(flags):
         return factory, CatchEnv.num_actions, (42, 42, 1)
     if flags.env == "cartpole":
         return CartPoleEnv, 2, (4,)
+    if flags.env.startswith("atari:"):
+        # Real ALE (reference examples/atari/environment.py), e.g.
+        # --env atari:Pong.  Probe once in the parent for a clear error and
+        # for the action count; workers build their own instances.
+        from ...envs.atari import create_env
+
+        game = flags.env.split(":", 1)[1]
+        probe = create_env(game)
+        n, shape = probe.num_actions, probe.observation_shape
+        probe.close()
+        return partial(create_env, game), n, shape
+    if flags.env.startswith("gym:"):
+        # Any gymnasium env id with a Discrete action space, e.g.
+        # --env gym:CartPole-v1, through the GymEnv protocol adapter.
+        from ...envs.atari import GymEnv
+
+        env_id = flags.env.split(":", 1)[1]
+        probe = GymEnv(env_id)
+        n, shape = probe.num_actions, probe.reset().shape
+        probe.close()
+        return partial(GymEnv, env_id), n, tuple(shape)
+    if flags.env != "synthetic":
+        raise ValueError(
+            f"unknown --env {flags.env!r} (catch | pixel_catch | cartpole | "
+            "synthetic | atari:<Game> | gym:<id>)"
+        )
     return SyntheticAtariEnv, 6, (84, 84, 4)
 
 

@@ -1,0 +1,184 @@
+"""Atari preprocessing parity (reference examples/atari/atari_preprocessing.py)
+tested against a fake ALE-style env, and the gymnasium protocol adapter
+against the real gymnasium CartPole (present in this image; ale_py is not)."""
+
+import numpy as np
+import pytest
+
+from moolib_tpu.envs.atari import AtariPreprocessing, GymEnv, create_env
+
+
+class _Space:
+    def __init__(self, n):
+        self.n = n
+
+
+class FakeALE:
+    """gymnasium-API env emitting 210x160 RGB frames whose brightness encodes
+    the emulator step (so max-pooling and skipping are observable)."""
+
+    def __init__(self, episode_len=20, flicker=False):
+        self.action_space = _Space(6)
+        self.episode_len = episode_len
+        self.flicker = flicker
+        self.t = 0
+        self.actions = []
+
+    def _frame(self):
+        if self.flicker and self.t % 2 == 0:
+            return np.zeros((210, 160, 3), np.uint8)  # odd frames go black
+        v = min(10 * self.t, 255)
+        return np.full((210, 160, 3), v, np.uint8)
+
+    def reset(self, seed=None):
+        self.t = 0
+        self.actions = []
+        return self._frame(), {}
+
+    def step(self, action):
+        self.actions.append(int(action))
+        self.t += 1
+        reward = 1.0  # one reward unit per emulator step
+        done = self.t >= self.episode_len
+        return self._frame(), reward, done, False, {}
+
+
+def test_shapes_reward_sum_and_frameskip():
+    env = AtariPreprocessing(FakeALE(), frame_skip=4, num_stack=4)
+    assert env.observation_shape == (84, 84, 4)
+    assert env.num_actions == 6
+    obs = env.reset()
+    assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+    # Reset frame is stacked 4x.
+    assert (obs[..., 0] == obs[..., 3]).all()
+    obs, reward, done, _ = env.step(0)
+    assert reward == 4.0  # rewards summed over the skip
+    assert env.env.t == 4  # 4 emulator steps per agent step
+    assert not done
+    # The newest channel is the brightest (brightness encodes time).
+    assert obs[..., 3].mean() > obs[..., 0].mean()
+
+
+def test_flicker_maxpool_takes_brighter_of_last_two():
+    # With flicker, the final raw frame alternates black; max over the last
+    # two frames must recover the bright one.
+    env = AtariPreprocessing(FakeALE(flicker=True), frame_skip=4, num_stack=1)
+    env.reset()
+    obs, _, _, _ = env.step(0)
+    assert obs[..., 0].max() > 0
+
+
+def test_done_mid_skip_stops_stepping_and_sums_partial_reward():
+    env = AtariPreprocessing(FakeALE(episode_len=6), frame_skip=4, num_stack=2)
+    env.reset()
+    _, r1, d1, _ = env.step(0)
+    assert (r1, d1) == (4.0, False)
+    _, r2, d2, _ = env.step(0)
+    assert (r2, d2) == (2.0, True)  # only 2 emulator steps remained
+    assert env.env.t == 6
+
+
+def test_sticky_actions_repeat_previous():
+    env = AtariPreprocessing(FakeALE(), frame_skip=1, sticky_action_prob=1.0, seed=0)
+    env.reset()
+    env.step(3)  # first step: prev_action is 0, sticky forces 0
+    env.step(5)
+    env.step(1)
+    assert env.env.actions == [0, 0, 0]  # p=1.0: the initial action persists
+
+
+def test_sticky_actions_drawn_per_emulator_frame():
+    """Machado et al. §5: the sticky coin flips at every emulator frame, so
+    the executed action can change mid-skip (not one draw per agent step)."""
+    env = AtariPreprocessing(
+        FakeALE(episode_len=1000), frame_skip=4, sticky_action_prob=0.5, seed=1
+    )
+    env.reset()
+    env.step(2)
+    for _ in range(20):
+        env.step(5)
+    skips = [env.env.actions[i : i + 4] for i in range(4, len(env.env.actions), 4)]
+    # With p=0.5 over 20 four-frame skips, some skip must mix old/new actions.
+    assert any(len(set(s)) > 1 for s in skips), env.env.actions
+
+
+class FakeALEWithLives(FakeALE):
+    def __init__(self, episode_len=100, lives=3, life_len=5):
+        super().__init__(episode_len=episode_len)
+        self._lives, self._life_len = lives, life_len
+        self.full_resets = 0
+        outer = self
+
+        class _Ale:
+            def lives(self):
+                return outer._lives
+
+        self.ale = _Ale()
+
+    def reset(self, seed=None):
+        self.full_resets += 1
+        self._lives = 3
+        return super().reset(seed=seed)
+
+    def step(self, action):
+        obs, r, term, trunc, info = super().step(action)
+        if self.t % self._life_len == 0 and self._lives > 0:
+            self._lives -= 1
+        term = self._lives == 0 or term
+        return obs, r, term, trunc, info
+
+
+def test_episodic_life_continues_game_until_game_over():
+    env = AtariPreprocessing(
+        FakeALEWithLives(), frame_skip=1, num_stack=1, terminal_on_life_loss=True
+    )
+    env.reset()
+    assert env.env.full_resets == 1
+    dones = 0
+    for _ in range(40):
+        _, _, done, _ = env.step(0)
+        if done:
+            env.reset()
+            dones += 1
+    # The agent saw several episode ends (one per life), but the emulator
+    # only fully reset on real game-overs — not on every life loss.
+    assert dones >= 3
+    assert env.env.full_resets < 1 + dones
+
+
+def test_frame_stack_shifts():
+    env = AtariPreprocessing(FakeALE(), frame_skip=1, num_stack=4)
+    env.reset()
+    o1, *_ = env.step(0)
+    o2, *_ = env.step(0)
+    np.testing.assert_array_equal(o2[..., 2], o1[..., 3])
+
+
+def test_create_env_without_ale_raises_clear_error():
+    with pytest.raises(ImportError, match="ale_py|ale-py"):
+        create_env("Pong")
+
+
+def test_gym_adapter_protocol_with_real_gymnasium_cartpole():
+    env = GymEnv("CartPole-v1", seed=0)
+    assert env.num_actions == 2
+    obs = env.reset()
+    assert obs.shape == (4,)
+    steps = 0
+    done = False
+    while not done and steps < 500:
+        obs, reward, done, info = env.step(steps % 2)
+        assert obs.shape == (4,) and isinstance(done, bool)
+        assert reward == 1.0
+        steps += 1
+    assert done  # alternating actions topple the pole well before 500
+    env.close()
+
+
+def test_gym_adapter_reseed_only_first_reset():
+    a = GymEnv("CartPole-v1", seed=123)
+    b = GymEnv("CartPole-v1", seed=123)
+    first_a, first_b = a.reset(), b.reset()
+    np.testing.assert_array_equal(first_a, first_b)  # seed honored once
+    # If reset re-applied the seed, the state would replay identically.
+    assert not np.array_equal(first_a, a.reset())

@@ -33,15 +33,17 @@ class GymEnv:
             env_or_id = gymnasium.make(env_or_id, **make_kwargs)
         self.env = env_or_id
         self._seed = seed
-        n = getattr(getattr(self.env, "action_space", None), "n", None)
-        if n is None:
+        import gymnasium
+
+        space = getattr(self.env, "action_space", None)
+        # Strict isinstance: MultiBinary etc. also duck-type ``.n``.
+        if not isinstance(space, gymnasium.spaces.Discrete):
             raise ValueError(
-                f"{self.env} has action space "
-                f"{getattr(self.env, 'action_space', None)!r}; the framework "
-                "agents act by integer index, so only Discrete action spaces "
-                "are supported"
+                f"{self.env} has action space {space!r}; the framework agents "
+                "act by integer index, so only Discrete action spaces are "
+                "supported"
             )
-        self.num_actions = int(n)
+        self.num_actions = int(space.n)
 
     def reset(self):
         obs, _ = self.env.reset(seed=self._seed)
@@ -209,7 +211,13 @@ def create_env(
             repeat_action_probability=0.0,
             full_action_space=full_action_space,
         )
-    except Exception as e:  # gymnasium without ale_py, or missing ROM
+    except Exception as e:
+        # Missing-ALE shows up as ImportError or an unknown-ALE-namespace
+        # error; anything else (e.g. a typo'd game name with ale_py
+        # installed) is the caller's problem and keeps its own message.
+        msg = str(e).lower()
+        if not (isinstance(e, ImportError) or "ale" in msg and "namespace" in msg):
+            raise
         raise ImportError(
             f"creating ALE/{game}-v5 failed ({e!r}). Real Atari needs the "
             "ale_py package and its ROMs (pip install ale-py gymnasium[atari]); "

@@ -62,6 +62,19 @@ def make_flags(argv=None):
         "experts; add an ep axis to --mesh to shard them (expert parallelism)",
     )
     p.add_argument("--moe_aux_weight", type=float, default=0.01)
+    p.add_argument(
+        "--microbatches",
+        type=int,
+        default=0,
+        help="pipeline microbatches when --mesh has a pp axis (0 = 2*pp)",
+    )
+    p.add_argument(
+        "--pp_repeats",
+        type=int,
+        default=1,
+        help="circular-schedule virtual stages per pp device "
+        "(--layers must equal pp_repeats * pp)",
+    )
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--learning_rate", type=float, default=3e-3)
     p.add_argument("--log_interval", type=int, default=50)
@@ -102,6 +115,25 @@ def train(flags, on_stats=None) -> dict:
         # MoE lands on every 2nd block (TransformerLM.moe_every); with a
         # single layer no expert would ever be created.
         raise ValueError("--moe_experts needs --layers >= 2")
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        if flags.attention == "ring":
+            raise ValueError("pipeline (pp) composes with dense/flash, not ring")
+        if flags.moe_experts:
+            raise ValueError("pipeline (pp) needs identical blocks (no --moe_experts)")
+        if flags.layers != flags.pp_repeats * pp:
+            raise ValueError(
+                f"--layers must be pp_repeats*pp = {flags.pp_repeats * pp}"
+            )
+    microbatches = flags.microbatches or 2 * pp
+    if pp > 1:
+        if flags.batch_size % microbatches:
+            raise ValueError("--batch_size must be divisible by --microbatches")
+        if (flags.batch_size // microbatches) % axes.get("dp", 1):
+            raise ValueError(
+                "the per-microbatch batch (batch_size/microbatches) must be "
+                "divisible by the dp axis size"
+            )
 
     model = TransformerLM(
         vocab_size=flags.vocab,
@@ -122,7 +154,20 @@ def train(flags, on_stats=None) -> dict:
     half = flags.seq_len // 2
 
     def loss_fn(params, tokens):
-        if flags.moe_experts:
+        if pp > 1:
+            from ..models.transformer import pipeline_lm_apply
+
+            logits = pipeline_lm_apply(
+                model,
+                params,
+                tokens,
+                mesh,
+                num_microbatches=microbatches,
+                data_axis="dp" if axes.get("dp", 1) > 1 else None,
+                circular_repeats=flags.pp_repeats,
+            )
+            aux = 0.0
+        elif flags.moe_experts:
             logits, col = model.apply(
                 params, tokens, mutable=["losses"], **apply_kwargs
             )
@@ -154,7 +199,9 @@ def train(flags, on_stats=None) -> dict:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         rep = parallel.replicated(mesh)
-        tok_sharding = NamedSharding(mesh, P("dp", None))
+        tok_sharding = NamedSharding(
+            mesh, P("dp", None) if axes.get("dp", 1) > 1 else P()
+        )
         # Expert weights shard over ep when the mesh has that axis (EP);
         # the rest of the params stay replicated.
         if flags.moe_experts and "ep" in mesh.axis_names:

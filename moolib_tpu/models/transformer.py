@@ -119,3 +119,74 @@ class TransformerLM(nn.Module):
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(
             x.astype(jnp.float32)
         )
+
+
+def pipeline_lm_apply(
+    model: TransformerLM,
+    params,
+    tokens: jax.Array,
+    mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    data_axis: Optional[str] = None,
+    circular_repeats: int = 1,
+    remat: bool = False,
+) -> jax.Array:
+    """Apply ``model`` with its transformer blocks run through
+    :func:`..parallel.pipeline.pipeline_apply` over the mesh's ``pp`` axis.
+
+    The blocks of a (non-MoE) TransformerLM are structurally identical, so
+    their parameters stack into the leading virtual-stage axis the pipeline
+    expects; embeddings and the LM head stay outside the pipeline
+    (replicated — they are a sliver of the FLOPs).  Differentiable end to
+    end: gradients flow back through the schedule into the *per-block*
+    leaves of ``params``, so one optimizer tree serves both the pipelined
+    and plain paths.  Attention must be "dense" or "flash" (ring attention's
+    own collective axis would have to nest inside the pipeline shard_map).
+
+    With ``circular_repeats=v``, the model's ``num_layers`` must be
+    ``v * mesh.shape[axis_name]`` and microbatch count a multiple of the pp
+    size (see pipeline_apply).
+    """
+    from ..parallel.pipeline import pipeline_apply
+
+    if model.attention == "ring":
+        raise ValueError("pipeline_lm_apply supports dense/flash attention only")
+    if model.moe_num_experts:
+        raise ValueError(
+            "pipeline_lm_apply needs structurally identical blocks (no MoE)"
+        )
+    B, T = tokens.shape
+    if B % num_microbatches:
+        raise ValueError(f"batch {B} not divisible by microbatches {num_microbatches}")
+    p = params["params"]
+    L = model.num_layers
+
+    emb = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
+    pos = nn.Embed(model.max_len, model.d_model, dtype=model.dtype)
+    x = emb.apply({"params": p["Embed_0"]}, tokens)
+    x = x + pos.apply({"params": p["pos"]}, jnp.arange(T)[None, :])
+
+    block = Block(model.d_model, model.num_heads, model.attention, model.dtype)
+    stage_params = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *(p[f"block{i}"] for i in range(L))
+    )
+
+    def stage_fn(bp, x):
+        return block.apply({"params": bp}, x)
+
+    mb = x.reshape(num_microbatches, B // num_microbatches, T, model.d_model)
+    out = pipeline_apply(
+        stage_fn,
+        stage_params,
+        mb,
+        mesh,
+        axis_name=axis_name,
+        data_axis=data_axis,
+        circular_repeats=circular_repeats,
+        remat=remat,
+    )
+    x = out.reshape(B, T, model.d_model)
+    x = nn.LayerNorm(dtype=jnp.float32).apply({"params": p["LayerNorm_0"]}, x)
+    head = nn.Dense(model.vocab_size, dtype=jnp.float32)
+    return head.apply({"params": p["lm_head"]}, x.astype(jnp.float32))

@@ -1,0 +1,93 @@
+"""Long-context TransformerLM training throughput on real hardware.
+
+Times the full jitted train step (forward + backward + adamw) of the
+framework's TransformerLM with the pallas flash-attention kernel, bf16
+compute, at sequence lengths up to 8k, and reports tokens/s and MFU
+(6*N*tokens/step approximation vs the chip's dense bf16 peak).  The
+reference has no long-context capability (SURVEY.md §5.7) — this bench
+documents the new one on hardware.
+
+    JAX_PLATFORMS='' python benchmarks/lm_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import chain_elapsed, marginal_time  # noqa: E402
+
+# Dense bf16 peak FLOP/s per device kind (same table as bench.py).
+_PEAK = [("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+         ("v5", 459e12), ("v4", 275e12), ("v3", 61.5e12), ("v2", 22.5e12)]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from moolib_tpu.models.transformer import TransformerLM
+
+    if jax.default_backend() == "cpu":
+        raise SystemExit("lm_bench needs an accelerator backend")
+    dev = jax.devices()[0]
+    peak = next((p for s, p in _PEAK if s in dev.device_kind.lower()), None)
+    print(f"# backend={jax.default_backend()} device={dev.device_kind}")
+    print(f"{'T':>6} {'B':>3} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
+
+    rows = []
+    for T, B in ((1024, 16), (2048, 8), (4096, 4), (8192, 2)):
+        model = TransformerLM(
+            vocab_size=32768, d_model=512, num_heads=8, num_layers=8,
+            max_len=8192, attention="flash", dtype=jnp.bfloat16,
+        )
+        rng = np.random.default_rng(T)
+        toks = jnp.asarray(rng.integers(0, 32768, size=(B, T), dtype=np.int32))
+        params = model.init(jax.random.key(0), toks)
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        opt = optax.adamw(1e-4)
+        opt_state = opt.init(params)
+
+        def loss_fn(p, t):
+            logits = model.apply(p, t)
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+            return -jnp.take_along_axis(logp, t[:, 1:, None], axis=-1).mean()
+
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(p, s, t):
+            loss, g = jax.value_and_grad(loss_fn)(p, t)
+            up, s = opt.update(g, s, p)
+            return optax.apply_updates(p, up), s, loss
+
+        state = {"p": params, "s": opt_state}
+
+        def run(iters):
+            def one(st):
+                p, s, loss = step(st["p"], st["s"], toks)
+                return {"p": p, "s": s, "loss": loss}
+
+            return chain_elapsed(one, state, iters, lambda st: float(st["loss"]))
+
+        sec = marginal_time(run, 2, 8)
+        tokens_s = B * T / sec
+        # Standard 6*N*D transformer FLOPs (fwd+bwd) + attention term
+        # 12*L*H*hd*T^2... keep the 6ND convention and report it as such.
+        flops = 6.0 * n_params * B * T
+        mfu = flops / sec / peak if peak else float("nan")
+        print(f"{T:>6} {B:>3} {sec * 1e3:>9.2f} {tokens_s:>10.0f} {mfu:>6.3f}")
+        rows.append(
+            {"T": T, "B": B, "step_ms": round(sec * 1e3, 2),
+             "tokens_per_s": round(tokens_s, 1), "mfu_6nd": round(mfu, 4)}
+        )
+    print(json.dumps({"lm_train": rows}))
+
+
+if __name__ == "__main__":
+    main()

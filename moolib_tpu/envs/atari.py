@@ -27,14 +27,12 @@ class GymEnv:
     ``done = terminated or truncated``)."""
 
     def __init__(self, env_or_id, seed=None, **make_kwargs):
-        if isinstance(env_or_id, str):
-            import gymnasium
+        import gymnasium
 
+        if isinstance(env_or_id, str):
             env_or_id = gymnasium.make(env_or_id, **make_kwargs)
         self.env = env_or_id
         self._seed = seed
-        import gymnasium
-
         space = getattr(self.env, "action_space", None)
         # Strict isinstance: MultiBinary etc. also duck-type ``.n``.
         if not isinstance(space, gymnasium.spaces.Discrete):
@@ -212,11 +210,12 @@ def create_env(
             full_action_space=full_action_space,
         )
     except Exception as e:
-        # Missing-ALE shows up as ImportError or an unknown-ALE-namespace
-        # error; anything else (e.g. a typo'd game name with ale_py
-        # installed) is the caller's problem and keeps its own message.
-        msg = str(e).lower()
-        if not (isinstance(e, ImportError) or "ale" in msg and "namespace" in msg):
+        # Only blame a missing ale_py when it actually is missing; anything
+        # else (e.g. a typo'd game name with ale_py installed) keeps its own
+        # message — gymnasium's NameNotFound includes a did-you-mean.
+        import importlib.util
+
+        if importlib.util.find_spec("ale_py") is not None:
             raise
         raise ImportError(
             f"creating ALE/{game}-v5 failed ({e!r}). Real Atari needs the "

@@ -354,9 +354,14 @@ def train(flags, on_stats=None) -> dict:
         mesh = parallel.make_mesh(axes, devices=mesh_devices)
         if flags.batch_size % mesh.shape.get("dp", 1):
             raise ValueError("the dp mesh axis size must divide --batch_size")
+        sp = mesh.shape.get("sp", 1)
+        if (flags.unroll_length + 1) % sp:
+            raise ValueError("the sp mesh axis size must divide unroll_length+1")
         param_sh = parallel.auto_shardings(params, mesh)
         rep = parallel.replicated(mesh)
-        batch_sharding = NamedSharding(mesh, P(None, "dp"))  # [T+1, B, ...]
+        # [T+1, B, ...]: batch over dp, and the unroll (time) axis over sp
+        # when present — sequence parallelism on the learner batch.
+        batch_sharding = NamedSharding(mesh, P("sp" if sp > 1 else None, "dp"))
         core_sharding = NamedSharding(mesh, P("dp"))  # [B, ...]
         params = jax.device_put(params, param_sh)
         # Optimizer moments follow the same TP/FSDP layout as the params

@@ -99,7 +99,9 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array, mesh=None) -> jax.Array:
         B, T = tokens.shape
-        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(
+            tokens
+        )
         pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos")(
             jnp.arange(T)[None, :]
         )
@@ -115,7 +117,7 @@ class TransformerLM(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 name=f"block{i}",
             )(x, mesh=mesh)
-        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(
             x.astype(jnp.float32)
         )
@@ -164,7 +166,7 @@ def pipeline_lm_apply(
 
     emb = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     pos = nn.Embed(model.max_len, model.d_model, dtype=model.dtype)
-    x = emb.apply({"params": p["Embed_0"]}, tokens)
+    x = emb.apply({"params": p["embed"]}, tokens)
     x = x + pos.apply({"params": p["pos"]}, jnp.arange(T)[None, :])
 
     block = Block(model.d_model, model.num_heads, model.attention, model.dtype)
@@ -187,6 +189,6 @@ def pipeline_lm_apply(
         remat=remat,
     )
     x = out.reshape(B, T, model.d_model)
-    x = nn.LayerNorm(dtype=jnp.float32).apply({"params": p["LayerNorm_0"]}, x)
+    x = nn.LayerNorm(dtype=jnp.float32).apply({"params": p["ln_f"]}, x)
     head = nn.Dense(model.vocab_size, dtype=jnp.float32)
     return head.apply({"params": p["lm_head"]}, x.astype(jnp.float32))

@@ -72,6 +72,38 @@ def test_impala_learns_under_dp_tp_mesh(free_port):
     assert out["mean_episode_return"] > -0.45, f"no learning: {out}"
 
 
+def test_impala_runs_under_dp_sp_tp_mesh(free_port):
+    """Sequence parallelism in the flagship agent: one dp×sp×tp mesh, the
+    learner batch sharded over (sp: unroll time, dp: batch), params TP/FSDP.
+    Short smoke run — the dp×tp test above covers learning."""
+    flags = make_flags(
+        [
+            "--env",
+            "catch",
+            "--total_steps",
+            "2500",
+            "--actor_batch_size",
+            "8",
+            "--batch_size",
+            "4",
+            "--virtual_batch_size",
+            "4",
+            "--num_env_processes",
+            "1",
+            "--unroll_length",
+            "19",  # T+1 = 20 divisible by sp=2
+            "--address",
+            f"127.0.0.1:{free_port}",
+            "--mesh",
+            "dp=2,sp=2,tp=2",
+            "--quiet",
+        ]
+    )
+    out = train(flags)
+    assert out["steps"] >= 2500
+    assert out["sgd_steps"] > 0
+
+
 def test_impala_learns_from_pixels(free_port):
     """VERDICT round-1 ask #7: a pixels task whose optimal policy requires
     reading the frame — Catch rendered at 42×42 through the full ImpalaNet

@@ -9,10 +9,10 @@ from moolib_tpu.models.transformer import TransformerLM
 from moolib_tpu.utils.batchsize import find_batch_size
 
 
-def _model(attention, dtype=jnp.float32):
+def _model(attention, dtype=jnp.float32, moe_num_experts=0):
     return TransformerLM(
         vocab_size=64, d_model=64, num_heads=2, num_layers=2,
-        attention=attention, dtype=dtype,
+        attention=attention, dtype=dtype, moe_num_experts=moe_num_experts,
     )
 
 
@@ -53,10 +53,7 @@ def test_ring_attention_model_on_mesh():
 
 
 def test_moe_forward_sows_aux_loss():
-    model = TransformerLM(
-        vocab_size=64, d_model=64, num_heads=2, num_layers=2,
-        attention="dense", dtype=jnp.float32, moe_num_experts=4,
-    )
+    model = _model("dense", moe_num_experts=4)
     tokens = jax.random.randint(jax.random.key(0), (2, 32), 0, 64)
     params = model.init(jax.random.key(1), tokens)
     # block1 (every 2nd) has a SwitchMoE FFN; block0 keeps the dense FFN.
@@ -70,10 +67,7 @@ def test_moe_forward_sows_aux_loss():
 
 def test_moe_sharded_over_ep_matches_single_device():
     mesh = parallel.make_mesh({"dp": 2, "ep": 4})
-    model = TransformerLM(
-        vocab_size=64, d_model=64, num_heads=2, num_layers=2,
-        attention="dense", dtype=jnp.float32, moe_num_experts=4,
-    )
+    model = _model("dense", moe_num_experts=4)
     tokens = jax.random.randint(jax.random.key(0), (4, 32), 0, 64)
     params = model.init(jax.random.key(1), tokens)
     ref = model.apply(params, tokens)
@@ -86,6 +80,33 @@ def test_moe_sharded_over_ep_matches_single_device():
     assert parallel.moe_shardings(moe_sh, mesh, "ep")["w_in"].spec == P("ep", None, None)
     tok_sh = NamedSharding(mesh, P("dp", None))
     out = jax.jit(model.apply, in_shardings=(p_sh, tok_sh))(params, tokens)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
+
+
+def test_moe_shardings_compose_with_tp_fsdp_base():
+    """EP over ep + TP/FSDP over tp/dp from auto_shardings in ONE mesh: the
+    expert leaves take the ep spec, everything else keeps the base spec, and
+    the jitted sharded apply still matches single-device numerics."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = parallel.make_mesh({"dp": 2, "tp": 2, "ep": 2})
+    model = _model("dense", moe_num_experts=4)
+    tokens = jax.random.randint(jax.random.key(0), (4, 32), 0, 64)
+    params = model.init(jax.random.key(1), tokens)
+    base = parallel.auto_shardings(params, mesh)
+    p_sh = parallel.moe_shardings(params, mesh, "ep", base=base)
+    flat = jax.tree_util.tree_leaves_with_path(p_sh)
+    specs = {
+        "/".join(str(getattr(k, "key", k)) for k in path): s.spec
+        for path, s in flat
+    }
+    assert specs["params/block1/moe/w_in"] == P("ep", None, None)
+    assert specs["params/block1/moe/w_out"] == P("ep", None, None)
+    # Non-expert leaves keep the auto_shardings TP spec (last axis over tp).
+    assert specs["params/block0/qkv/kernel"][-1] == "tp"
+    tok_sh = NamedSharding(mesh, P("dp", None))
+    out = jax.jit(model.apply, in_shardings=(p_sh, tok_sh))(params, tokens)
+    ref = model.apply(params, tokens)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
 
 

@@ -55,6 +55,12 @@ def make_flags(argv=None):
         "shards T over sp); empty string = single device + dense",
     )
     p.add_argument(
+        "--pos",
+        default="learned",
+        choices=["learned", "rotary"],
+        help="position encoding: learned table (capped at seq_len) or rotary",
+    )
+    p.add_argument(
         "--moe_experts",
         type=int,
         default=0,
@@ -143,6 +149,7 @@ def train(flags, on_stats=None) -> dict:
         max_len=flags.seq_len,
         attention=flags.attention,
         moe_num_experts=flags.moe_experts,
+        pos_embedding=flags.pos,
     )
     rng = np.random.default_rng(flags.seed)
     tokens0 = jnp.asarray(make_batch(rng, flags))

@@ -28,6 +28,29 @@ import jax
 import jax.numpy as jnp
 
 
+def apply_rotary(x: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding (RoPE, Su et al. 2021) on [B, T, H, D].
+
+    Rotates feature pairs by position-proportional angles so attention scores
+    depend on *relative* offsets — the standard long-context choice (no
+    learned table capping the usable length, graceful extrapolation).
+    Computed in float32 and cast back (bf16 angles visibly distort long-range
+    phases).
+    """
+    B, T, H, D = x.shape
+    half = D // 2
+    if D % 2:
+        raise ValueError(f"rotary needs an even head dim, got {D}")
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)  # [half]
+    angles = jnp.arange(T, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 class Block(nn.Module):
     d_model: int
     num_heads: int
@@ -35,6 +58,7 @@ class Block(nn.Module):
     dtype: Any
     moe_num_experts: int = 0  # 0 = dense FFN; >0 = SwitchMoE FFN (EP-shardable)
     moe_capacity_factor: float = 1.25
+    rotary: bool = False
 
     @nn.compact
     def __call__(self, x, mesh=None):
@@ -44,6 +68,8 @@ class Block(nn.Module):
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         qkv = nn.Dense(3 * D, dtype=self.dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, hd), 3, axis=2)
+        if self.rotary:
+            q, k = apply_rotary(q), apply_rotary(k)
 
         if self.attention == "ring":
             from ..parallel.ring_attention import ring_attention
@@ -95,6 +121,7 @@ class TransformerLM(nn.Module):
     moe_num_experts: int = 0  # >0: MoE FFN on every ``moe_every``-th block
     moe_every: int = 2  # blocks i with i % moe_every == moe_every - 1 use MoE
     moe_capacity_factor: float = 1.25
+    pos_embedding: str = "learned"  # learned (table, capped at max_len) | rotary
 
     @nn.compact
     def __call__(self, tokens: jax.Array, mesh=None) -> jax.Array:
@@ -102,10 +129,12 @@ class TransformerLM(nn.Module):
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(
             tokens
         )
-        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos")(
-            jnp.arange(T)[None, :]
-        )
-        x = x + pos
+        if self.pos_embedding == "learned":
+            x = x + nn.Embed(
+                self.max_len, self.d_model, dtype=self.dtype, name="pos"
+            )(jnp.arange(T)[None, :])
+        elif self.pos_embedding != "rotary":
+            raise ValueError(f"unknown pos_embedding {self.pos_embedding!r}")
         for i in range(self.num_layers):
             use_moe = self.moe_num_experts and i % self.moe_every == self.moe_every - 1
             x = Block(
@@ -115,6 +144,7 @@ class TransformerLM(nn.Module):
                 self.dtype,
                 moe_num_experts=self.moe_num_experts if use_moe else 0,
                 moe_capacity_factor=self.moe_capacity_factor,
+                rotary=self.pos_embedding == "rotary",
                 name=f"block{i}",
             )(x, mesh=mesh)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
@@ -165,11 +195,17 @@ def pipeline_lm_apply(
     L = model.num_layers
 
     emb = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
-    pos = nn.Embed(model.max_len, model.d_model, dtype=model.dtype)
     x = emb.apply({"params": p["embed"]}, tokens)
-    x = x + pos.apply({"params": p["pos"]}, jnp.arange(T)[None, :])
+    if model.pos_embedding == "learned":
+        pos = nn.Embed(model.max_len, model.d_model, dtype=model.dtype)
+        x = x + pos.apply({"params": p["pos"]}, jnp.arange(T)[None, :])
+    elif model.pos_embedding != "rotary":
+        raise ValueError(f"unknown pos_embedding {model.pos_embedding!r}")
 
-    block = Block(model.d_model, model.num_heads, model.attention, model.dtype)
+    block = Block(
+        model.d_model, model.num_heads, model.attention, model.dtype,
+        rotary=model.pos_embedding == "rotary",
+    )
     stage_params = jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *(p[f"block{i}"] for i in range(L))
     )

@@ -52,6 +52,57 @@ def test_ring_attention_model_on_mesh():
     np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_r), rtol=2e-4, atol=2e-4)
 
 
+def test_rotary_dense_flash_parity_and_causality():
+    """RoPE applies to q/k before attention, so dense and flash must still
+    agree; causality must still hold; and a rotary model runs past max_len
+    (no learned table to exhaust — the long-context point of RoPE)."""
+    from moolib_tpu.models.transformer import TransformerLM
+
+    def mk(attention):
+        return TransformerLM(
+            vocab_size=64, d_model=64, num_heads=2, num_layers=2,
+            attention=attention, dtype=jnp.float32, pos_embedding="rotary",
+            max_len=64,
+        )
+
+    dense, flash = mk("dense"), mk("flash")
+    tokens = jax.random.randint(jax.random.key(0), (2, 128), 0, 64)  # T > max_len
+    params = dense.init(jax.random.key(1), tokens)
+    assert "pos" not in params["params"]  # no learned table
+    out_d = dense.apply(params, tokens)
+    out_f = flash.apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_f), rtol=2e-4, atol=2e-4)
+    # Causality: edits after position 100 cannot change earlier logits.
+    t2 = tokens.at[0, 100:].set((tokens[0, 100:] + 7) % 64)
+    o2 = dense.apply(params, t2)
+    np.testing.assert_allclose(
+        np.asarray(out_d[0, :100]), np.asarray(o2[0, :100]), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_rotary_scores_are_relative():
+    """The RoPE invariant: rotating q and k leaves q·k dependent only on the
+    relative offset, so shifting a sequence shifts the (non-edge) attention
+    pattern rather than changing it."""
+    from moolib_tpu.models.transformer import apply_rotary
+
+    x = jax.random.normal(jax.random.key(0), (1, 16, 1, 8))
+    q, k = apply_rotary(x), apply_rotary(x)
+    # score(i, j) for the original at (i, j) equals score(i+s, j+s) when the
+    # inputs are shifted by s positions.
+    s = 4
+    xs = jnp.roll(x, s, axis=1)
+    qs, ks = apply_rotary(xs), apply_rotary(xs)
+    orig = jnp.einsum("bqhd,bkhd->bqk", q, k)
+    shif = jnp.einsum("bqhd,bkhd->bqk", qs, ks)
+    np.testing.assert_allclose(
+        np.asarray(orig[0, : 16 - s, : 16 - s]),
+        np.asarray(shif[0, s:, s:]),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
 def test_moe_forward_sows_aux_loss():
     model = _model("dense", moe_num_experts=4)
     tokens = jax.random.randint(jax.random.key(0), (2, 32), 0, 64)

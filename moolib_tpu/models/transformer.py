@@ -28,21 +28,23 @@ import jax
 import jax.numpy as jnp
 
 
-def apply_rotary(x: jax.Array, base: float = 10000.0) -> jax.Array:
+def apply_rotary(x: jax.Array, base: float = 10000.0, offset=0) -> jax.Array:
     """Rotary position embedding (RoPE, Su et al. 2021) on [B, T, H, D].
 
     Rotates feature pairs by position-proportional angles so attention scores
     depend on *relative* offsets — the standard long-context choice (no
     learned table capping the usable length, graceful extrapolation).
     Computed in float32 and cast back (bf16 angles visibly distort long-range
-    phases).
+    phases).  ``offset`` shifts the positions (the cache index during
+    autoregressive decoding); it may be a traced scalar.
     """
     B, T, H, D = x.shape
     half = D // 2
     if D % 2:
         raise ValueError(f"rotary needs an even head dim, got {D}")
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)  # [half]
-    angles = jnp.arange(T, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, half]
+    positions = offset + jnp.arange(T, dtype=jnp.float32)
+    angles = positions[:, None] * freqs[None, :]  # [T, half]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     xf = x.astype(jnp.float32)
@@ -59,6 +61,8 @@ class Block(nn.Module):
     moe_num_experts: int = 0  # 0 = dense FFN; >0 = SwitchMoE FFN (EP-shardable)
     moe_capacity_factor: float = 1.25
     rotary: bool = False
+    decode: bool = False  # single-token steps against a KV cache (generation)
+    max_len: int = 8192  # cache capacity in decode mode
 
     @nn.compact
     def __call__(self, x, mesh=None):
@@ -68,23 +72,64 @@ class Block(nn.Module):
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         qkv = nn.Dense(3 * D, dtype=self.dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, hd), 3, axis=2)
-        if self.rotary:
-            q, k = apply_rotary(q), apply_rotary(k)
 
-        if self.attention == "ring":
-            from ..parallel.ring_attention import ring_attention
-
-            if mesh is None:
-                raise ValueError("attention='ring' needs mesh= at apply time")
-            att = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
-        elif self.attention == "flash":
-            from ..ops.flash_attention import flash_attention
-
-            att = flash_attention(q, k, v, causal=True)
+        if self.decode:
+            # Autoregressive step: x is [B, 1, D]; append this position's
+            # K/V to the cache and attend over everything cached so far.
+            if T != 1:
+                raise ValueError(f"decode mode steps one token at a time, got T={T}")
+            ck = self.variable(
+                "cache", "k", jnp.zeros, (B, self.max_len, H, hd), self.dtype
+            )
+            cv = self.variable(
+                "cache", "v", jnp.zeros, (B, self.max_len, H, hd), self.dtype
+            )
+            idx = self.variable(
+                "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+            )
+            t = idx.value
+            if self.rotary:
+                q = apply_rotary(q, offset=t)
+                k = apply_rotary(k, offset=t)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(self.dtype), (0, t, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(self.dtype), (0, t, 0, 0)
+            )
+            idx.value = t + 1
+            scale = hd**-0.5
+            scores = (
+                jnp.einsum(
+                    "bqhd,bkhd->bhqk",
+                    q.astype(jnp.float32),
+                    ck.value.astype(jnp.float32),
+                )
+                * scale
+            )
+            mask = jnp.arange(self.max_len)[None, None, None, :] <= t
+            scores = jnp.where(mask, scores, -1e30)
+            p_att = jax.nn.softmax(scores, axis=-1)
+            att = jnp.einsum(
+                "bhqk,bkhd->bqhd", p_att, cv.value.astype(jnp.float32)
+            ).astype(x.dtype)
         else:
-            from ..parallel.ring_attention import full_attention
+            if self.rotary:
+                q, k = apply_rotary(q), apply_rotary(k)
+            if self.attention == "ring":
+                from ..parallel.ring_attention import ring_attention
 
-            att = full_attention(q, k, v, causal=True)
+                if mesh is None:
+                    raise ValueError("attention='ring' needs mesh= at apply time")
+                att = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+            elif self.attention == "flash":
+                from ..ops.flash_attention import flash_attention
+
+                att = flash_attention(q, k, v, causal=True)
+            else:
+                from ..parallel.ring_attention import full_attention
+
+                att = full_attention(q, k, v, causal=True)
         att = att.reshape(B, T, D)
         x = x + nn.Dense(D, dtype=self.dtype, name="proj")(att)
 
@@ -122,6 +167,7 @@ class TransformerLM(nn.Module):
     moe_every: int = 2  # blocks i with i % moe_every == moe_every - 1 use MoE
     moe_capacity_factor: float = 1.25
     pos_embedding: str = "learned"  # learned (table, capped at max_len) | rotary
+    decode: bool = False  # single-token KV-cache steps (see generate())
 
     @nn.compact
     def __call__(self, tokens: jax.Array, mesh=None) -> jax.Array:
@@ -130,9 +176,18 @@ class TransformerLM(nn.Module):
             tokens
         )
         if self.pos_embedding == "learned":
+            pos_idx = jnp.arange(T)[None, :]
+            if self.decode:
+                # The LM owns its position counter (how many tokens have
+                # been decoded) rather than peeking at a child block's cache.
+                ctr = self.variable(
+                    "cache", "pos_idx", lambda: jnp.zeros((), jnp.int32)
+                )
+                pos_idx = pos_idx + ctr.value
+                ctr.value = ctr.value + T
             x = x + nn.Embed(
                 self.max_len, self.d_model, dtype=self.dtype, name="pos"
-            )(jnp.arange(T)[None, :])
+            )(pos_idx)
         elif self.pos_embedding != "rotary":
             raise ValueError(f"unknown pos_embedding {self.pos_embedding!r}")
         for i in range(self.num_layers):
@@ -145,12 +200,95 @@ class TransformerLM(nn.Module):
                 moe_num_experts=self.moe_num_experts if use_moe else 0,
                 moe_capacity_factor=self.moe_capacity_factor,
                 rotary=self.pos_embedding == "rotary",
+                decode=self.decode,
+                max_len=self.max_len,
                 name=f"block{i}",
             )(x, mesh=mesh)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(
             x.astype(jnp.float32)
         )
+
+
+def generate(
+    model: TransformerLM,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive sampling with a per-block KV cache.
+
+    ``prompt`` is [B, Tp] int32; returns [B, Tp + max_new_tokens] with the
+    continuation appended.  Each step attends against cached K/V (O(T) per
+    token instead of O(T²) re-forwarding), the flax ``decode`` pattern:
+    blocks append to a ``cache`` collection carried through two scans
+    (teacher-forced prefill over the prompt, then sampling).
+    ``temperature=0`` is greedy argmax; otherwise softmax sampling with
+    ``rng``.
+    """
+    B, Tp = prompt.shape
+    if Tp + max_new_tokens > model.max_len:
+        raise ValueError(
+            f"prompt + max_new_tokens = {Tp + max_new_tokens} exceeds the "
+            f"cache capacity max_len={model.max_len}"
+        )
+    if model.moe_num_experts:
+        # Per-step Switch capacity is computed over B tokens, not B*T, so
+        # cached decoding would drop different tokens than the training
+        # forward — refuse rather than silently diverge.
+        raise ValueError("generate() does not support MoE models yet")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 needs an explicit rng key")
+    dec = TransformerLM(
+        vocab_size=model.vocab_size,
+        d_model=model.d_model,
+        num_heads=model.num_heads,
+        num_layers=model.num_layers,
+        max_len=model.max_len,
+        attention="dense",  # unused in decode steps (cached attention)
+        dtype=model.dtype,
+        moe_num_experts=model.moe_num_experts,
+        moe_every=model.moe_every,
+        moe_capacity_factor=model.moe_capacity_factor,
+        pos_embedding=model.pos_embedding,
+        decode=True,
+    )
+    pdict = {"params": params["params"]}
+
+    def step(cache, tok):
+        logits, upd = dec.apply(
+            {**pdict, "cache": cache}, tok[:, None], mutable=["cache"]
+        )
+        return upd["cache"], logits[:, 0]
+
+    # Prefill: the first apply creates the cache variables; the rest scan.
+    first_logits, vars0 = dec.apply(pdict, prompt[:, :1], mutable=["cache"])
+    cache = vars0["cache"]
+    if Tp > 1:
+        cache, logits_seq = jax.lax.scan(step, cache, prompt[:, 1:].T)
+        last_logits = logits_seq[-1]
+    else:
+        last_logits = first_logits[:, 0]
+
+    if rng is None:
+        rng = jax.random.key(0)  # unused: greedy path (temperature == 0)
+
+    def gen_step(carry, _):
+        cache, logits, rng = carry
+        if temperature == 0.0:
+            tok = jnp.argmax(logits, axis=-1)
+        else:
+            rng, sub = jax.random.split(rng)
+            tok = jax.random.categorical(sub, logits / temperature, axis=-1)
+        cache, logits = step(cache, tok)
+        return (cache, logits, rng), tok
+
+    (_, _, _), new_toks = jax.lax.scan(
+        gen_step, (cache, last_logits, rng), None, length=max_new_tokens
+    )
+    return jnp.concatenate([prompt, new_toks.T.astype(prompt.dtype)], axis=1)
 
 
 def pipeline_lm_apply(

@@ -103,6 +103,51 @@ def test_rotary_scores_are_relative():
     )
 
 
+def test_kv_cache_generate_matches_full_reforwarding():
+    """Greedy decoding against the KV cache must produce exactly the tokens
+    that naive full re-forwarding (O(T^2) per token) produces — for both
+    position encodings."""
+    from moolib_tpu.models.transformer import generate
+
+    for pos in ("learned", "rotary"):
+        model = TransformerLM(
+            vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+            attention="dense", dtype=jnp.float32, pos_embedding=pos, max_len=64,
+        )
+        prompt = jax.random.randint(jax.random.key(0), (2, 12), 0, 64)
+        params = model.init(jax.random.key(1), prompt)
+
+        toks = prompt
+        for _ in range(8):
+            logits = model.apply(params, toks)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            toks = jnp.concatenate([toks, nxt[:, None].astype(toks.dtype)], axis=1)
+
+        out = generate(model, params, prompt, max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(toks), err_msg=pos)
+
+
+def test_generate_respects_cache_capacity_and_samples():
+    from moolib_tpu.models.transformer import generate
+
+    model = TransformerLM(
+        vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+        attention="dense", dtype=jnp.float32, max_len=16,
+    )
+    prompt = jax.random.randint(jax.random.key(0), (1, 8), 0, 64)
+    params = model.init(jax.random.key(1), prompt)
+    import pytest
+
+    with pytest.raises(ValueError, match="cache capacity"):
+        generate(model, params, prompt, max_new_tokens=9)
+    out = generate(
+        model, params, prompt, max_new_tokens=8, temperature=1.0,
+        rng=jax.random.key(2),
+    )
+    assert out.shape == (1, 16)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 64).all()
+
+
 def test_moe_forward_sows_aux_loss():
     model = _model("dense", moe_num_experts=4)
     tokens = jax.random.randint(jax.random.key(0), (2, 32), 0, 64)

@@ -1,0 +1,120 @@
+"""Batched LM generation served over RPC — inference batching (SURVEY.md
+§2.3, ``define_queue(dynamic_batching=True)``) applied to the TransformerLM.
+
+A server peer owns the model and a dynamic-batching queue: concurrent
+single-prompt calls from many client peers are stacked into one batch, run
+through :func:`..models.transformer.generate` (KV-cache decoding) in a
+single jitted call, and unbatched back to each caller — the reference's
+cross-caller inference batching (``src/moolib.cc:1007-1178``), here feeding
+a TPU generation step instead of a torch policy.
+
+Serve:  python -m moolib_tpu.examples.lm_serve --listen 127.0.0.1:4460
+Client: python -m moolib_tpu.examples.lm_serve --connect 127.0.0.1:4460 \\
+            --prompts 3 (sends 3 concurrent prompts, prints continuations)
+
+Prompts in one batch must share a length (the queue stacks them); pad
+client-side for mixed lengths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerLM, generate
+from ..rpc import Rpc
+
+
+def make_model(flags):
+    return TransformerLM(
+        vocab_size=flags.vocab,
+        d_model=flags.d_model,
+        num_heads=flags.heads,
+        num_layers=flags.layers,
+        attention="dense",
+        dtype=jnp.float32,
+        pos_embedding="rotary",
+        max_len=flags.seq_len + flags.max_new_tokens,
+    )
+
+
+def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate",
+          batch_size: int = 16, total=None):
+    """Coroutine serving ``total`` prompts (None = forever).  Returns the
+    number of *service iterations* — with concurrent callers this is smaller
+    than the prompt count, which is the point of dynamic batching."""
+    queue = rpc.define_queue(name, batch_size=batch_size, dynamic_batching=True)
+    jgen = jax.jit(lambda p, prompts: generate(model, p, prompts, max_new_tokens))
+
+    async def loop():
+        served = iterations = 0
+        while total is None or served < total:
+            ret_cb, args, kwargs = await queue
+            prompts = np.asarray(args[0])
+            single = prompts.ndim == 1
+            if single:
+                prompts = prompts[None]
+            served += prompts.shape[0]
+            iterations += 1
+            try:
+                out = np.asarray(jgen(params, jnp.asarray(prompts)))
+            except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
+                ret_cb.error(f"generate failed: {e}")
+                continue
+            ret_cb(out[0] if single else out)
+        return iterations
+
+    return loop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="batched LM generation over RPC")
+    p.add_argument("--listen", default=None, help="serve on this address")
+    p.add_argument("--connect", default=None, help="request from this address")
+    p.add_argument("--prompts", type=int, default=3, help="concurrent client prompts")
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--seq_len", type=int, default=16)
+    p.add_argument("--d_model", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=2)
+    p.add_argument("--max_new_tokens", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    flags = p.parse_args(argv)
+    if (flags.listen is None) == (flags.connect is None):
+        raise SystemExit("pass exactly one of --listen / --connect")
+
+    model = make_model(flags)
+    if flags.listen:
+        rng = np.random.default_rng(flags.seed)
+        toks = jnp.asarray(rng.integers(0, flags.vocab, (1, flags.seq_len), dtype=np.int32))
+        params = model.init(jax.random.key(flags.seed), toks)
+        rpc = Rpc()
+        rpc.set_name("lm_server")
+        rpc.listen(flags.listen)
+        print(f"serving 'generate' on {flags.listen}", flush=True)
+        try:
+            asyncio.run(serve(rpc, model, params, flags.max_new_tokens))
+        finally:
+            rpc.close()
+    else:
+        rpc = Rpc()
+        rpc.set_name("lm_client")
+        rpc.set_timeout(60)
+        rpc.connect(flags.connect)
+        rng = np.random.default_rng(flags.seed + 1)
+        futs = []
+        for _ in range(flags.prompts):
+            prompt = rng.integers(2, flags.vocab, flags.seq_len).astype(np.int32)
+            futs.append((prompt, rpc.async_("lm_server", "generate", prompt)))
+        for prompt, fut in futs:
+            out = np.asarray(fut.result())
+            print(f"prompt={prompt.tolist()}\n  -> {out[len(prompt):].tolist()}")
+        rpc.close()
+
+
+if __name__ == "__main__":
+    main()

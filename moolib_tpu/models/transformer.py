@@ -63,6 +63,7 @@ class Block(nn.Module):
     rotary: bool = False
     decode: bool = False  # single-token steps against a KV cache (generation)
     max_len: int = 8192  # cache capacity in decode mode
+    collect_kv: bool = False  # sow K/V into a "kv" collection (prefill)
 
     @nn.compact
     def __call__(self, x, mesh=None):
@@ -116,6 +117,10 @@ class Block(nn.Module):
         else:
             if self.rotary:
                 q, k = apply_rotary(q), apply_rotary(k)
+            if self.collect_kv:
+                # One-pass prefill: generate() reads these to seed the cache.
+                self.sow("kv", "k", k.astype(self.dtype))
+                self.sow("kv", "v", v.astype(self.dtype))
             if self.attention == "ring":
                 from ..parallel.ring_attention import ring_attention
 
@@ -168,6 +173,7 @@ class TransformerLM(nn.Module):
     moe_capacity_factor: float = 1.25
     pos_embedding: str = "learned"  # learned (table, capped at max_len) | rotary
     decode: bool = False  # single-token KV-cache steps (see generate())
+    collect_kv: bool = False  # sow per-block K/V (generate()'s prefill)
 
     @nn.compact
     def __call__(self, tokens: jax.Array, mesh=None) -> jax.Array:
@@ -202,6 +208,7 @@ class TransformerLM(nn.Module):
                 rotary=self.pos_embedding == "rotary",
                 decode=self.decode,
                 max_len=self.max_len,
+                collect_kv=self.collect_kv,
                 name=f"block{i}",
             )(x, mesh=mesh)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
@@ -221,12 +228,12 @@ def generate(
     """Autoregressive sampling with a per-block KV cache.
 
     ``prompt`` is [B, Tp] int32; returns [B, Tp + max_new_tokens] with the
-    continuation appended.  Each step attends against cached K/V (O(T) per
-    token instead of O(T²) re-forwarding), the flax ``decode`` pattern:
-    blocks append to a ``cache`` collection carried through two scans
-    (teacher-forced prefill over the prompt, then sampling).
-    ``temperature=0`` is greedy argmax; otherwise softmax sampling with
-    ``rng``.
+    continuation appended.  Prefill is ONE teacher-forced forward over the
+    prompt (each block sows its K/V, which seed the cache); each generated
+    token is then a single-position step against the cached K/V — O(T) per
+    token instead of O(T²) re-forwarding (the flax ``decode`` pattern, the
+    cache collection carried through a scan).  ``temperature=0`` is greedy
+    argmax; otherwise softmax sampling with ``rng``.
     """
     B, Tp = prompt.shape
     if Tp + max_new_tokens > model.max_len:
@@ -263,14 +270,33 @@ def generate(
         )
         return upd["cache"], logits[:, 0]
 
-    # Prefill: the first apply creates the cache variables; the rest scan.
-    first_logits, vars0 = dec.apply(pdict, prompt[:, :1], mutable=["cache"])
-    cache = vars0["cache"]
-    if Tp > 1:
-        cache, logits_seq = jax.lax.scan(step, cache, prompt[:, 1:].T)
-        last_logits = logits_seq[-1]
-    else:
-        last_logits = first_logits[:, 0]
+    # Prefill in ONE teacher-forced forward over the whole prompt: the
+    # full model sows every block's K/V (collect_kv) and the cache is
+    # assembled from them — not Tp sequential single-token steps.
+    full = TransformerLM(
+        vocab_size=model.vocab_size,
+        d_model=model.d_model,
+        num_heads=model.num_heads,
+        num_layers=model.num_layers,
+        max_len=model.max_len,
+        attention="dense",
+        dtype=model.dtype,
+        pos_embedding=model.pos_embedding,
+        collect_kv=True,
+    )
+    full_logits, col = full.apply(pdict, prompt, mutable=["kv"])
+    last_logits = full_logits[:, -1]
+    pad = model.max_len - Tp
+    cache = {}
+    for i in range(model.num_layers):
+        kv = col["kv"][f"block{i}"]
+        cache[f"block{i}"] = {
+            "k": jnp.pad(kv["k"][0], ((0, 0), (0, pad), (0, 0), (0, 0))),
+            "v": jnp.pad(kv["v"][0], ((0, 0), (0, pad), (0, 0), (0, 0))),
+            "idx": jnp.asarray(Tp, jnp.int32),
+        }
+    if model.pos_embedding == "learned":
+        cache["pos_idx"] = jnp.asarray(Tp, jnp.int32)
 
     if rng is None:
         rng = jax.random.key(0)  # unused: greedy path (temperature == 0)

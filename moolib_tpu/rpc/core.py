@@ -618,6 +618,13 @@ def _batch_calls(calls):
         for ret, part in zip(rets, parts):
             ret(part)
 
+    def error(message: str) -> None:
+        # Fail every caller stacked into this batch (mirrors
+        # RpcDeferredReturn.error so queue consumers can error uniformly).
+        for ret in rets:
+            ret.error(message)
+
+    return_callback.error = error
     return (return_callback, batched_args, batched_kwargs)
 
 

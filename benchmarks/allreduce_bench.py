@@ -89,7 +89,12 @@ def bench_ici(args):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from moolib_tpu import parallel
+    from moolib_tpu.utils import apply_platform_env
 
+    # The sitecustomize imports jax at interpreter start, which can lock
+    # platform selection before our env var is honored — re-apply it, or a
+    # dead TPU tunnel hangs this CPU bench in backend init.
+    apply_platform_env()
     devices = jax.devices()
     mesh = parallel.make_mesh({"dp": len(devices)})
     print(f"# XLA psum over {len(devices)} x {devices[0].platform} (ICI data plane)")

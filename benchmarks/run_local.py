@@ -25,19 +25,36 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _run(cmd, timeout=600):
     t0 = time.time()
-    try:
-        proc = subprocess.run(
-            cmd, cwd=ROOT, capture_output=True, text=True, timeout=timeout
-        )
+    # Children import moolib_tpu by path: make the repo root importable and
+    # pin the CPU backend (a hung TPU tunnel must not stall a CPU bench).
+    env = dict(
+        os.environ,
+        PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    # Capture via temp FILES, not pipes: jax's plugin discovery can fork a
+    # daemon that inherits the pipe fds, and communicate() then blocks on
+    # pipe EOF long after the benchmark itself exited.
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out_f, tempfile.TemporaryFile("w+") as err_f:
+        try:
+            proc = subprocess.run(
+                cmd, cwd=ROOT, stdout=out_f, stderr=err_f, text=True,
+                timeout=timeout, env=env,
+            )
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            return {"cmd": " ".join(cmd[1:]), "rc": -1, "error": f"timeout {timeout}s"}
+        out_f.seek(0)
+        err_f.seek(0)
         return {
             "cmd": " ".join(cmd[1:]),
-            "rc": proc.returncode,
+            "rc": rc,
             "seconds": round(time.time() - t0, 1),
-            "stdout": proc.stdout.strip().splitlines(),
-            "stderr": proc.stderr.strip().splitlines()[-5:] if proc.returncode else [],
+            "stdout": out_f.read().strip().splitlines(),
+            "stderr": err_f.read().strip().splitlines()[-5:] if rc else [],
         }
-    except subprocess.TimeoutExpired:
-        return {"cmd": " ".join(cmd[1:]), "rc": -1, "error": f"timeout {timeout}s"}
 
 
 def main():
@@ -48,11 +65,17 @@ def main():
         "caveat": "single-core box: rates are noisy, bandwidths are meaningful",
     }
     py = sys.executable
+    # The ici bench imports jax, whose plugin registration can hang for
+    # minutes when the TPU tunnel is mid-failure (even pinned to CPU):
+    # bound it and retry once rather than eating the whole collection budget.
+    ici = _run([py, "benchmarks/allreduce_bench.py", "ici"], timeout=240)
+    if ici.get("rc") != 0:
+        ici = _run([py, "benchmarks/allreduce_bench.py", "ici"], timeout=240)
     results = {
         "env": env_note,
         "rpc": _run([py, "benchmarks/rpc_bench.py", "--backend", "both"]),
         "allreduce_rpc": _run([py, "benchmarks/allreduce_bench.py", "rpc"]),
-        "allreduce_ici": _run([py, "benchmarks/allreduce_bench.py", "ici"]),
+        "allreduce_ici": ici,
         "envpool": _run([py, "benchmarks/envpool_bench.py"]),
     }
     out = os.path.join(ROOT, "BENCH_LOCAL.json")

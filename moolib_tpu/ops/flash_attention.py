@@ -81,6 +81,94 @@ def _flash_kernel(
         o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
+def _blockwise_attention(q, k, v, causal, block_q, block_k):
+    """Pure-jax chunked streaming-softmax attention — the differentiable
+    reference the backward pass uses (same math as the kernel; O(block)
+    score memory thanks to the scan + checkpointed inner step)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = D**-0.5
+    nq, nk = Tq // block_q, Tk // block_k
+    qb = jnp.moveaxis(
+        q.astype(jnp.float32).reshape(B, nq, block_q, H, D), 1, 0
+    )  # [nq, B, bq, H, D]
+    kb = jnp.moveaxis(k.astype(jnp.float32).reshape(B, nk, block_k, H, D), 1, 0)
+    vb = jnp.moveaxis(v.astype(jnp.float32).reshape(B, nk, block_k, H, D), 1, 0)
+
+    def per_q(args):
+        qi, q_blk = args  # q_blk [B, bq, H, D]
+
+        def kv_step(carry, inp):
+            ki, k_blk, v_blk = inp
+
+            def active(carry):
+                from ..parallel.ring_attention import online_softmax_update
+
+                acc, l, m = carry
+                s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_blk) * scale
+                if causal:
+                    q_pos = qi * block_q + jnp.arange(block_q)
+                    k_pos = ki * block_k + jnp.arange(block_k)
+                    mask = q_pos[:, None] >= k_pos[None, :]
+                    s = jnp.where(mask[None, None], s, _NEG_INF)
+                return online_softmax_update(
+                    s, v_blk, acc, l, m, zero_masked_rows=causal
+                )
+
+            if causal:
+                # Mirror the kernel's pl.when: kv blocks entirely above the
+                # diagonal contribute nothing — skip their matmuls.
+                carry = jax.lax.cond(
+                    (qi + 1) * block_q > ki * block_k, active, lambda c: c, carry
+                )
+            else:
+                carry = active(carry)
+            return carry, None
+
+        init = (
+            jnp.zeros((B, H, block_q, D), jnp.float32),
+            jnp.zeros((B, H, block_q), jnp.float32),
+            jnp.full((B, H, block_q), _NEG_INF, jnp.float32),
+        )
+        (acc, l, _), _ = jax.lax.scan(
+            jax.checkpoint(kv_step), init, (jnp.arange(nk), kb, vb)
+        )
+        out = acc / jnp.maximum(l[..., None], 1e-30)  # [B, H, bq, D]
+        return jnp.moveaxis(out, 1, 2)  # [B, bq, H, D]
+
+    outs = jax.lax.map(per_q, (jnp.arange(nq), qb))  # [nq, B, bq, H, D]
+    return (
+        jnp.moveaxis(outs, 0, 1).reshape(B, Tq, H, D).astype(q.dtype)
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    # Backward = VJP of the blockwise-jax formulation (recomputes the
+    # streaming softmax; same FLOPs class as a flash backward, O(block)
+    # score memory).  The pallas forward computes the same function up to
+    # float rounding, so these are the gradients of flash attention.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _blockwise_attention(q_, k_, v_, causal, block_q, block_k),
+        q,
+        k,
+        v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -90,7 +178,12 @@ def flash_attention(
     block_k: int | None = None,
     interpret: bool | None = None,
 ):
-    """Blockwise attention; q/k/v: [B, T, H, D] → [B, T, H, D]."""
+    """Blockwise attention; q/k/v: [B, T, H, D] → [B, T, H, D].
+
+    Differentiable: the forward runs the pallas kernel; the backward is the
+    VJP of an equivalent blockwise-jax formulation (``custom_vjp``), so the
+    TransformerLM trains through this path at long T.
+    """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     # Defaults from a block sweep on TPU v5e (T=4096, causal): 128x128 blocks
@@ -118,6 +211,12 @@ def flash_attention(
         return full_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
     scale = D**-0.5
 
     # [B, T, H, D] -> [B*H, T, D]

@@ -24,6 +24,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def online_softmax_update(scores, v_blk, acc, l, m, zero_masked_rows: bool):
+    """Fold one K/V block into streaming-softmax accumulators.
+
+    The single source of the online-softmax math shared by the pure-jax
+    blockwise paths (ring attention's per-hop update and flash attention's
+    backward recompute; the pallas kernel hand-writes the same update in its
+    memory model).  ``scores`` [B, H, Q, K] f32, already masked with
+    ``_NEG_INF``; ``v_blk`` [B, K, H, D]; accumulators ``acc`` [B, H, Q, D],
+    ``l``/``m`` [B, H, Q].  ``zero_masked_rows`` keeps fully-masked rows at
+    zero weight (avoid exp(-inf - (-inf))).
+    """
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    if zero_masked_rows:
+        p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
+    l = l * corr + p.sum(axis=-1)
+    acc = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+    )
+    return acc, l, m_new
+
+
 def full_attention(q, k, v, causal: bool = True):
     """Reference dense attention (single device), for testing parity."""
     scale = q.shape[-1] ** -0.5
@@ -69,19 +92,12 @@ def ring_attention_sharded(
             k_pos = src * Tk + jnp.arange(Tk)
             mask = q_pos[:, None] >= k_pos[None, :]
             scores = jnp.where(mask[None, None], scores, _NEG_INF)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[..., None])
-        if causal:
-            # Fully-masked rows: keep them at zero weight (avoid exp(-inf-(-inf))).
-            p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_c.astype(jnp.float32))
+        o, l, m = online_softmax_update(scores, v_c, o, l, m, zero_masked_rows=causal)
         # Rotate K/V one step around the ring (device j -> j+1).
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_c = jax.lax.ppermute(k_c, axis_name, perm)
         v_c = jax.lax.ppermute(v_c, axis_name, perm)
-        return (o, l, m_new, k_c, v_c)
+        return (o, l, m, k_c, v_c)
 
     o, l, m, _, _ = jax.lax.fori_loop(0, n, body, (o, l, m, k, v))
     out = o / jnp.maximum(l[..., None], 1e-30)

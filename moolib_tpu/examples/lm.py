@@ -98,6 +98,9 @@ def make_batch(rng: np.random.Generator, flags):
 
 
 def train(flags, on_stats=None) -> dict:
+    from ..utils import apply_platform_env
+
+    apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
     if flags.seq_len % 2:
         raise ValueError("--seq_len must be even")
     mesh = None

@@ -86,6 +86,9 @@ def main(argv=None):
     flags = p.parse_args(argv)
     if (flags.listen is None) == (flags.connect is None):
         raise SystemExit("pass exactly one of --listen / --connect")
+    from ..utils import apply_platform_env
+
+    apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
 
     model = make_model(flags)
     if flags.listen:

@@ -195,6 +195,21 @@ def _child_main():
 
         jax.config.update("jax_platforms", "cpu")
         result = _run_bench(warmup=1, iters=5, max_seconds=120.0)
+    elif mode == "probe":
+        # Cheap TPU liveness check: init the backend and run one tiny op.
+        # Keeps the expensive full bench from burning its timeout on a dead
+        # tunnel — the parent staggers these probes across a long window.
+        import jax
+
+        if jax.devices()[0].platform == "cpu":
+            print("MOOLIB_BENCH_NOTPU", flush=True)
+            sys.exit(3)
+        import jax.numpy as jnp
+
+        x = jnp.ones((128, 128))
+        float((x @ x).sum())  # scalar fetch forces real execution
+        print("MOOLIB_BENCH_RESULT " + json.dumps({"probe": "ok"}), flush=True)
+        return
     else:
         # Don't pin a platform name (TPU plugins register under various
         # names, e.g. "axon") — but never let a silent CPU fallback
@@ -230,6 +245,27 @@ def _spawn(mode: str, timeout: float):
     return None, f"{mode}: rc={proc.returncode}: " + " | ".join(tail)
 
 
+def _last_good_tpu():
+    """Builder-captured on-chip result from the committed BENCH_TPU.json.
+
+    When the tunnel is dead at snapshot time, the artifact degrades to this
+    provenance-labeled stale chip data instead of erasing the perf story
+    with a CPU-only row.
+    """
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        row = dict(data["impala_learner"])
+        row["provenance"] = (
+            "builder-captured on real TPU (committed BENCH_TPU.json, "
+            f"when={data.get('when', 'unknown')}); live chip unreachable at bench time"
+        )
+        return row
+    except Exception:  # noqa: BLE001 — missing/corrupt file just means no stale data
+        return None
+
+
 def main():
     if os.environ.get("MOOLIB_BENCH_CHILD"):
         _child_main()
@@ -237,32 +273,54 @@ def main():
 
     errors = []
     result = None
-    # TPU first, with one retry (transient tunnel flakiness), then CPU.
+    # TPU attempts staggered across a long window: a dead tunnel is often
+    # transient, and two back-to-back 7-min hangs (the round-2 failure mode)
+    # buy nothing.  Instead: cheap liveness probes with backoff; only a
+    # successful probe spends the full-bench timeout.
+    probe_t = float(os.environ.get("MOOLIB_BENCH_PROBE_TIMEOUT", 120))
     tpu_t = float(os.environ.get("MOOLIB_BENCH_TPU_TIMEOUT", 420))
     cpu_t = float(os.environ.get("MOOLIB_BENCH_CPU_TIMEOUT", 600))
-    for mode, timeout in (("tpu", tpu_t), ("tpu", tpu_t), ("cpu", cpu_t)):
-        result, err = _spawn(mode, timeout)
-        if result is not None:
-            break
-        errors.append(err)
-        if "no TPU backend" in err:
-            # Deterministic absence — retrying won't help; drop to cpu now.
-            result, err = _spawn("cpu", cpu_t)
+    budget = float(os.environ.get("MOOLIB_BENCH_TPU_BUDGET", 900))
+    deadline = time.monotonic() + budget
+    backoffs = [15.0, 30.0, 60.0, 90.0, 120.0, 180.0]
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        probe, err = _spawn("probe", probe_t)
+        if probe is not None:
+            # Clamp the full bench to the remaining budget (floor 120 s: a
+            # probe just succeeded, give the bench one compile's worth) so a
+            # flapping tunnel can't overrun the budget by a whole tpu_t.
+            remaining = deadline - time.monotonic()
+            result, err = _spawn("tpu", min(tpu_t, max(120.0, remaining)))
             if result is not None:
                 break
-            errors.append(err)
+            errors.append(f"attempt {attempt}: {err}")
+        else:
+            errors.append(f"attempt {attempt} (probe): {err}")
+            if "no TPU backend" in err:
+                break  # deterministic absence — retrying won't help
+        wait = backoffs[min(attempt - 1, len(backoffs) - 1)]
+        if time.monotonic() + wait >= deadline:
             break
-        time.sleep(5.0)
+        time.sleep(wait)
     if result is None:
-        # Even the CPU fallback died: report the failure as data, rc still 0.
-        result = {
-            "metric": "impala_learner_sps",
-            "value": 0.0,
-            "unit": "env_frames/s",
-            "vs_baseline": 0.0,
-        }
-    if errors and result.get("platform") != "tpu":
-        result["error"] = "; ".join(errors)
+        result, err = _spawn("cpu", cpu_t)
+        if result is None:
+            errors.append(err)
+            # Even the CPU fallback died: report the failure as data, rc 0.
+            result = {
+                "metric": "impala_learner_sps",
+                "value": 0.0,
+                "unit": "env_frames/s",
+                "vs_baseline": 0.0,
+            }
+    if result.get("platform") != "tpu":
+        if errors:
+            result["error"] = "; ".join(errors)
+        stale = _last_good_tpu()
+        if stale is not None:
+            result["last_good_tpu"] = stale
     print(json.dumps(result))
 
 

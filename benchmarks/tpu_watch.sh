@@ -1,0 +1,15 @@
+#!/bin/bash
+# TPU tunnel liveness watcher: probe every ~3 min, append status lines to
+# the log so an operator (or the build loop) can see when the chip is back.
+# The probe is bench.py's own child probe mode — one copy of the logic.
+LOG=${1:-/tmp/tpu_watch.log}
+BENCH="$(dirname "$0")/../bench.py"
+while true; do
+  ts=$(date +%H:%M:%S)
+  if timeout 120 env MOOLIB_BENCH_CHILD=probe python "$BENCH" 2>/dev/null | grep -q MOOLIB_BENCH_RESULT; then
+    echo "$ts ALIVE" >> "$LOG"
+  else
+    echo "$ts dead" >> "$LOG"
+  fi
+  sleep 180
+done

@@ -86,6 +86,7 @@ class AtariPreprocessing:
         sticky_action_prob: float = 0.0,
         num_stack: int = 4,
         terminal_on_life_loss: bool = False,
+        noop_max: int = 0,
         seed=None,
     ):
         if frame_skip < 1:
@@ -96,6 +97,7 @@ class AtariPreprocessing:
         self.sticky_action_prob = sticky_action_prob
         self.num_stack = num_stack
         self.terminal_on_life_loss = terminal_on_life_loss
+        self.noop_max = noop_max
         self._rng = np.random.default_rng(seed)
         self._seed = seed
         self._stack = deque(maxlen=num_stack)
@@ -108,12 +110,20 @@ class AtariPreprocessing:
     def observation_shape(self):
         return (self.screen_size, self.screen_size, self.num_stack)
 
-    def _process(self, frame, prev_frame=None):
-        if prev_frame is not None:
-            frame = np.maximum(frame, prev_frame)
+    def _to_gray(self, frame):
+        frame = np.asarray(frame)
         if frame.ndim == 3 and frame.shape[-1] == 3:
             # ITU-R 601 luminance, same as cv2.COLOR_RGB2GRAY.
             frame = (frame @ np.array([0.299, 0.587, 0.114])).astype(np.uint8)
+        return frame
+
+    def _process(self, frame, prev_frame=None):
+        # Grayscale each raw frame FIRST, then max-pool: pixelwise
+        # luminance(max(rgb)) != max(luminance), and the reference pools
+        # already-grayscale screen buffers.
+        frame = self._to_gray(frame)
+        if prev_frame is not None:
+            frame = np.maximum(frame, self._to_gray(prev_frame))
         if frame.shape[:2] != (self.screen_size, self.screen_size):
             import cv2
 
@@ -131,6 +141,14 @@ class AtariPreprocessing:
         if self._needs_full_reset:
             obs, _ = self.env.reset(seed=self._seed)
             self._seed = None
+            # Random no-op starts (1..noop_max emulator no-ops on a full
+            # game reset), the reference's evaluation convention for
+            # de-determinizing start states.
+            if self.noop_max:
+                for _ in range(int(self._rng.integers(1, self.noop_max + 1))):
+                    obs, _, terminated, truncated, _ = self.env.step(0)
+                    if terminated or truncated:
+                        obs, _ = self.env.reset()
         else:
             # Life lost but the game is still on: continue it with a no-op
             # so the agent sees post-first-life states (episodic-life).
@@ -194,12 +212,15 @@ def create_env(
     screen_size: int = 84,
     num_stack: int = 4,
     sticky_actions: bool = True,
-    full_action_space: bool = False,
+    full_action_space: bool = True,
+    noop_max: int = 30,
     seed=None,
 ):
     """ALE factory matching the reference (``examples/atari/environment.py``):
     ``ALE/<game>-v5`` with emulator-level frameskip/sticky disabled so the
-    wrapper (testable, explicit) owns them.  Needs ``ale_py`` + ROMs."""
+    wrapper (testable, explicit) owns them.  Defaults follow the reference's
+    evaluation convention: the full 18-action space and random no-op starts
+    (``noop_max=30``).  Needs ``ale_py`` + ROMs."""
     try:
         import gymnasium
 
@@ -229,5 +250,6 @@ def create_env(
         screen_size=screen_size,
         sticky_action_prob=0.25 if sticky_actions else 0.0,
         num_stack=num_stack,
+        noop_max=noop_max,
         seed=seed,
     )

@@ -279,7 +279,11 @@ def generate(
         num_heads=model.num_heads,
         num_layers=model.num_layers,
         max_len=model.max_len,
-        attention="dense",
+        # Prefill rides the model's own attention kind, so long prompts go
+        # through the flash kernel instead of a Tp² dense score matrix.
+        # ring needs a mesh at apply time (generate() takes none); its
+        # single-chip equivalent is flash.
+        attention="flash" if model.attention == "ring" else model.attention,
         dtype=model.dtype,
         pos_embedding=model.pos_embedding,
         collect_kv=True,

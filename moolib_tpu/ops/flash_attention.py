@@ -18,6 +18,7 @@ tile (T not divisible by the block size, tiny D).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -27,8 +28,17 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _largest_divisor(t: int, cap: int) -> int:
+    """Largest multiple of 128 that divides ``t`` and is <= ``cap`` (0 if none)."""
+    for b in range(min(cap, t) // 128 * 128, 0, -128):
+        if t % b == 0:
+            return b
+    return 0
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, block_q, block_k,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -79,6 +89,9 @@ def _flash_kernel(
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finalize():
         o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+        # Row logsumexp for the backward pass, written in the scratch's own
+        # lane-replicated (block_q, 128) layout — no in-kernel transpose.
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
 def _blockwise_attention(q, k, v, causal, block_q, block_k):
@@ -144,29 +157,209 @@ def _blockwise_attention(q, k, v, causal, block_q, block_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    # Backward = VJP of the blockwise-jax formulation (recomputes the
-    # streaming softmax; same FLOPs class as a flash backward, O(block)
-    # score memory).  The pallas forward computes the same function up to
-    # float rounding, so these are the gradients of flash attention.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _blockwise_attention(q_, k_, v_, causal, block_q, block_k),
-        q,
-        k,
-        v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    if os.environ.get("MOOLIB_TPU_FLASH_BWD", "pallas") == "jax":
+        # Oracle path: VJP of the blockwise-jax formulation (recomputes the
+        # streaming softmax in pure XLA; same FLOPs class, O(block) score
+        # memory).  Kept for parity testing against the pallas kernels.
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _blockwise_attention(
+                q_, k_, v_, causal, block_q, block_k
+            ),
+            q,
+            k,
+            v,
+        )
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _flash_bwd_dq_kernel(
+    k_ref, q_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, causal, block_q, block_k,
+):
+    """dq pass: one q block per (batch*head, qi), kv blocks stream innermost.
+
+    Works in scores-transposed layout — st = k @ qᵀ is [block_k, block_q] —
+    so the per-row lse/delta tables enter as natural (1, block_q) row
+    vectors (no sublane→lane transpose anywhere on the TPU).
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        st = jax.lax.dot_general(
+            k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bk, bq] f32
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
+            st = jnp.where(q_pos >= k_pos, st, _NEG_INF)
+        pt = jnp.exp(st - lse_ref[:])  # masked entries underflow to 0
+        dpt = jax.lax.dot_general(
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, bq]
+        dst = pt * (dpt - delta_ref[:]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            dst.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, D]
+
+    if causal:
+        pl.when((qi + 1) * block_q > ki * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    k_ref, q_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, scale, causal, block_q, block_k,
+):
+    """dk/dv pass: one kv block per (batch*head, ki), q blocks stream innermost.
+
+    Same transposed-scores layout as the dq pass; dk and dv accumulate in
+    f32 scratch across the q sweep.
+    """
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        st = jax.lax.dot_general(
+            k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bk, bq]
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
+            st = jnp.where(q_pos >= k_pos, st, _NEG_INF)
+        pt = jnp.exp(st - lse_ref[:])
+        dv_scr[:] += jax.lax.dot_general(
+            pt.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        dpt = jax.lax.dot_general(
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dst = pt * (dpt - delta_ref[:]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            dst.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+
+    if causal:
+        pl.when((qi + 1) * block_q > ki * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    """Pallas flash backward: dq pass + dk/dv pass (FlashAttention-2 style)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = D**-0.5
+    # Backward blocks capped at 512x512: the transposed-score intermediates
+    # (st, pt, dpt — all [bk, bq] f32) plus two f32 output scratches are live
+    # at once, so the forward's 512x1024 tiles would crowd VMEM.  The cap
+    # must preserve divisibility (e.g. Tk=1280 forwards with block_k=640;
+    # a blind min() to 512 would drop the tail kv block) — re-derive the
+    # largest dividing block under the cap.  Always succeeds: any valid
+    # forward block is a multiple of 128 dividing T, so 128 divides T.
+    bq = _largest_divisor(Tq, min(block_q, 512))
+    bk = _largest_divisor(Tk, min(block_k, 512))
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    qb, kb, vb, dob = to_bh(q), to_bh(k), to_bh(v), to_bh(g)
+    # delta_i = Σ_d dO_i · O_i — row table, like lse, in [B*H, Tq] layout.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1).reshape(B * H, Tq)
+
+    kwargs = dict(scale=scale, causal=causal, block_q=bq, block_k=bk)
+    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **kwargs),
+        grid=(B * H, Tq // bq, Tk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # q
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # do
+            row_spec,  # lse
+            row_spec,  # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(kb, qb, vb, dob, lse, delta)
+
+    qrow_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **kwargs),
+        grid=(B * H, Tk // bk, Tq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),  # k
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0)),  # q
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),  # v
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0)),  # do
+            qrow_spec,  # lse
+            qrow_spec,  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kb, qb, vb, dob, lse, delta)
+
+    def from_bh(x, T):
+        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    return from_bh(dq, Tq), from_bh(dk, Tk), from_bh(dv, Tk)
 
 
 def flash_attention(
@@ -180,9 +373,11 @@ def flash_attention(
 ):
     """Blockwise attention; q/k/v: [B, T, H, D] → [B, T, H, D].
 
-    Differentiable: the forward runs the pallas kernel; the backward is the
-    VJP of an equivalent blockwise-jax formulation (``custom_vjp``), so the
-    TransformerLM trains through this path at long T.
+    Differentiable: the forward runs the pallas kernel (also emitting the
+    row logsumexp); the backward runs two pallas kernels — a dq pass and a
+    dk/dv pass (FlashAttention-2 style) — so the TransformerLM trains
+    through on-chip kernels at long T.  ``MOOLIB_TPU_FLASH_BWD=jax``
+    selects the blockwise-jax VJP oracle instead (parity testing).
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -193,19 +388,26 @@ def flash_attention(
     # 1536 or 2560 still ride the kernel; T without such a divisor (e.g. 250,
     # or 160 < 2*128) takes the dense fallback rather than handing Mosaic a
     # non-tile-aligned block.
-    def _largest_divisor(t, cap):
-        for b in range(min(cap, t) // 128 * 128, 0, -128):
-            if t % b == 0:
-                return b
-        return 0
-
+    explicit_q = block_q is not None
+    explicit_k = block_k is not None
     if block_q is None:
         block_q = _largest_divisor(Tq, 512)
     if block_k is None:
         block_k = _largest_divisor(Tk, 1024)
     # Blocks below the 128-lane tile (T with a large odd factor) aren't worth
-    # a pallas launch — use the dense path.
-    if block_q < 128 or block_k < 128 or Tq % block_q or Tk % block_k:
+    # a pallas launch — use the dense path.  An unusable *caller-supplied*
+    # block raises instead (the caller tuning blocks gets a signal, not a
+    # silent O(T²) reroute); an unusable auto-selected one keeps the
+    # documented silent fallback.
+    bad_q = block_q < 128 or block_q % 128 or Tq % block_q
+    bad_k = block_k < 128 or block_k % 128 or Tk % block_k
+    if (bad_q and explicit_q) or (bad_k and explicit_k):
+        raise ValueError(
+            f"flash_attention block_q={block_q}, block_k={block_k} unusable for "
+            f"Tq={Tq}, Tk={Tk}: blocks must be multiples of 128 that divide the "
+            "sequence length. Omit them to auto-select (or fall back to dense)."
+        )
+    if bad_q or bad_k:
         from ..parallel.ring_attention import full_attention
 
         return full_attention(q, k, v, causal=causal)
@@ -225,7 +427,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
 
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     grid = (B * H, Tq // block_q, Tk // block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
         ),
@@ -235,8 +437,14 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -244,4 +452,5 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qb, kb, vb)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    # lse comes out lane-replicated; one lane is the [B*H, Tq] row table.
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3), lse[:, :, 0]

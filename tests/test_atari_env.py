@@ -68,6 +68,45 @@ def test_flicker_maxpool_takes_brighter_of_last_two():
     assert obs[..., 0].max() > 0
 
 
+def test_maxpool_runs_on_grayscale_frames():
+    """Reference order: grayscale each raw frame, THEN max-pool.  With
+    single-channel-saturated colors, max-of-RGB-then-luminance differs:
+    max(rgb) of pure red + pure green is yellow (luma 226) while
+    max(luma) is 150 — the wrapper must produce the latter."""
+    red = np.zeros((210, 160, 3), np.uint8)
+    red[..., 0] = 255  # luma 76
+    green = np.zeros((210, 160, 3), np.uint8)
+    green[..., 1] = 255  # luma 150
+
+    class TwoColor(FakeALE):
+        def _frame(self):
+            return green if self.t % 2 else red
+
+    env = AtariPreprocessing(TwoColor(), frame_skip=2, num_stack=1)
+    env.reset()
+    obs, *_ = env.step(0)
+    # max(luma(red), luma(green)) = 150; pooling RGB first would give
+    # luma(yellow) = 226.
+    assert abs(int(obs[..., 0].max()) - 150) <= 1, obs[..., 0].max()
+
+
+def test_noop_starts_randomize_reset_state():
+    """noop_max: a full reset runs 1..noop_max emulator no-ops, so the first
+    observation varies with the RNG (reference evaluation convention)."""
+    env = AtariPreprocessing(FakeALE(), frame_skip=1, num_stack=1, noop_max=10, seed=0)
+    env.reset()
+    first_steps = env.env.t
+    assert 1 <= first_steps <= 10
+    assert env.env.actions == [0] * first_steps  # all no-ops
+    # Different seed -> (almost surely) different number of no-ops.
+    counts = set()
+    for s in range(8):
+        e = AtariPreprocessing(FakeALE(), frame_skip=1, num_stack=1, noop_max=10, seed=s)
+        e.reset()
+        counts.add(e.env.t)
+    assert len(counts) > 1
+
+
 def test_done_mid_skip_stops_stepping_and_sums_partial_reward():
     env = AtariPreprocessing(FakeALE(episode_len=6), frame_skip=4, num_stack=2)
     env.reset()

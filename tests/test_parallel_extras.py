@@ -138,10 +138,10 @@ def test_pipeline_circular_rejects_bad_shapes():
 
 
 def test_flash_attention_gradients_match_dense():
-    """flash_attention is differentiable (custom_vjp: pallas forward,
-    blockwise-jax backward) and its q/k/v cotangents match the dense path.
-    Regression: jax.grad through the raw pallas_call used to crash, so any
-    model training with attention='flash' was broken."""
+    """flash_attention is differentiable (custom_vjp: pallas forward, pallas
+    dq + dk/dv backward kernels) and its q/k/v cotangents match the dense
+    path.  Regression: jax.grad through the raw pallas_call used to crash, so
+    any model training with attention='flash' was broken."""
     rngs = jax.random.split(jax.random.key(7), 4)
     B, T, H, D = 2, 256, 2, 64
     q, k, v, g = (jax.random.normal(r, (B, T, H, D)) for r in rngs)
@@ -153,6 +153,58 @@ def test_flash_attention_gradients_match_dense():
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
                 err_msg=f"causal={causal} d{name}",
             )
+
+
+def test_flash_backward_pallas_matches_blockwise_oracle(monkeypatch):
+    """The pallas backward kernels against the blockwise-jax VJP they
+    replaced (kept as the selectable oracle via MOOLIB_TPU_FLASH_BWD)."""
+    rngs = jax.random.split(jax.random.key(3), 4)
+    B, T, H, D = 1, 256, 2, 64
+    q, k, v, g = (jax.random.normal(r, (B, T, H, D)) for r in rngs)
+    grads = {}
+    for mode in ("pallas", "jax"):
+        monkeypatch.setenv("MOOLIB_TPU_FLASH_BWD", mode)
+        _, vjp = jax.vjp(lambda *a: flash_attention(*a, causal=True), q, k, v)
+        grads[mode] = vjp(g)
+    for a, b, name in zip(grads["pallas"], grads["jax"], "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4, err_msg=f"d{name}"
+        )
+
+
+def test_flash_attention_rejects_bad_explicit_blocks():
+    """Caller-supplied block sizes that can't tile the sequence raise instead
+    of silently rerouting to the dense path (ADVICE round-2)."""
+    q = jnp.zeros((1, 256, 2, 64))
+    with pytest.raises(ValueError, match="block_q"):
+        flash_attention(q, q, q, block_q=64)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, q, q, block_q=192, block_k=128)
+    # Non-multiple-of-128 blocks are rejected even when they divide T: the
+    # backward's block re-derivation scans 128-multiples only.
+    q192 = jnp.zeros((1, 192, 2, 64))
+    with pytest.raises(ValueError, match="multiples of 128"):
+        flash_attention(q192, q192, q192, block_q=192)
+    # But an unusable AUTO-selected block keeps the silent dense fallback,
+    # even when the *other* block was passed explicitly and is fine.
+    k = jnp.zeros((1, 160, 2, 64))  # no 128-multiple divides 160
+    out = flash_attention(q, k, k, block_q=128, causal=False)
+    assert out.shape == q.shape
+
+
+def test_flash_backward_with_block_not_dividing_cap():
+    """T whose auto block exceeds the backward's 512 cap but isn't divisible
+    by 512 (e.g. 1280 -> forward block_k 640): the backward must re-derive a
+    dividing block instead of dropping the tail kv block."""
+    rngs = jax.random.split(jax.random.key(5), 4)
+    B, T, H, D = 1, 1280, 1, 64
+    q, k, v, g = (jax.random.normal(r, (B, T, H, D)) for r in rngs)
+    _, vjp_f = jax.vjp(lambda *a: flash_attention(*a, causal=True), q, k, v)
+    _, vjp_r = jax.vjp(lambda *a: parallel.full_attention(*a, causal=True), q, k, v)
+    for a, b, name in zip(vjp_f(g), vjp_r(g), "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4, err_msg=f"d{name}"
+        )
 
 
 def test_flash_attention_matches_dense():

@@ -12,9 +12,12 @@ buffers.  ``num_batches`` > 1 gives double buffering: act on batch 0 while
 batch 1 is stepping (reference ``src/moolib.cc:1587-1630`` docstring).
 
 Design differences from the reference (TPU-first, not a translation):
-- fork happens directly at construction — like the reference's early fork
-  server (``src/env.cc:149-169``), construct EnvPool *before* initializing
-  jax/TPU backends in the parent.
+- worker start method enforces the reference's fork-safety contract
+  (``src/env.cc:149-169``): plain ``fork`` while the jax backend is
+  uninitialized (fast, closures allowed), an automatic switch to
+  ``forkserver`` afterwards (the server is fork+exec'd, so it is safe with
+  jax's threads; ``create_env`` must then be picklable).  Constructing the
+  pool before the first jax backend use remains the preferred order.
 - the doorbell is a per-worker task queue + per-batch completion semaphore
   (futex-backed) instead of spin-waiting on atomic action words.
 - results are host numpy views meant to be fed to ``Batcher``/``jax.device_put``
@@ -29,13 +32,29 @@ ndarrays with fixed shapes/dtypes.
 from __future__ import annotations
 
 import ctypes
-import mmap
 import multiprocessing as mp
+import os
+import pickle
+import sys
 import traceback
 from multiprocessing import shared_memory
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+
+def _jax_backend_initialized() -> bool:
+    """True once any XLA backend client exists in this process — the point
+    after which a plain fork() is unsafe (jax is multithreaded).  Checks
+    without importing or initializing jax."""
+    if sys.modules.get("jax") is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # noqa: BLE001 — private API; fail toward the safe path
+        return True
 
 _FIELD_RESERVED = ("reward", "done")
 _SHUTDOWN = -1
@@ -65,50 +84,105 @@ class _MpSem:
         return self._s.acquire(True, timeout)
 
 
+class _RingQueue:
+    def __init__(self, ring):
+        self._ring = ring
+
+    def put(self, v: int) -> None:
+        self._ring.push(int(v))
+
+    def get(self) -> int:
+        out = self._ring.pop()
+        return _SHUTDOWN if out is None else out
+
+
+def _doorbell_layout(lib, cap, num_processes, num_batches):
+    """Single owner of the doorbell shm layout math — the parent's size
+    computation and both sides' view placement must agree byte-for-byte."""
+    from . import native
+
+    ring_sz = (native.NativeRing.size(lib, cap) + 63) & ~63
+    sem_sz = (native.NativeSemaphore.size(lib) + 63) & ~63
+    total = ring_sz * num_processes + sem_sz * num_batches
+    return ring_sz, sem_sz, total
+
+
+def _native_doorbell_views(lib, buf, cap, num_processes, num_batches, initialize):
+    """Construct ring/semaphore handles over a doorbell shm region."""
+    from . import native
+
+    ring_sz, sem_sz, _ = _doorbell_layout(lib, cap, num_processes, num_batches)
+    base = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+    queues = [
+        _RingQueue(
+            native.NativeRing(lib, base + i * ring_sz, cap, initialize=initialize)
+        )
+        for i in range(num_processes)
+    ]
+    off = ring_sz * num_processes
+    sems = [
+        native.NativeSemaphore(lib, base + off + i * sem_sz, initialize=initialize)
+        for i in range(num_batches)
+    ]
+    return queues, sems
+
+
 def _make_doorbells(ctx, num_processes: int, num_batches: int):
-    """Native futex rings/semaphores in one fork-shared anonymous mapping
-    (counterpart of the reference's shm semaphores + queues, src/shm.h),
-    falling back to multiprocessing primitives when g++ is unavailable."""
+    """Native futex rings/semaphores in one NAMED shm segment (counterpart of
+    the reference's shm semaphores + queues, src/shm.h), falling back to
+    multiprocessing primitives when g++ is unavailable.
+
+    Returns ``(queues, sems, region, descriptor)``: workers reconstruct their
+    handles from ``descriptor`` by attaching the named segment, so the pool
+    works under both the ``fork`` and ``forkserver`` start methods (the
+    anonymous-mmap design it replaces required address-space inheritance and
+    thus fork)."""
     from . import native
 
     lib = native.get_shmq()
     if lib is None:
-        return (
-            [_MpQueue(ctx) for _ in range(num_processes)],
-            [_MpSem(ctx) for _ in range(num_batches)],
-            None,
-        )
+        queues = [_MpQueue(ctx) for _ in range(num_processes)]
+        sems = [_MpSem(ctx) for _ in range(num_batches)]
+        # mp primitives pickle through Process args under either start method;
+        # per-worker descriptors are built at spawn so each worker receives
+        # only its own queue's fds, not all N workers'.
+        return queues, sems, None, ("mp", queues, sems)
     # Power-of-two capacity: the ring indexes with u32 cursors mod capacity,
     # which only stays consistent across the 2^32 wrap for powers of two.
     cap = 16
     while cap < 4 * num_batches:
         cap *= 2
-    ring_sz = (native.NativeRing.size(lib, cap) + 63) & ~63
-    sem_sz = (native.NativeSemaphore.size(lib) + 63) & ~63
-    total = ring_sz * num_processes + sem_sz * num_batches
-    mm = mmap.mmap(-1, total)  # MAP_SHARED | MAP_ANONYMOUS: inherited on fork
-    base = ctypes.addressof(ctypes.c_char.from_buffer(mm))
-    queues = [
-        native.NativeRing(lib, base + i * ring_sz, cap) for i in range(num_processes)
-    ]
-    off = ring_sz * num_processes
-    sems = [
-        native.NativeSemaphore(lib, base + off + i * sem_sz)
-        for i in range(num_batches)
-    ]
+    _, _, total = _doorbell_layout(lib, cap, num_processes, num_batches)
+    region = shared_memory.SharedMemory(create=True, size=total)
+    queues, sems = _native_doorbell_views(
+        lib, region.buf, cap, num_processes, num_batches, initialize=True
+    )
+    return queues, sems, region, ("native", region.name, cap, num_processes, num_batches)
 
-    class _RingQueue:
-        def __init__(self, ring):
-            self._ring = ring
 
-        def put(self, v: int) -> None:
-            self._ring.push(int(v))
+def _worker_doorbell_desc(desc, worker_index):
+    """Slice the pool-wide descriptor down to one worker's share (mp fallback:
+    just that worker's queue, so its peers' pipe fds never travel)."""
+    if desc[0] == "mp":
+        _, queues, sems = desc
+        return ("mp", queues[worker_index], sems)
+    return desc
 
-        def get(self) -> int:
-            out = self._ring.pop()
-            return _SHUTDOWN if out is None else out
 
-    return [_RingQueue(q) for q in queues], sems, mm
+def _attach_doorbells(desc, worker_index):
+    """Worker-side counterpart of :func:`_make_doorbells`: resolve the
+    descriptor into (task_queue, done_sems[, segment])."""
+    if desc[0] == "mp":
+        _, queue, sems = desc
+        return queue, sems, None
+    from . import native
+
+    _, shm_name, cap, num_processes, num_batches = desc
+    seg = shared_memory.SharedMemory(name=shm_name)
+    queues, sems = _native_doorbell_views(
+        native.get_shmq(), seg.buf, cap, num_processes, num_batches, initialize=False
+    )
+    return queues[worker_index], sems, seg
 
 
 def _normalize_obs(obs) -> Dict[str, np.ndarray]:
@@ -238,11 +312,16 @@ class EnvRunner:
             view["done"][i] = done
 
 
-def _worker_main(create_env, worker_index, lo, hi, num_batches, conn, task_queue, done_sems):
+def _worker_main(create_env, worker_index, lo, hi, num_batches, conn, doorbells):
+    task_queue, done_sems, seg = _attach_doorbells(doorbells, worker_index)
     runner = EnvRunner(
         create_env, worker_index, lo, hi, num_batches, conn, task_queue, done_sems
     )
-    runner.start()
+    try:
+        runner.start()
+    finally:
+        if seg is not None:
+            seg.close()
 
 
 def _spec_probe(create_env, conn):
@@ -342,7 +421,28 @@ class EnvPool:
         self._num_processes = num_processes
         self._batch_size = batch_size
         self._num_batches = num_batches
-        ctx = mp.get_context("fork")
+        # Start-method contract (reference fork guard src/env.cc:149-169): a
+        # plain fork() after the jax backend has started its threads is a
+        # deadlock lottery, so fork is only chosen while jax is uninitialized.
+        # Afterwards workers come from a forkserver — the server process is
+        # launched via fork+exec (thread-safe) and its children are clean —
+        # at the cost of create_env needing to be picklable.
+        start = os.environ.get("MOOLIB_TPU_ENVPOOL_START")
+        if start is None:
+            start = "fork" if not _jax_backend_initialized() else "forkserver"
+        if start == "forkserver":
+            try:
+                pickle.dumps(create_env)
+            except Exception as e:
+                raise RuntimeError(
+                    "EnvPool after jax initialization uses the forkserver start "
+                    f"method, which requires a picklable create_env ({e!r}). "
+                    "Either construct the EnvPool before the first jax backend "
+                    "use (preferred; the reference forks early for the same "
+                    "reason), or pass a module-level function / functools."
+                    "partial instead of a closure."
+                ) from e
+        ctx = mp.get_context(start)
 
         # 1. Spec discovery in a throwaway child.
         parent_conn, child_conn = ctx.Pipe()
@@ -390,9 +490,9 @@ class EnvPool:
             self._act_views.append(av)
             layout_act.append((seg.name, act_shape, np.dtype(action_dtype).str))
 
-        # 3. Fork workers, hand each its env slice + the shm layout.
-        self._task_queues, self._done_sems, self._doorbell_mm = _make_doorbells(
-            ctx, num_processes, num_batches
+        # 3. Spawn workers, hand each its env slice + the shm layout.
+        self._task_queues, self._done_sems, self._doorbell_region, doorbell_desc = (
+            _make_doorbells(ctx, num_processes, num_batches)
         )
         self._procs: List = []
         self._worker_conns: List = []
@@ -411,8 +511,7 @@ class EnvPool:
                     hi,
                     num_batches,
                     cconn,
-                    self._task_queues[w],
-                    self._done_sems,
+                    _worker_doorbell_desc(doorbell_desc, w),
                 ),
                 daemon=True,
             )
@@ -471,6 +570,11 @@ class EnvPool:
             try:
                 seg.close()
                 seg.unlink()
+            except Exception:
+                pass
+        if self._doorbell_region is not None:
+            try:
+                self._doorbell_region.unlink()
             except Exception:
                 pass
 

@@ -101,12 +101,59 @@ def test_envpool_pixel_env():
         pool.close()
 
 
-def test_bad_env_raises():
-    def make_bad():
-        raise RuntimeError("nope")
+def _make_bad():
+    raise RuntimeError("nope")
 
+
+def test_bad_env_raises():
     with pytest.raises(RuntimeError, match="probe process"):
-        EnvPool(make_bad, num_processes=1, batch_size=1, num_batches=1)
+        EnvPool(_make_bad, num_processes=1, batch_size=1, num_batches=1)
+
+
+def test_forkserver_start_method_works(monkeypatch):
+    """The pool must work under the forkserver start method — the path the
+    auto-selection takes once jax is initialized (fork-after-jax hazard,
+    reference guard src/env.cc:149-169)."""
+    monkeypatch.setenv("MOOLIB_TPU_ENVPOOL_START", "forkserver")
+    pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=1)
+    try:
+        out = pool.step(0, np.zeros(4, np.int64)).result()
+        assert out["obs"].shape[0] == 4
+        out = pool.step(0, np.ones(4, np.int64)).result()
+        assert out["reward"].shape == (4,)
+    finally:
+        pool.close()
+
+
+def test_forkserver_rejects_unpicklable_create_env(monkeypatch):
+    monkeypatch.setenv("MOOLIB_TPU_ENVPOOL_START", "forkserver")
+
+    def closure_env():  # nested -> unpicklable
+        return FakeEnv()
+
+    with pytest.raises(RuntimeError, match="picklable create_env"):
+        EnvPool(closure_env, num_processes=1, batch_size=1, num_batches=1)
+
+
+def test_auto_selects_forkserver_once_jax_is_initialized(monkeypatch):
+    """After any jax backend use in this process, the pool must not plain-fork
+    (jax is multithreaded; the reference refuses fork with live threads)."""
+    monkeypatch.delenv("MOOLIB_TPU_ENVPOOL_START", raising=False)
+    import jax
+
+    jax.devices()  # ensure the backend exists (cpu in tests)
+    from moolib_tpu.envpool import _jax_backend_initialized
+
+    assert _jax_backend_initialized()
+    pool = EnvPool(FakeEnv, num_processes=1, batch_size=2, num_batches=1)
+    try:
+        assert pool._procs and all(
+            type(p).__name__ == "ForkServerProcess" for p in pool._procs
+        ), [type(p).__name__ for p in pool._procs]
+        out = pool.step(0, np.zeros(2, np.int64)).result()
+        assert out["obs"].shape[0] == 2
+    finally:
+        pool.close()
 
 
 class ExplodingEnv(FakeEnv):

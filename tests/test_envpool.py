@@ -29,7 +29,11 @@ class FakeEnv:
         )
 
 
-def test_envpool_basic():
+def test_envpool_basic(monkeypatch):
+    # Pin the fork path: in a full-suite run an earlier test has usually
+    # initialized jax, which would silently flip every pool to forkserver
+    # and lose fork-path coverage.
+    monkeypatch.setenv("MOOLIB_TPU_ENVPOOL_START", "fork")
     pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=1)
     try:
         fut = pool.step(0, np.zeros(4, np.int64))
@@ -49,7 +53,8 @@ def test_envpool_basic():
         pool.close()
 
 
-def test_envpool_double_buffer():
+def test_envpool_double_buffer(monkeypatch):
+    monkeypatch.setenv("MOOLIB_TPU_ENVPOOL_START", "fork")  # see test_envpool_basic
     pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=2)
     try:
         f0 = pool.step(0, np.zeros(4, np.int64))

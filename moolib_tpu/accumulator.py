@@ -90,7 +90,11 @@ class _Round:
 
 
 def _tree_nbytes(tree) -> int:
-    return sum(np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(tree))
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = getattr(leaf, "nbytes", None)  # numpy and jax.Array: no transfer
+        total += int(n) if n is not None else np.asarray(leaf).nbytes
+    return total
 
 
 class Accumulator:

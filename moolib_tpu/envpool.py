@@ -156,9 +156,17 @@ def _make_doorbells(ctx, num_processes: int, num_batches: int):
         cap *= 2
     _, _, total = _doorbell_layout(lib, cap, num_processes, num_batches)
     region = shared_memory.SharedMemory(create=True, size=total)
-    queues, sems = _native_doorbell_views(
-        lib, region.buf, cap, num_processes, num_batches, initialize=True
-    )
+    try:
+        queues, sems = _native_doorbell_views(
+            lib, region.buf, cap, num_processes, num_batches, initialize=True
+        )
+    except Exception:
+        # Not yet owned by a pool: unlink here or the named segment leaks.
+        try:
+            region.unlink()
+        except Exception:
+            pass
+        raise
     return queues, sems, region, ("native", region.name, cap, num_processes, num_batches)
 
 

@@ -58,3 +58,34 @@ class CatchEnv:
         if done:
             reward = 1.0 if self._ball[1] == self._paddle else -1.0
         return self._obs(), reward, done, {}
+
+
+class FrameStack:
+    """Stack the last ``num_stack`` single-channel frames on the channel axis
+    (the reference trains on (84, 84, 4) stacked Atari frames,
+    ``examples/atari/environment.py``; AtariPreprocessing stacks internally —
+    this is the generic wrapper for envs that emit one frame per step)."""
+
+    def __init__(self, env, num_stack: int = 4):
+        self.env = env
+        self.num_stack = num_stack
+        self._frames = None
+        self.num_actions = env.num_actions
+
+    @property
+    def observation_shape(self):
+        h, w, c = self.env.observation_shape
+        return (h, w, c * self.num_stack)
+
+    def _obs(self):
+        return np.concatenate(self._frames, axis=-1)
+
+    def reset(self):
+        first = self.env.reset()
+        self._frames = [first] * self.num_stack
+        return self._obs()
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        self._frames = self._frames[1:] + [obs]
+        return self._obs(), reward, done, info

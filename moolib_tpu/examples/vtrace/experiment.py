@@ -136,6 +136,12 @@ def make_env_factory(flags):
         # (VERDICT round-1 ask #7; intent of the reference's Atari flagship).
         factory = partial(CatchEnv, frame_shape=(42, 42))
         return factory, CatchEnv.num_actions, (42, 42, 1)
+    if flags.env == "pixel_catch84":
+        # The reference's full observation scale: (84, 84, 4) stacked frames
+        # (examples/atari/environment.py) through the complete 16/32/32
+        # ImpalaNet — the pixel bar at Atari geometry, without ALE.
+        factory = _pixel_catch84_factory
+        return factory, CatchEnv.num_actions, (84, 84, 4)
     if flags.env == "cartpole":
         return CartPoleEnv, 2, (4,)
     if flags.env.startswith("atari:"):
@@ -161,10 +167,17 @@ def make_env_factory(flags):
         return partial(GymEnv, env_id), n, tuple(shape)
     if flags.env != "synthetic":
         raise ValueError(
-            f"unknown --env {flags.env!r} (catch | pixel_catch | cartpole | "
-            "synthetic | atari:<Game> | gym:<id>)"
+            f"unknown --env {flags.env!r} (catch | pixel_catch | pixel_catch84 "
+            "| cartpole | synthetic | atari:<Game> | gym:<id>)"
         )
     return SyntheticAtariEnv, 6, (84, 84, 4)
+
+
+def _pixel_catch84_factory():
+    # Module-level (picklable) for EnvPool's forkserver path.
+    from ...envs import FrameStack
+
+    return FrameStack(CatchEnv(frame_shape=(84, 84)), num_stack=4)
 
 
 def make_model(flags, num_actions, obs_shape):

@@ -221,3 +221,22 @@ def test_gym_adapter_reseed_only_first_reset():
     np.testing.assert_array_equal(first_a, first_b)  # seed honored once
     # If reset re-applied the seed, the state would replay identically.
     assert not np.array_equal(first_a, a.reset())
+
+
+def test_frame_stack_wrapper():
+    """FrameStack stacks the last k single-channel frames on the channel axis
+    (reference geometry: (84, 84, 4), examples/atari/environment.py)."""
+    from moolib_tpu.envs import CatchEnv, FrameStack
+
+    env = FrameStack(CatchEnv(frame_shape=(84, 84), seed=0), num_stack=4)
+    assert env.observation_shape == (84, 84, 4)
+    assert env.num_actions == 3
+    obs = env.reset()
+    assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+    # Reset replicates the first frame into every slot.
+    assert (obs[..., 0] == obs[..., 3]).all()
+    o1, _, _, _ = env.step(1)
+    o2, _, _, _ = env.step(1)
+    # Channels shift: frame t-1 moves from slot 3 to slot 2.
+    np.testing.assert_array_equal(o2[..., 2], o1[..., 3])
+    assert o2.shape == (84, 84, 4)

@@ -29,11 +29,7 @@ class FakeEnv:
         )
 
 
-def test_envpool_basic(monkeypatch):
-    # Pin the fork path: in a full-suite run an earlier test has usually
-    # initialized jax, which would silently flip every pool to forkserver
-    # and lose fork-path coverage.
-    monkeypatch.setenv("MOOLIB_TPU_ENVPOOL_START", "fork")
+def test_envpool_basic():
     pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=1)
     try:
         fut = pool.step(0, np.zeros(4, np.int64))
@@ -53,8 +49,7 @@ def test_envpool_basic(monkeypatch):
         pool.close()
 
 
-def test_envpool_double_buffer(monkeypatch):
-    monkeypatch.setenv("MOOLIB_TPU_ENVPOOL_START", "fork")  # see test_envpool_basic
+def test_envpool_double_buffer():
     pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=2)
     try:
         f0 = pool.step(0, np.zeros(4, np.int64))
@@ -113,6 +108,35 @@ def _make_bad():
 def test_bad_env_raises():
     with pytest.raises(RuntimeError, match="probe process"):
         EnvPool(_make_bad, num_processes=1, batch_size=1, num_batches=1)
+
+
+def test_fork_path_in_fresh_process():
+    """Fork-path coverage without forking after jax: a fresh interpreter
+    (jax uninitialized) must auto-select plain fork and serve steps.  In the
+    full suite jax is already up in-process, so the in-suite pools above ride
+    forkserver — forcing fork here would be the exact hazard the guard
+    prevents."""
+    import subprocess
+    import sys
+
+    script = """
+import numpy as np
+from moolib_tpu import EnvPool
+from moolib_tpu.envs import CatchEnv
+
+pool = EnvPool(CatchEnv, num_processes=1, batch_size=2, num_batches=1)
+assert all(type(p).__name__ == "ForkProcess" for p in pool._procs), (
+    [type(p).__name__ for p in pool._procs])
+out = pool.step(0, np.zeros(2, np.int64)).result()
+assert out["state"].shape[0] == 2
+pool.close()
+print("FORK-PATH-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "FORK-PATH-OK" in proc.stdout
 
 
 def test_forkserver_start_method_works(monkeypatch):

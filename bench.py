@@ -59,8 +59,16 @@ def _peak_for(kind: str):
     return None
 
 
-def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
-    """The actual device benchmark (runs in the child process)."""
+def build_step():
+    """Construct the reference-config IMPALA learner step: ImpalaNet forward
+    + v-trace loss + RMSProp update on the Atari shapes.  Shared by the
+    benchmark loop below and ``benchmarks/impala_roofline.py`` so the
+    roofline analysis characterizes exactly the step that is timed.
+
+    Returns ``(step, params, opt_state, batch)`` with ``step`` jitted and
+    donating params/opt_state (the update happens in place in HBM instead of
+    allocating fresh buffers every step — matters at Atari-model size).
+    """
     from functools import partial
 
     import jax
@@ -91,7 +99,6 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
         ent = entropy_loss(target_logits)
         return pg + 0.5 * bl + 0.01 * ent
 
-    device = jax.devices()[0]
     model = ImpalaNet(num_actions=NUM_ACTIONS, use_lstm=False, dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
     batch = {
@@ -106,13 +113,21 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
     opt = optax.rmsprop(1e-3, decay=0.99, eps=0.01)
     opt_state = opt.init(params)
 
-    # Donate params/opt_state: the update happens in place in HBM instead of
-    # allocating fresh buffers every step (matters at Atari-model size).
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(partial(loss_fn, model=model))(params, batch)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
+
+    return step, params, opt_state, batch
+
+
+def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
+    """The actual device benchmark (runs in the child process)."""
+    import jax
+
+    device = jax.devices()[0]
+    step, params, opt_state, batch = build_step()
 
     flops_per_step = None
     try:

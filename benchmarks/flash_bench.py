@@ -18,6 +18,18 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from timing import chain_elapsed, marginal_time  # noqa: E402
 
 
+def _time_or_oom(thunk):
+    """Run a timing thunk; dense attention legitimately runs out of HBM at
+    long T (the problem flash attention solves) — report that as None, not a
+    crash.  XLA raises backend-specific OOM types, hence string matching."""
+    try:
+        return thunk()
+    except Exception as e:  # noqa: BLE001
+        if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e).lower():
+            raise
+        return None
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -49,20 +61,60 @@ def main():
             n1, n2 = (8, 40) if T <= 2048 else (4, 16)
             return marginal_time(run, n1, n2) * 1e3
 
-        # Dense materializes the full [B,H,T,T] score matrix and runs out of
-        # HBM at long T (the problem flash attention solves) — report that as
-        # a result, not a crash.
-        try:
-            d_ms = timeit(dense)
-        except Exception as e:  # noqa: BLE001 — XLA raises backend-specific OOM types
-            if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e).lower():
-                raise
-            d_ms = None
+        d_ms = _time_or_oom(lambda: timeit(dense))
         f_ms = timeit(flash)
         if d_ms is None:
             print(f"{T:>6} {'OOM':>9} {f_ms:>9.3f} {'inf':>8}")
         else:
             print(f"{T:>6} {d_ms:>9.3f} {f_ms:>9.3f} {d_ms / f_ms:>8.2f}x")
+
+    # Training path: forward + backward.  flash rides the pallas dq and dk/dv
+    # kernels (default); "oracle" is the blockwise-jax VJP it replaced
+    # (MOOLIB_TPU_FLASH_BWD=jax), AOT-compiled while the env var is set so
+    # the comparison is kernel vs pure-XLA recompute at identical math.
+    print("# fwd+bwd (sum-of-output gradient wrt q,k,v)")
+    print(f"{'T':>6} {'dense_ms':>9} {'flash_ms':>9} {'oracle_ms':>10}")
+    for T in (512, 1024, 2048, 4096, 8192):
+        rng = np.random.default_rng(T)
+        mk = lambda: jnp.asarray(
+            rng.normal(size=(B, T, H, D)).astype(np.float32)
+        ).astype(jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+
+        def grad_of(attn):
+            return jax.jit(
+                jax.grad(
+                    lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32)),
+                    argnums=(0, 1, 2),
+                )
+            )
+
+        gdense = grad_of(lambda q, k, v: full_attention(q, k, v, causal=True))
+        gflash = grad_of(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        os.environ["MOOLIB_TPU_FLASH_BWD"] = "jax"
+        try:
+            goracle = grad_of(
+                lambda q, k, v: flash_attention(q, k, v, causal=True)
+            ).lower(q, k, v).compile()
+        finally:
+            os.environ.pop("MOOLIB_TPU_FLASH_BWD", None)
+
+        def timeit_g(fn):
+            # Chain through dq (same shape as q) to keep steps data-dependent.
+            def run(iters):
+                return chain_elapsed(
+                    lambda qq: fn(qq, k, v)[0], q, iters,
+                    lambda dq: float(jnp.sum(dq.astype(jnp.float32))),
+                )
+
+            n1, n2 = (8, 40) if T <= 2048 else (2, 8)
+            return marginal_time(run, n1, n2) * 1e3
+
+        d_ms = _time_or_oom(lambda: timeit_g(gdense))
+        f_ms = timeit_g(gflash)
+        o_ms = timeit_g(goracle)
+        d_str = f"{d_ms:>9.3f}" if d_ms is not None else f"{'OOM':>9}"
+        print(f"{T:>6} {d_str} {f_ms:>9.3f} {o_ms:>10.3f}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,93 @@
+"""Roofline bound analysis for the IMPALA learner step (VERDICT r2 weak #2).
+
+Compiles bench.py's exact train step (``bench.build_step()``: ImpalaNet +
+v-trace + RMSProp at the reference's Atari config) and pulls XLA cost
+analysis: model FLOPs and bytes accessed per step.  Arithmetic intensity vs
+the chip's compute/bandwidth ratio states which resource bounds the step —
+the profile-backed statement that must accompany the MFU number.  Optionally
+captures a jax profiler trace (--trace_dir) for later inspection.
+
+Peak FLOP/s comes from bench.py's table; HBM bandwidth ~819 GB/s for v5e,
+~1228 GB/s v4, ~2765 GB/s v5p (public spec sheets).
+
+    JAX_PLATFORMS='' python benchmarks/impala_roofline.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PEAK_BW = [("v6", 1640e9), ("v5p", 2765e9), ("v5 lite", 819e9),
+            ("v5e", 819e9), ("v5", 2765e9), ("v4", 1228e9),
+            ("v3", 900e9), ("v2", 700e9)]
+
+
+def _bw_for(kind: str):
+    k = kind.lower()
+    return next((p for s, p in _PEAK_BW if s in k), None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace_dir", default=None,
+                    help="also capture a jax profiler trace of a few steps")
+    args = ap.parse_args()
+
+    import jax
+
+    # The environment's sitecustomize pins jax_platforms via config, which
+    # overrides the env var — re-assert the caller's explicit choice.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import bench  # repo-root bench.py: the exact step the benchmark times
+
+    device = jax.devices()[0]
+    step, params, opt_state, batch = bench.build_step()
+    compiled = step.lower(params, opt_state, batch).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0))
+    byts = float(cost.get("bytes accessed", 0.0))
+    pf = bench._peak_for(device.device_kind)
+    pb = _bw_for(device.device_kind)
+
+    out = {
+        "device": device.device_kind,
+        "platform": device.platform,
+        "model_tflops_per_step": round(flops / 1e12, 4),
+        "bytes_accessed_per_step_mb": round(byts / 1e6, 1),
+        "arithmetic_intensity_flop_per_byte": round(flops / byts, 1) if byts else None,
+    }
+    if pf and pb and byts:
+        # Ridge point: AI below peak_flops/peak_bw means HBM-bound.
+        ridge = pf / pb
+        ai = flops / byts
+        out["ridge_flop_per_byte"] = round(ridge, 1)
+        out["bound"] = "memory (HBM bandwidth)" if ai < ridge else "compute (MXU)"
+        out["min_step_ms_compute"] = round(flops / pf * 1e3, 3)
+        out["min_step_ms_memory"] = round(byts / pb * 1e3, 3)
+        out["roofline_mfu_ceiling"] = round(min(1.0, ai / ridge), 3)
+
+    if args.trace_dir:
+        # AOT `compiled` is used directly so no retrace/recompile lands
+        # inside the captured trace window.
+        p2, s2 = params, opt_state
+        p2, s2, l = compiled(p2, s2, batch)  # warmup outside the trace
+        with jax.profiler.trace(args.trace_dir):
+            for _ in range(5):
+                p2, s2, l = compiled(p2, s2, batch)
+            jax.block_until_ready(l)
+        out["trace_dir"] = args.trace_dir
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

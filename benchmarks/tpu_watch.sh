@@ -1,13 +1,21 @@
 #!/bin/bash
-# TPU tunnel liveness watcher: probe every ~3 min, append status lines to
-# the log so an operator (or the build loop) can see when the chip is back.
+# TPU tunnel liveness watcher: probe every ~3 min and append status lines.
 # The probe is bench.py's own child probe mode — one copy of the logic.
+# When the tunnel comes alive and AUTOCAPTURE=1, fire the capture battery
+# (benchmarks/tpu_autocapture.sh) once per watcher lifetime.
 LOG=${1:-/tmp/tpu_watch.log}
 BENCH="$(dirname "$0")/../bench.py"
+CAPTURED=0
 while true; do
   ts=$(date +%H:%M:%S)
   if timeout 120 env MOOLIB_BENCH_CHILD=probe python "$BENCH" 2>/dev/null | grep -q MOOLIB_BENCH_RESULT; then
     echo "$ts ALIVE" >> "$LOG"
+    if [ "${AUTOCAPTURE:-0}" = "1" ] && [ "$CAPTURED" = "0" ]; then
+      CAPTURED=1
+      echo "$ts autocapture starting" >> "$LOG"
+      bash "$(dirname "$0")/tpu_autocapture.sh" >> "$LOG" 2>&1
+      echo "$(date +%H:%M:%S) autocapture finished" >> "$LOG"
+    fi
   else
     echo "$ts dead" >> "$LOG"
   fi

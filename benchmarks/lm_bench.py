@@ -15,11 +15,12 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from timing import chain_elapsed, marginal_time  # noqa: E402
+from timing import marginal_time  # noqa: E402
 
 # Dense bf16 peak FLOP/s per device kind (same table as bench.py).
 _PEAK = [("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
@@ -37,56 +38,79 @@ def main():
         raise SystemExit("lm_bench needs an accelerator backend")
     dev = jax.devices()[0]
     peak = next((p for s, p in _PEAK if s in dev.device_kind.lower()), None)
-    print(f"# backend={jax.default_backend()} device={dev.device_kind}")
-    print(f"{'T':>6} {'B':>3} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
+    # Model scale is env-tunable; the default (d=1024, L=12, ~220M params)
+    # keeps per-layer matmuls at 1024x4096 — big enough to fill the MXU,
+    # where the earlier d=512 draft would cap MFU well below the 35% target.
+    D = int(os.environ.get("MOOLIB_LM_DMODEL", 1024))
+    L = int(os.environ.get("MOOLIB_LM_LAYERS", 12))
+    H = max(4, D // 128)
+    print(f"# backend={jax.default_backend()} device={dev.device_kind} "
+          f"d_model={D} layers={L}")
+    print(f"{'T':>6} {'B':>3} {'remat':>5} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
 
     rows = []
-    for T, B in ((1024, 16), (2048, 8), (4096, 4), (8192, 2)):
+    # (T, B, remat): constant 16k-token steps, plus remat rows at long T
+    # where checkpointing lets the batch double within the same HBM.
+    for T, B, remat in (
+        (1024, 16, False), (2048, 8, False), (4096, 4, False),
+        (4096, 8, True), (8192, 2, False), (8192, 4, True),
+    ):
         model = TransformerLM(
-            vocab_size=32768, d_model=512, num_heads=8, num_layers=8,
-            max_len=8192, attention="flash", dtype=jnp.bfloat16,
+            vocab_size=32768, d_model=D, num_heads=H, num_layers=L,
+            max_len=8192, attention="flash", dtype=jnp.bfloat16, remat=remat,
         )
         rng = np.random.default_rng(T)
         toks = jnp.asarray(rng.integers(0, 32768, size=(B, T), dtype=np.int32))
-        params = model.init(jax.random.key(0), toks)
-        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-        opt = optax.adamw(1e-4)
-        opt_state = opt.init(params)
+        try:
+            params = model.init(jax.random.key(0), toks)
+            n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+            opt = optax.adamw(1e-4)
+            opt_state = opt.init(params)
 
-        def loss_fn(p, t):
-            logits = model.apply(p, t)
-            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
-            return -jnp.take_along_axis(logp, t[:, 1:, None], axis=-1).mean()
+            def loss_fn(p, t):
+                logits = model.apply(p, t)
+                logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+                return -jnp.take_along_axis(logp, t[:, 1:, None], axis=-1).mean()
 
-        from functools import partial
+            from functools import partial
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(p, s, t):
-            loss, g = jax.value_and_grad(loss_fn)(p, t)
-            up, s = opt.update(g, s, p)
-            return optax.apply_updates(p, up), s, loss
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def step(p, s, t):
+                loss, g = jax.value_and_grad(loss_fn)(p, t)
+                up, s = opt.update(g, s, p)
+                return optax.apply_updates(p, up), s, loss
 
-        state = {"p": params, "s": opt_state}
+            # The chain state persists across run() calls: step donates its
+            # param/opt buffers, so re-starting a chain from an earlier state
+            # would dereference deleted arrays on an accelerator backend.
+            state = {"p": params, "s": opt_state}
 
-        def run(iters):
-            def one(st):
-                p, s, loss = step(st["p"], st["s"], toks)
-                return {"p": p, "s": s, "loss": loss}
+            def run(iters):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    state["p"], state["s"], loss = step(state["p"], state["s"], toks)
+                float(loss)  # force the chain with a scalar fetch
+                return time.perf_counter() - t0
 
-            return chain_elapsed(one, state, iters, lambda st: float(st["loss"]))
-
-        sec = marginal_time(run, 2, 8)
+            sec = marginal_time(run, 2, 8)
+        except Exception as e:  # noqa: BLE001 — backend-specific OOM types
+            if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e).lower():
+                raise
+            print(f"{T:>6} {B:>3} {str(remat):>5} {'OOM':>9}")
+            rows.append({"T": T, "B": B, "remat": remat, "oom": True})
+            continue
         tokens_s = B * T / sec
         # Standard 6*N*D transformer FLOPs (fwd+bwd) + attention term
         # 12*L*H*hd*T^2... keep the 6ND convention and report it as such.
         flops = 6.0 * n_params * B * T
         mfu = flops / sec / peak if peak else float("nan")
-        print(f"{T:>6} {B:>3} {sec * 1e3:>9.2f} {tokens_s:>10.0f} {mfu:>6.3f}")
+        print(f"{T:>6} {B:>3} {str(remat):>5} {sec * 1e3:>9.2f} "
+              f"{tokens_s:>10.0f} {mfu:>6.3f}")
         rows.append(
-            {"T": T, "B": B, "step_ms": round(sec * 1e3, 2),
+            {"T": T, "B": B, "remat": remat, "step_ms": round(sec * 1e3, 2),
              "tokens_per_s": round(tokens_s, 1), "mfu_6nd": round(mfu, 4)}
         )
-    print(json.dumps({"lm_train": rows}))
+    print(json.dumps({"lm_train": {"d_model": D, "layers": L, "rows": rows}}))
 
 
 if __name__ == "__main__":

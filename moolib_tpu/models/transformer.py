@@ -174,6 +174,7 @@ class TransformerLM(nn.Module):
     pos_embedding: str = "learned"  # learned (table, capped at max_len) | rotary
     decode: bool = False  # single-token KV-cache steps (see generate())
     collect_kv: bool = False  # sow per-block K/V (generate()'s prefill)
+    remat: bool = False  # checkpoint each block: O(L) -> O(1) activations
 
     @nn.compact
     def __call__(self, tokens: jax.Array, mesh=None) -> jax.Array:
@@ -196,9 +197,19 @@ class TransformerLM(nn.Module):
             )(pos_idx)
         elif self.pos_embedding != "rotary":
             raise ValueError(f"unknown pos_embedding {self.pos_embedding!r}")
+        # remat trades ~1/3 extra FLOPs for O(1)-in-depth activation memory
+        # (HBM is the usual TPU bottleneck): each block's activations are
+        # recomputed during the backward instead of stored.  Bigger batches
+        # then fit at long T, which is how lm_bench pushes MFU.  mesh is a
+        # static argument (index 2 counting self), not a traced operand.
+        block_cls = (
+            nn.remat(Block, static_argnums=(2,))
+            if self.remat and not self.decode
+            else Block
+        )
         for i in range(self.num_layers):
             use_moe = self.moe_num_experts and i % self.moe_every == self.moe_every - 1
-            x = Block(
+            x = block_cls(
                 self.d_model,
                 self.num_heads,
                 self.attention,
@@ -210,7 +221,7 @@ class TransformerLM(nn.Module):
                 max_len=self.max_len,
                 collect_kv=self.collect_kv,
                 name=f"block{i}",
-            )(x, mesh=mesh)
+            )(x, mesh)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(
             x.astype(jnp.float32)

@@ -25,8 +25,9 @@ def _time_or_oom(thunk):
     try:
         return thunk()
     except Exception as e:  # noqa: BLE001
-        if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e).lower():
-            raise
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" not in msg and "out of memory" not in msg.lower():
+            raise  # only real OOMs are tolerated; compile errors must fail
         return None
 
 

@@ -1,0 +1,196 @@
+"""Deterministic frame-level fault simulation for the RPC reliability layer.
+
+SURVEY §7 names the poke/ack/nack/dedup/timeout interplay the hardest part
+of the build and notes the reference's own tests for it are weak
+(randomized churn only).  ``tests/test_rpc_faults.py`` covers stochastic
+churn through a chaos proxy; this file scripts EXACT protocol faults — drop
+the Nth frame of kind K, duplicate it, or hold it past the next frame
+(reordering) — so each reliability invariant is pinned by a deterministic
+scenario:
+
+- dropped RESPONSE  -> POKE draws the cached response; no re-execution
+- duplicated REQUEST -> at-most-once dedup; executed exactly once
+- duplicated RESPONSE -> future completes once, duplicate ignored
+- reordered RESPONSEs -> rid matching is order-independent
+- dropped ACK under a slow handler -> pokes continue, still one execution
+
+Faults are injected at ``send_frame`` (the single seam both transport
+backends share); the asyncio backend is pinned for python-deterministic
+frame timing.
+"""
+
+import threading
+import time
+
+import pytest
+
+from moolib_tpu import Rpc
+from moolib_tpu.rpc import core as rpc_core
+
+
+class FrameSim:
+    """Scripted per-kind frame actions on one connection.
+
+    ``policy`` maps a frame KIND to a list of actions applied to successive
+    frames of that kind: "pass", "drop", "dup", or "hold" (withheld, then
+    flushed right after the next frame of any kind is sent — a deterministic
+    reorder).  Frames beyond the list, and kinds not in the policy, pass.
+    """
+
+    def __init__(self, conn, policy):
+        self.conn = conn
+        self.policy = policy
+        self.counts = {}
+        self.held = []
+        self.log = []
+        self._cls = type(conn)
+        self._orig = self._cls.send_frame
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        sim = self
+
+        def send(conn_self, chunks):
+            if conn_self is not sim.conn or not chunks:
+                return sim._orig(conn_self, chunks)
+            kind = bytes(chunks[0][:1])[0]
+            with sim._lock:
+                i = sim.counts.get(kind, 0)
+                sim.counts[kind] = i + 1
+                actions = sim.policy.get(kind, ())
+                action = actions[i] if i < len(actions) else "pass"
+                sim.log.append((kind, i, action))
+                if action == "drop":
+                    return None
+                if action == "hold":
+                    # Materialize: the caller may reuse its buffers.
+                    sim.held.append([bytes(c) for c in chunks])
+                    return None
+                held, sim.held = sim.held, []
+            rv = sim._orig(conn_self, chunks)
+            if action == "dup":
+                sim._orig(conn_self, chunks)
+            for h in held:  # flush AFTER the passing frame: reorder
+                sim._orig(conn_self, h)
+            return rv
+
+        self._cls.send_frame = send
+        return self
+
+    def __exit__(self, *exc):
+        self._cls.send_frame = self._orig
+        return False
+
+
+@pytest.fixture()
+def pair(free_port, monkeypatch):
+    """host/client Rpc pair over loopback with a counted echo handler."""
+    monkeypatch.setenv("MOOLIB_TPU_NATIVE_TRANSPORT", "0")
+    host, client = Rpc(), Rpc()
+    host.set_name("host")
+    client.set_name("client")
+    client.set_timeout(30)
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    def echo(x):
+        with lock:
+            calls["n"] += 1
+        return x + 1
+
+    host.define("echo", echo)
+    host.listen(f"127.0.0.1:{free_port}")
+    client.connect(f"127.0.0.1:{free_port}")
+    assert client.sync("host", "echo", 0) == 1  # warm link + fid
+    calls["n"] = 0
+    yield host, client, calls
+    host.close()
+    client.close()
+
+
+def _host_conn(host):
+    return host._peers["client"].best_connection(host._transport_order)
+
+
+def _client_conn(client):
+    return client._peers["host"].best_connection(client._transport_order)
+
+
+def test_dropped_response_recovers_from_cache_without_reexecution(pair):
+    """The receiver caches responses: when the RESPONSE frame is lost, the
+    sender's POKE must draw the cached copy — the handler must NOT run
+    again (reference at-most-once, src/rpc.cc:2561-2641)."""
+    host, client, calls = pair
+    with FrameSim(_host_conn(host), {rpc_core.KIND_RESPONSE: ["drop"]}) as sim:
+        t0 = time.monotonic()
+        assert client.sync("host", "echo", 41) == 42
+        elapsed = time.monotonic() - t0
+    assert ("drop" in [a for _, _, a in sim.log]), "fault never injected"
+    assert calls["n"] == 1, "re-executed after response loss"
+    # Poke cadence is 0.75 s; far below blind resend (9 s) and timeout.
+    assert elapsed < 6.0, f"cached-response recovery took {elapsed:.1f}s"
+
+
+def test_duplicated_request_executes_once(pair):
+    host, client, calls = pair
+    with FrameSim(_client_conn(client), {rpc_core.KIND_REQUEST: ["dup"]}):
+        assert client.sync("host", "echo", 10) == 11
+        # Give the duplicate time to be (wrongly) executed if dedup failed.
+        time.sleep(0.5)
+    assert calls["n"] == 1, f"duplicate request executed {calls['n']} times"
+
+
+def test_duplicated_response_completes_future_once(pair):
+    host, client, calls = pair
+    results = []
+    with FrameSim(_host_conn(host), {rpc_core.KIND_RESPONSE: ["dup"]}):
+        fut = client.async_("host", "echo", 20)
+        results.append(fut.result())
+        time.sleep(0.5)  # the duplicate arrives; must be ignored
+    assert results == [21]
+    assert calls["n"] == 1
+    # A fresh call still works (duplicate didn't corrupt rid state).
+    assert client.sync("host", "echo", 30) == 31
+
+
+def test_reordered_responses_match_by_rid(pair):
+    """Hold call A's RESPONSE until B's passes: the wire order inverts, and
+    both futures must still complete with their own results."""
+    host, client, calls = pair
+    sem = threading.Semaphore(0)
+    host.define("gated", lambda x: (sem.acquire(timeout=10), x * 100)[1])
+    with FrameSim(
+        _host_conn(host), {rpc_core.KIND_RESPONSE: ["hold", "pass"]}
+    ) as sim:
+        fa = client.async_("host", "gated", 1)
+        time.sleep(0.3)  # A reaches the handler first (deterministic rids)
+        fb = client.async_("host", "gated", 2)
+        sem.release()  # A finishes first -> its response is held
+        time.sleep(0.3)
+        sem.release()  # B's response passes, then A's flushes after it
+        assert fb.result() == 200
+        assert fa.result() == 100
+    kinds = [(k, a) for k, _, a in sim.log if k == rpc_core.KIND_RESPONSE]
+    assert kinds[:2] == [(rpc_core.KIND_RESPONSE, "hold"),
+                         (rpc_core.KIND_RESPONSE, "pass")], sim.log
+
+
+def test_dropped_ack_keeps_poking_without_reexecution(pair):
+    """Pokes during a slow handler draw ACKs; losing the first ACK must only
+    cost another poke round — never a re-execution."""
+    host, client, calls = pair
+    slow_calls = {"n": 0}
+    lock = threading.Lock()
+
+    def slow(x):
+        with lock:
+            slow_calls["n"] += 1
+        time.sleep(2.0)  # several poke periods
+        return x * 10
+
+    host.define("slow", slow)
+    with FrameSim(_host_conn(host), {rpc_core.KIND_ACK: ["drop"]}) as sim:
+        assert client.sync("host", "slow", 7) == 70
+    acks = [(i, a) for k, i, a in sim.log if k == rpc_core.KIND_ACK]
+    assert acks and acks[0][1] == "drop", sim.log
+    assert slow_calls["n"] == 1

@@ -264,3 +264,22 @@ def test_remat_with_ring_attention_mesh_is_static():
     np.testing.assert_allclose(
         np.asarray(out_d), np.asarray(out_r), rtol=2e-4, atol=2e-4
     )
+
+    # And BACKWARD: jax.checkpoint's re-trace must handle the static Mesh
+    # and the ring ppermutes under grad — the composition's fragile case.
+    def loss(m, kwargs):
+        def f(p):
+            logits = m.apply(p, tokens, **kwargs)
+            logp = jax.nn.log_softmax(logits[:, :-1], -1)
+            return -jnp.take_along_axis(logp, tokens[:, 1:, None], -1).mean()
+        return f
+
+    # jit is required: remat's closed_call can't evaluate eagerly inside
+    # shard_map (and real train steps are always jitted anyway).
+    g_d = jax.jit(jax.grad(loss(dense, {})))(params)
+    g_r = jax.jit(jax.grad(loss(ring_remat, {"mesh": mesh})))(params)
+    assert jax.tree_util.tree_structure(g_d) == jax.tree_util.tree_structure(g_r)
+    for a, b in zip(jax.tree_util.tree_leaves(g_d), jax.tree_util.tree_leaves(g_r)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4
+        )

@@ -36,6 +36,21 @@ def _largest_divisor(t: int, cap: int) -> int:
     return 0
 
 
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct for a pallas output, carrying the union of the
+    operands' varying-mesh-axes (vma) so the kernel works inside shard_map
+    (ring attention calls it per chunk) as well as at top level."""
+    vma = frozenset()
+    for x in operands:
+        v = getattr(jax.typeof(x), "vma", None)
+        if v:
+            vma |= v
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax without vma support
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, scale, causal, block_q, block_k,
@@ -94,7 +109,7 @@ def _flash_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
-def _blockwise_attention(q, k, v, causal, block_q, block_k):
+def _blockwise_attention(q, k, v, causal, block_q, block_k, return_lse=False):
     """Pure-jax chunked streaming-softmax attention — the differentiable
     reference the backward pass uses (same math as the kernel; O(block)
     score memory thanks to the scan + checkpointed inner step)."""
@@ -143,16 +158,22 @@ def _blockwise_attention(q, k, v, causal, block_q, block_k):
             jnp.zeros((B, H, block_q), jnp.float32),
             jnp.full((B, H, block_q), _NEG_INF, jnp.float32),
         )
-        (acc, l, _), _ = jax.lax.scan(
+        (acc, l, m), _ = jax.lax.scan(
             jax.checkpoint(kv_step), init, (jnp.arange(nk), kb, vb)
         )
         out = acc / jnp.maximum(l[..., None], 1e-30)  # [B, H, bq, D]
-        return jnp.moveaxis(out, 1, 2)  # [B, bq, H, D]
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))  # [B, H, bq]
+        return jnp.moveaxis(out, 1, 2), jnp.moveaxis(lse, 1, 2)
 
-    outs = jax.lax.map(per_q, (jnp.arange(nq), qb))  # [nq, B, bq, H, D]
-    return (
-        jnp.moveaxis(outs, 0, 1).reshape(B, Tq, H, D).astype(q.dtype)
-    )
+    outs, lses = jax.lax.map(per_q, (jnp.arange(nq), qb))  # [nq, B, bq, ...]
+    out = jnp.moveaxis(outs, 0, 1).reshape(B, Tq, H, D).astype(q.dtype)
+    if return_lse:
+        return out, jnp.moveaxis(lses, 0, 1).reshape(B, Tq, H)
+    return out
+
+
+def _use_oracle_bwd() -> bool:
+    return os.environ.get("MOOLIB_TPU_FLASH_BWD", "pallas") == "jax"
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -161,13 +182,13 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    out, lse_raw = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse_raw)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    if os.environ.get("MOOLIB_TPU_FLASH_BWD", "pallas") == "jax":
+    if _use_oracle_bwd():
         # Oracle path: VJP of the blockwise-jax formulation (recomputes the
         # streaming softmax in pure XLA; same FLOPs class, O(block) score
         # memory).  Kept for parity testing against the pallas kernels.
@@ -175,15 +196,54 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
             lambda q_, k_, v_: _blockwise_attention(
                 q_, k_, v_, causal, block_q, block_k
             ),
-            q,
-            k,
-            v,
+            q, k, v,
         )
         return vjp(g)
-    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret)
+    return _flash_backward(
+        q, k, v, out, lse, g, None, causal, block_q, block_k, interpret
+    )
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
+    """Like ``_flash`` but returns (out [B,Tq,H,D], lse [B,Tq,H]) with lse a
+    differentiable output: ring attention combines per-chunk results by
+    logsumexp weights, so gradients flow through it (the lse cotangent folds
+    into the backward kernels' delta term — no extra kernel).  A separate
+    custom_vjp so the plain path never materializes/consumes a zero lse
+    cotangent on the training hot path."""
+    out, lse_raw = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    B, Tq, H, D = q.shape
+    return out, lse_raw.reshape(B, H, Tq).transpose(0, 2, 1)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse_raw = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    B, Tq, H, D = q.shape
+    lse_pub = lse_raw.reshape(B, H, Tq).transpose(0, 2, 1)
+    return (out, lse_pub), (q, k, v, out, lse_raw)
+
+
+def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    if _use_oracle_bwd():
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _blockwise_attention(
+                q_, k_, v_, causal, block_q, block_k, return_lse=True
+            ),
+            q, k, v,
+        )
+        return vjp((g_out, g_lse))
+    return _flash_backward(
+        q, k, v, out, lse, g_out, g_lse, causal, block_q, block_k, interpret
+    )
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def _flash_bwd_dq_kernel(
@@ -285,8 +345,15 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
-    """Pallas flash backward: dq pass + dk/dv pass (FlashAttention-2 style)."""
+def _flash_backward(
+    q, k, v, out, lse, g, g_lse, causal, block_q, block_k, interpret
+):
+    """Pallas flash backward: dq pass + dk/dv pass (FlashAttention-2 style).
+
+    ``g_lse`` is the cotangent of the lse output ([B,Tq,H] or None): since
+    dL/ds_j = p_j((g·v_j) - (g·out) + g_lse), it folds into the delta row
+    table as ``delta - g_lse`` — the kernels are unchanged.
+    """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = D**-0.5
@@ -308,6 +375,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     ).transpose(0, 2, 1).reshape(B * H, Tq)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32).transpose(0, 2, 1).reshape(
+            B * H, Tq
+        )
 
     kwargs = dict(scale=scale, causal=causal, block_q=bq, block_k=bk)
     row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
@@ -324,7 +395,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
             row_spec,  # delta
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_shape=_out_struct((B * H, Tq, D), q.dtype, kb, qb, vb, dob, delta),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(kb, qb, vb, dob, lse, delta)
@@ -346,8 +417,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+            _out_struct((B * H, Tk, D), k.dtype, kb, qb, vb, dob, delta),
+            _out_struct((B * H, Tk, D), v.dtype, kb, qb, vb, dob, delta),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
@@ -370,6 +441,7 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    return_lse: bool = False,
 ):
     """Blockwise attention; q/k/v: [B, T, H, D] → [B, T, H, D].
 
@@ -378,6 +450,10 @@ def flash_attention(
     dk/dv pass (FlashAttention-2 style) — so the TransformerLM trains
     through on-chip kernels at long T.  ``MOOLIB_TPU_FLASH_BWD=jax``
     selects the blockwise-jax VJP oracle instead (parity testing).
+
+    ``return_lse=True`` additionally returns the per-row logsumexp
+    ([B, T, H], f32, differentiable) — the combinable form ring attention
+    uses to merge chunk results across ICI hops.
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -408,11 +484,15 @@ def flash_attention(
             "sequence length. Omit them to auto-select (or fall back to dense)."
         )
     if bad_q or bad_k:
-        from ..parallel.ring_attention import full_attention
+        from ..parallel.ring_attention import dense_attention_lse, full_attention
 
+        if return_lse:
+            return dense_attention_lse(q, k, v, causal=causal)
         return full_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if return_lse:
+        return _flash_lse(q, k, v, causal, block_q, block_k, interpret)
     return _flash(q, k, v, causal, block_q, block_k, interpret)
 
 
@@ -442,8 +522,8 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq, 128), jnp.float32),
+            _out_struct((B * H, Tq, D), q.dtype, qb, kb, vb),
+            _out_struct((B * H, Tq, 128), jnp.float32, qb, kb, vb),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),

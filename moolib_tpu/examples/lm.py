@@ -81,6 +81,13 @@ def make_flags(argv=None):
         help="circular-schedule virtual stages per pp device "
         "(--layers must equal pp_repeats * pp)",
     )
+    p.add_argument(
+        "--remat",
+        action="store_true",
+        help="checkpoint each transformer block (recompute activations in "
+        "the backward): O(1)-in-depth activation memory, ~1/3 extra FLOPs — "
+        "the lever for bigger batches at long --seq_len",
+    )
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--learning_rate", type=float, default=3e-3)
     p.add_argument("--log_interval", type=int, default=50)
@@ -153,6 +160,7 @@ def train(flags, on_stats=None) -> dict:
         attention=flags.attention,
         moe_num_experts=flags.moe_experts,
         pos_embedding=flags.pos,
+        remat=flags.remat,
     )
     rng = np.random.default_rng(flags.seed)
     tokens0 = jnp.asarray(make_batch(rng, flags))
@@ -175,6 +183,7 @@ def train(flags, on_stats=None) -> dict:
                 num_microbatches=microbatches,
                 data_axis="dp" if axes.get("dp", 1) > 1 else None,
                 circular_repeats=flags.pp_repeats,
+                remat=flags.remat,  # the pipeline rebuilds blocks itself
             )
             aux = 0.0
         elif flags.moe_experts:

@@ -110,6 +110,30 @@ def test_lm_trains_with_ring_attention_over_dp_sp_mesh():
     assert out["loss"] < 0.5, out
 
 
+def test_lm_trains_remat_ring_over_dp_sp_mesh():
+    """--remat composes with ring attention over the mesh: per-block
+    gradient checkpointing (static mesh arg through nn.remat) while the
+    recall task still trains to high accuracy."""
+    out = train(
+        make_flags(
+            [
+                "--mesh",
+                "dp=2,sp=4",
+                "--seq_len",
+                "32",
+                "--batch_size",
+                "16",
+                "--steps",
+                "150",
+                "--remat",
+                "--quiet",
+            ]
+        )
+    )
+    assert out["acc"] > 0.9, out
+    assert out["loss"] < 0.5, out
+
+
 def test_lm_trains_moe_over_dp_ep_mesh():
     """Expert parallelism end to end: SwitchMoE FFN blocks, experts sharded
     over ep, router aux loss in the objective — and the model still learns."""

@@ -110,14 +110,9 @@ def train(flags, on_stats=None) -> dict:
     apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
     if flags.seq_len % 2:
         raise ValueError("--seq_len must be even")
-    mesh = None
-    if flags.mesh:
-        axes = {}
-        for part in flags.mesh.split(","):
-            k, _, v = part.partition("=")
-            axes[k.strip()] = int(v)
-        need = int(np.prod(list(axes.values())))
-        mesh = parallel.make_mesh(axes, devices=jax.devices()[:need])
+    mesh = parallel.parse_mesh_spec(flags.mesh)
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh is not None else {}
+    if mesh is not None:
         if flags.attention == "ring":
             if "sp" not in axes:
                 raise ValueError("attention='ring' needs an sp axis in --mesh")

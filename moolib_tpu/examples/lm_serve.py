@@ -43,12 +43,23 @@ def make_model(flags):
 
 
 def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate",
-          batch_size: int = 16, total=None):
+          batch_size: int = 16, total=None, mesh=None):
     """Coroutine serving ``total`` prompts (None = forever).  Returns the
     number of *service iterations* — with concurrent callers this is smaller
-    than the prompt count, which is the point of dynamic batching."""
+    than the prompt count, which is the point of dynamic batching.
+
+    ``mesh``: serve tensor-parallel — the generate step runs sharded over
+    the mesh (params via ``parallel.auto_shardings``), so one server peer
+    can front a model larger than a single chip's HBM."""
     queue = rpc.define_queue(name, batch_size=batch_size, dynamic_batching=True)
-    jgen = jax.jit(lambda p, prompts: generate(model, p, prompts, max_new_tokens))
+    if mesh is not None:
+        # Built ONCE: the returned fn is a plain jit, so repeated batches of
+        # the same prompt shape hit the compile cache.
+        from ..models.transformer import sharded_generator
+
+        jgen = sharded_generator(model, params, max_new_tokens, mesh)
+    else:
+        jgen = jax.jit(lambda p, prompts: generate(model, p, prompts, max_new_tokens))
 
     async def loop():
         served = iterations = 0
@@ -82,6 +93,12 @@ def main(argv=None):
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--heads", type=int, default=2)
     p.add_argument("--max_new_tokens", type=int, default=16)
+    p.add_argument(
+        "--mesh",
+        default="",
+        help='serve tensor-parallel over these axes, e.g. "tp=8" '
+        "(server side only; params sharded via auto_shardings)",
+    )
     p.add_argument("--seed", type=int, default=0)
     flags = p.parse_args(argv)
     if (flags.listen is None) == (flags.connect is None):
@@ -92,6 +109,9 @@ def main(argv=None):
 
     model = make_model(flags)
     if flags.listen:
+        from .. import parallel
+
+        mesh = parallel.parse_mesh_spec(flags.mesh)
         rng = np.random.default_rng(flags.seed)
         toks = jnp.asarray(rng.integers(0, flags.vocab, (1, flags.seq_len), dtype=np.int32))
         params = model.init(jax.random.key(flags.seed), toks)
@@ -100,7 +120,7 @@ def main(argv=None):
         rpc.listen(flags.listen)
         print(f"serving 'generate' on {flags.listen}", flush=True)
         try:
-            asyncio.run(serve(rpc, model, params, flags.max_new_tokens))
+            asyncio.run(serve(rpc, model, params, flags.max_new_tokens, mesh=mesh))
         finally:
             rpc.close()
     else:

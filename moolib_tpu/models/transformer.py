@@ -332,6 +332,64 @@ def generate(
     return jnp.concatenate([prompt, new_toks.T.astype(prompt.dtype)], axis=1)
 
 
+def sharded_generator(
+    model: TransformerLM,
+    params,
+    max_new_tokens: int,
+    mesh,
+    params_sharding=None,
+    temperature: float = 0.0,
+    sample: bool = False,
+):
+    """Build a REUSABLE tensor-parallel generation function: the whole of
+    :func:`generate` (flash prefill + KV-cache decode scan) jitted once over
+    ``mesh`` with the params sharded — serving models larger than one chip's
+    HBM, with the jit cache hit on every subsequent call.
+
+    ``params_sharding`` defaults to ``parallel.auto_shardings`` (TP on the
+    last axis of big kernels + FSDP), the same tree the training step uses,
+    so a trained sharded model serves without a resharding hop.  XLA
+    propagates the sharding through the per-block KV caches (heads follow
+    the attention kernels' TP axis) and inserts the decode-time collectives.
+    Prompt and output are replicated (the batch is tiny at serve time).
+
+    Returns ``fn(params, prompt)`` (greedy) or ``fn(params, prompt, rng)``
+    when ``sample=True`` (softmax sampling at ``temperature``).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.train import auto_shardings
+
+    if params_sharding is None:
+        params_sharding = auto_shardings(params, mesh)
+    rep = NamedSharding(mesh, PartitionSpec())
+    n_rng = 1 if sample else 0
+    return jax.jit(
+        lambda p, t, *r: generate(model, p, t, max_new_tokens, temperature, *r),
+        in_shardings=(params_sharding, rep) + (rep,) * n_rng,
+        out_shardings=rep,
+    )
+
+
+def generate_sharded(
+    model: TransformerLM,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    mesh,
+    params_sharding=None,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+):
+    """One-shot form of :func:`sharded_generator` (repeated callers should
+    build the generator once and reuse it — each call here re-jits)."""
+    fn = sharded_generator(
+        model, params, max_new_tokens, mesh, params_sharding, temperature,
+        sample=rng is not None,
+    )
+    return fn(params, prompt, rng) if rng is not None else fn(params, prompt)
+
+
 def pipeline_lm_apply(
     model: TransformerLM,
     params,

@@ -12,6 +12,7 @@ from .mesh import (  # noqa: F401
     local_batch_size,
     make_mesh,
     named,
+    parse_mesh_spec,
     replicated,
     shard_batch_spec,
 )

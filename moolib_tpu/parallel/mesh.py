@@ -83,6 +83,24 @@ def make_mesh(
     return Mesh(arr, tuple(axes.keys()))
 
 
+def parse_mesh_spec(spec: str) -> Optional[Mesh]:
+    """Build a mesh from a CLI string like ``"dp=2,tp=4"`` over the first
+    prod(sizes) devices ('' → None).  The shared parser behind the example
+    agents' ``--mesh`` flags."""
+    if not spec:
+        return None
+    axes: Dict[str, int] = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        k, _, v = part.partition("=")
+        axes[k.strip()] = int(v)
+    if any(v == -1 for v in axes.values()):
+        return make_mesh(axes)  # -1 absorbs the remaining devices
+    need = math.prod(axes.values())
+    return make_mesh(axes, devices=jax.devices()[:need])
+
+
 def named(mesh: Mesh, *spec) -> NamedSharding:
     """Shorthand: ``named(mesh, "dp", None)`` → NamedSharding over P(dp, ∅)."""
     return NamedSharding(mesh, P(*spec))

@@ -208,3 +208,46 @@ def test_lm_trains_dense_single_device():
         )
     )
     assert out["acc"] > 0.9, out
+
+
+def test_tp_sharded_serving_matches_local_generate(free_port):
+    """serve(mesh=...): the dynamic-batching server runs generation
+    tensor-parallel over a tp mesh; clients see exactly the tokens of the
+    single-device path."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from moolib_tpu import parallel
+    from moolib_tpu.examples.lm_serve import make_model, serve
+    from moolib_tpu.models.transformer import generate
+    from moolib_tpu.rpc import Rpc
+
+    flags = type("F", (), dict(
+        vocab=64, d_model=64, heads=2, layers=2, seq_len=12, max_new_tokens=6,
+    ))()
+    model = make_model(flags)
+    mesh = parallel.make_mesh({"tp": 8})
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(2, 64, 12).astype(np.int32) for _ in range(3)]
+    params = model.init(jax.random.key(0), jnp.asarray(prompts[0][None]))
+
+    server = Rpc()
+    server.set_name("lm_server")
+    server.listen(f"127.0.0.1:{free_port}")
+    client = Rpc()
+    client.set_name("lm_client")
+    client.set_timeout(120)
+    client.connect(f"127.0.0.1:{free_port}")
+    try:
+        coro = serve(server, model, params, flags.max_new_tokens, total=3, mesh=mesh)
+        futs = [client.async_("lm_server", "generate", p) for p in prompts]
+        asyncio.run(asyncio.wait_for(coro, 180))
+        for p, fut in zip(prompts, futs):
+            want = generate(model, params, jnp.asarray(p[None]), flags.max_new_tokens)
+            np.testing.assert_array_equal(np.asarray(fut.result(60)), np.asarray(want)[0])
+    finally:
+        client.close()
+        server.close()

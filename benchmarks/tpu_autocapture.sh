@@ -1,8 +1,8 @@
 #!/bin/bash
 # On-chip capture battery: run once when the TPU tunnel is alive, saving
 # every artifact the round needs (VERDICT r2 asks #1-#4) under OUT.  Each
-# step is individually time-boxed so a tunnel that dies mid-battery still
-# leaves the earlier logs.
+# step is individually time-boxed, and steps are ordered by artifact value
+# so a tunnel that dies mid-battery still leaves the headline numbers.
 set -u
 OUT=${1:-/root/repo/BENCH_CAPTURE_r03}
 mkdir -p "$OUT"
@@ -16,15 +16,16 @@ run() {
   echo "[$(date +%H:%M:%S)] done  $name rc=$rc" >> "$OUT/capture.log"
 }
 
-# 1. Flash attention on-chip tests (fwd + NEW backward parity rows).
+# 1. IMPALA learner SPS (headline driver metric) — direct child mode, no
+#    probe loop: the watcher's probe just succeeded.
+run impala_bench 600 env MOOLIB_BENCH_CHILD=tpu python bench.py
+# 2. Long-context LM training: tokens/s + MFU at T in {1k,2k,4k,8k}.
+run lm_bench 1800 python benchmarks/lm_bench.py
+# 3. Flash fwd + fwd/bwd timing (pallas backward vs blockwise oracle).
+run flash_bench 1500 python benchmarks/flash_bench.py
+# 4. Flash attention on-chip tests (fwd + backward parity rows).
 run flash_tests 1200 env MOOLIB_RUN_TPU_TESTS=1 \
   python -m pytest tests/test_flash_attention_tpu.py -v
-# 2. Flash fwd + fwd/bwd timing (pallas backward vs blockwise oracle).
-run flash_bench 1500 python benchmarks/flash_bench.py
-# 3. Long-context LM training: tokens/s + MFU at T in {1k,2k,4k,8k}.
-run lm_bench 1800 python benchmarks/lm_bench.py
-# 4. IMPALA learner SPS (the headline driver metric).
-run impala_bench 900 python bench.py
 # 5. Roofline bound analysis + profiler trace for the IMPALA step.
 run impala_roofline 900 python benchmarks/impala_roofline.py \
   --trace_dir "$OUT/impala_trace"

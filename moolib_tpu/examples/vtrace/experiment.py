@@ -356,15 +356,7 @@ def train(flags, on_stats=None) -> dict:
         from ... import parallel
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        axes = {}
-        for part in flags.mesh.split(","):
-            k, _, v = part.partition("=")
-            axes[k.strip()] = int(v)
-        need = int(np.prod(list(axes.values())))
-        mesh_devices = jax.devices()[:need]
-        if len(mesh_devices) < need:
-            raise ValueError(f"--mesh {flags.mesh} needs {need} devices, have {len(jax.devices())}")
-        mesh = parallel.make_mesh(axes, devices=mesh_devices)
+        mesh = parallel.parse_mesh_spec(flags.mesh)
         if flags.batch_size % mesh.shape.get("dp", 1):
             raise ValueError("the dp mesh axis size must divide --batch_size")
         sp = mesh.shape.get("sp", 1)

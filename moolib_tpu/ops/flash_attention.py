@@ -357,15 +357,22 @@ def _flash_backward(
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = D**-0.5
-    # Backward blocks capped at 512x512: the transposed-score intermediates
-    # (st, pt, dpt — all [bk, bq] f32) plus two f32 output scratches are live
-    # at once, so the forward's 512x1024 tiles would crowd VMEM.  The cap
-    # must preserve divisibility (e.g. Tk=1280 forwards with block_k=640;
-    # a blind min() to 512 would drop the tail kv block) — re-derive the
-    # largest dividing block under the cap.  Always succeeds: any valid
-    # forward block is a multiple of 128 dividing T, so 128 divides T.
-    bq = _largest_divisor(Tq, min(block_q, 512))
-    bk = _largest_divisor(Tk, min(block_k, 512))
+    # Backward blocks capped at 512x512 (env-tunable for on-chip sweeps;
+    # read at TRACE time — the jit cache does not key on env vars, so a
+    # sweep must re-trace per value: fresh process, cleared caches, or AOT
+    # .lower().compile() while the var is set, as flash_bench does for
+    # MOOLIB_TPU_FLASH_BWD.  Values clamp up to the 128 tile minimum.):
+    # the transposed-score intermediates (st, pt, dpt — all [bk, bq] f32)
+    # plus two f32 output scratches are live at once, so the forward's
+    # 512x1024 tiles would crowd VMEM.  The cap must preserve divisibility
+    # (e.g. Tk=1280 forwards with block_k=640; a blind min() would drop the
+    # tail kv block) — re-derive the largest dividing block under the cap.
+    # Always succeeds: any valid forward block is a multiple of 128
+    # dividing T, so 128 divides T.
+    cap_q = max(128, int(os.environ.get("MOOLIB_TPU_FLASH_BWD_BLOCK_Q", 512)))
+    cap_k = max(128, int(os.environ.get("MOOLIB_TPU_FLASH_BWD_BLOCK_K", 512)))
+    bq = _largest_divisor(Tq, min(block_q, cap_q))
+    bk = _largest_divisor(Tk, min(block_k, cap_k))
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)

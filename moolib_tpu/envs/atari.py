@@ -113,8 +113,18 @@ class AtariPreprocessing:
     def _to_gray(self, frame):
         frame = np.asarray(frame)
         if frame.ndim == 3 and frame.shape[-1] == 3:
-            # ITU-R 601 luminance, same as cv2.COLOR_RGB2GRAY.
-            frame = (frame @ np.array([0.299, 0.587, 0.114])).astype(np.uint8)
+            try:
+                import cv2
+
+                return cv2.cvtColor(frame, cv2.COLOR_RGB2GRAY)
+            except ImportError:
+                # ITU-R 601 luminance, ROUNDED (truncation would map (v,v,v)
+                # to v-1 where the float sum lands just under v); cv2's
+                # fixed-point rounding can still differ by 1 LSB on general
+                # RGB, so prefer cv2 when present.
+                frame = np.round(
+                    frame @ np.array([0.299, 0.587, 0.114])
+                ).astype(np.uint8)
         return frame
 
     def _process(self, frame, prev_frame=None):

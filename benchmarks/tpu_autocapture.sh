@@ -29,4 +29,7 @@ run flash_tests 1200 env MOOLIB_RUN_TPU_TESTS=1 \
 # 5. Roofline bound analysis + profiler trace for the IMPALA step.
 run impala_roofline 900 python benchmarks/impala_roofline.py \
   --trace_dir "$OUT/impala_trace"
+# 6. Fold results into BENCH_TPU.json so bench.py's last_good_tpu picks
+#    them up even if nobody is around when the battery fires.
+run fold_capture 120 python benchmarks/fold_capture.py "$OUT" /root/repo/BENCH_TPU.json
 echo "[$(date +%H:%M:%S)] battery complete" >> "$OUT/capture.log"

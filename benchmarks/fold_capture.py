@@ -71,16 +71,23 @@ def parse_roofline(path):
 
 
 def main():
-    cap = sys.argv[1] if len(sys.argv) > 1 else "/root/repo/BENCH_CAPTURE_r03"
+    if len(sys.argv) < 2:
+        # Required: defaulting to a round-suffixed dir would silently re-fold
+        # stale artifacts after the round advances (the battery always passes
+        # its own OUT).
+        raise SystemExit("usage: fold_capture.py <capture_dir> [bench_tpu_json]")
+    cap = sys.argv[1]
     out_path = (
         sys.argv[2]
         if len(sys.argv) > 2
-        else os.path.join(os.path.dirname(cap), "BENCH_TPU.json")
+        else os.path.join(os.path.dirname(cap.rstrip("/")), "BENCH_TPU.json")
     )
-    try:
+    if os.path.exists(out_path):
+        # A corrupt record must ABORT, not be clobbered with {} — it holds
+        # curated history bench.py republishes as last_good_tpu.
         with open(out_path) as f:
             data = json.load(f)
-    except (OSError, json.JSONDecodeError):
+    else:
         data = {}
 
     today = datetime.date.today().isoformat()
@@ -119,9 +126,11 @@ def main():
         "auto-folded from the tpu_autocapture battery "
         f"({cap}); sections updated: {', '.join(updated)}"
     )
-    with open(out_path, "w") as f:
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(data, f, indent=2)
         f.write("\n")
+    os.replace(tmp, out_path)  # atomic: a killed fold can't truncate the record
     print(f"fold_capture: updated {out_path}: {', '.join(updated)}")
 
 

@@ -43,6 +43,14 @@ def make_flags(argv=None):
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--heads", type=int, default=2)
     p.add_argument(
+        "--kv_heads",
+        type=int,
+        default=0,
+        help="grouped-query attention: KV heads shared by groups of "
+        "heads/kv_heads query heads (0 = heads, plain MHA); shrinks the "
+        "generation KV cache by the group factor",
+    )
+    p.add_argument(
         "--attention",
         default="ring",
         choices=["dense", "flash", "ring"],
@@ -156,6 +164,7 @@ def train(flags, on_stats=None) -> dict:
         moe_num_experts=flags.moe_experts,
         pos_embedding=flags.pos,
         remat=flags.remat,
+        num_kv_heads=flags.kv_heads or None,
     )
     rng = np.random.default_rng(flags.seed)
     tokens0 = jnp.asarray(make_batch(rng, flags))

@@ -64,26 +64,38 @@ class Block(nn.Module):
     decode: bool = False  # single-token steps against a KV cache (generation)
     max_len: int = 8192  # cache capacity in decode mode
     collect_kv: bool = False  # sow K/V into a "kv" collection (prefill)
+    num_kv_heads: Optional[int] = None  # GQA: KV heads < query heads
 
     @nn.compact
     def __call__(self, x, mesh=None):
         B, T, D = x.shape
         H = self.num_heads
         hd = D // H
+        # Grouped-query attention (Ainslie et al. 2023): Hk KV heads are
+        # shared by groups of H/Hk query heads — the KV cache (the HBM
+        # bottleneck at serve time) shrinks by that factor.  Hk == H is
+        # exactly multi-head attention (identical params and math).
+        Hk = self.num_kv_heads or H
+        if H % Hk:
+            raise ValueError(f"num_heads={H} must be divisible by num_kv_heads={Hk}")
+        group = H // Hk
         y = nn.LayerNorm(dtype=jnp.float32)(x)
-        qkv = nn.Dense(3 * D, dtype=self.dtype, name="qkv")(y)
-        q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, hd), 3, axis=2)
+        qkv = nn.Dense((H + 2 * Hk) * hd, dtype=self.dtype, name="qkv")(y)
+        qkv = qkv.reshape(B, T, H + 2 * Hk, hd)
+        q, k, v = qkv[:, :, :H], qkv[:, :, H : H + Hk], qkv[:, :, H + Hk :]
 
         if self.decode:
             # Autoregressive step: x is [B, 1, D]; append this position's
             # K/V to the cache and attend over everything cached so far.
+            # The cache holds Hk heads; query heads address their group's
+            # KV head through a grouped einsum — no repeat materializes.
             if T != 1:
                 raise ValueError(f"decode mode steps one token at a time, got T={T}")
             ck = self.variable(
-                "cache", "k", jnp.zeros, (B, self.max_len, H, hd), self.dtype
+                "cache", "k", jnp.zeros, (B, self.max_len, Hk, hd), self.dtype
             )
             cv = self.variable(
-                "cache", "v", jnp.zeros, (B, self.max_len, H, hd), self.dtype
+                "cache", "v", jnp.zeros, (B, self.max_len, Hk, hd), self.dtype
             )
             idx = self.variable(
                 "cache", "idx", lambda: jnp.zeros((), jnp.int32)
@@ -100,27 +112,35 @@ class Block(nn.Module):
             )
             idx.value = t + 1
             scale = hd**-0.5
+            qg = q.reshape(B, T, Hk, group, hd)
             scores = (
                 jnp.einsum(
-                    "bqhd,bkhd->bhqk",
-                    q.astype(jnp.float32),
+                    "bqhgd,bkhd->bhgqk",
+                    qg.astype(jnp.float32),
                     ck.value.astype(jnp.float32),
                 )
                 * scale
             )
-            mask = jnp.arange(self.max_len)[None, None, None, :] <= t
+            mask = jnp.arange(self.max_len)[None, None, None, None, :] <= t
             scores = jnp.where(mask, scores, -1e30)
             p_att = jax.nn.softmax(scores, axis=-1)
             att = jnp.einsum(
-                "bhqk,bkhd->bqhd", p_att, cv.value.astype(jnp.float32)
-            ).astype(x.dtype)
+                "bhgqk,bkhd->bqhgd", p_att, cv.value.astype(jnp.float32)
+            ).reshape(B, T, H, hd).astype(x.dtype)
         else:
             if self.rotary:
                 q, k = apply_rotary(q), apply_rotary(k)
             if self.collect_kv:
-                # One-pass prefill: generate() reads these to seed the cache.
+                # One-pass prefill: generate() reads these to seed the cache
+                # (unrepeated — the cache stays Hk heads).
                 self.sow("kv", "k", k.astype(self.dtype))
                 self.sow("kv", "v", v.astype(self.dtype))
+            if group > 1:
+                # Training/prefill path: the attention kernels take equal
+                # head counts — repeat KV across each group (transient; the
+                # cache and the params stay at Hk heads).
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             if self.attention == "ring":
                 from ..parallel.ring_attention import ring_attention
 
@@ -164,6 +184,7 @@ class TransformerLM(nn.Module):
     vocab_size: int
     d_model: int = 256
     num_heads: int = 4
+    num_kv_heads: Optional[int] = None  # GQA (None = num_heads: plain MHA)
     num_layers: int = 4
     max_len: int = 8192
     attention: str = "flash"  # dense | flash | ring
@@ -220,6 +241,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode,
                 max_len=self.max_len,
                 collect_kv=self.collect_kv,
+                num_kv_heads=self.num_kv_heads,
                 name=f"block{i}",
             )(x, mesh)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
@@ -263,6 +285,7 @@ def generate(
         vocab_size=model.vocab_size,
         d_model=model.d_model,
         num_heads=model.num_heads,
+        num_kv_heads=model.num_kv_heads,
         num_layers=model.num_layers,
         max_len=model.max_len,
         attention="dense",  # unused in decode steps (cached attention)
@@ -288,6 +311,7 @@ def generate(
         vocab_size=model.vocab_size,
         d_model=model.d_model,
         num_heads=model.num_heads,
+        num_kv_heads=model.num_kv_heads,
         num_layers=model.num_layers,
         max_len=model.max_len,
         # Prefill rides the model's own attention kind, so long prompts go
@@ -442,6 +466,7 @@ def pipeline_lm_apply(
     block = Block(
         model.d_model, model.num_heads, model.attention, model.dtype,
         rotary=model.pos_embedding == "rotary",
+        num_kv_heads=model.num_kv_heads,
     )
     stage_params = jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *(p[f"block{i}"] for i in range(L))

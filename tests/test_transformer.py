@@ -439,3 +439,21 @@ def test_gqa_through_pipeline_matches_direct_apply():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(direct), rtol=2e-4, atol=2e-4
     )
+
+
+def test_generate_sharded_composes_with_gqa():
+    """TP-sharded generation of a GQA model: the qkv projection shards over
+    tp while the KV cache keeps num_kv_heads heads; tokens must still match
+    the single-device path exactly."""
+    from moolib_tpu.models.transformer import generate, generate_sharded
+
+    mesh = parallel.make_mesh({"tp": 8})
+    model = TransformerLM(
+        vocab_size=64, d_model=64, num_heads=4, num_kv_heads=2,
+        num_layers=2, max_len=64, attention="dense", dtype=jnp.float32,
+    )
+    prompt = jax.random.randint(jax.random.key(0), (2, 16), 2, 64)
+    params = model.init(jax.random.key(1), prompt)
+    want = generate(model, params, prompt, 8)
+    got = generate_sharded(model, params, prompt, 8, mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -82,10 +82,16 @@ class FrameSim:
         return False
 
 
-@pytest.fixture()
-def pair(free_port, monkeypatch):
-    """host/client Rpc pair over loopback with a counted echo handler."""
-    monkeypatch.setenv("MOOLIB_TPU_NATIVE_TRANSPORT", "0")
+@pytest.fixture(params=["asyncio", "native"])
+def pair(free_port, monkeypatch, request):
+    """host/client Rpc pair over loopback with a counted echo handler.
+
+    Parametrized over both IO backends: the faults inject at the shared
+    ``send_frame`` seam, so the reliability invariants are pinned over the
+    C++ epoll engine as well as the asyncio fallback."""
+    monkeypatch.setenv(
+        "MOOLIB_TPU_NATIVE_TRANSPORT", "0" if request.param == "asyncio" else "1"
+    )
     host, client = Rpc(), Rpc()
     host.set_name("host")
     client.set_name("client")

@@ -44,8 +44,9 @@ def main():
     D = int(os.environ.get("MOOLIB_LM_DMODEL", 1024))
     L = int(os.environ.get("MOOLIB_LM_LAYERS", 12))
     H = max(4, D // 128)
+    KV = int(os.environ.get("MOOLIB_LM_KV_HEADS", 0)) or None  # GQA sweeps
     print(f"# backend={jax.default_backend()} device={dev.device_kind} "
-          f"d_model={D} layers={L}")
+          f"d_model={D} layers={L} kv_heads={KV or H}")
     print(f"{'T':>6} {'B':>3} {'remat':>5} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
 
     rows = []
@@ -56,8 +57,9 @@ def main():
         (4096, 8, True), (8192, 2, False), (8192, 4, True),
     ):
         model = TransformerLM(
-            vocab_size=32768, d_model=D, num_heads=H, num_layers=L,
-            max_len=8192, attention="flash", dtype=jnp.bfloat16, remat=remat,
+            vocab_size=32768, d_model=D, num_heads=H, num_kv_heads=KV,
+            num_layers=L, max_len=8192, attention="flash",
+            dtype=jnp.bfloat16, remat=remat,
         )
         rng = np.random.default_rng(T)
         toks = jnp.asarray(rng.integers(0, 32768, size=(B, T), dtype=np.int32))
@@ -118,7 +120,8 @@ def main():
             {"T": T, "B": B, "remat": remat, "step_ms": round(sec * 1e3, 2),
              "tokens_per_s": round(tokens_s, 1), "mfu_6nd": round(mfu, 4)}
         )
-    print(json.dumps({"lm_train": {"d_model": D, "layers": L, "rows": rows}}))
+    print(json.dumps({"lm_train": {
+        "d_model": D, "layers": L, "kv_heads": KV or H, "rows": rows}}))
 
 
 if __name__ == "__main__":

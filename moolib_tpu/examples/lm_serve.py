@@ -34,6 +34,7 @@ def make_model(flags):
         vocab_size=flags.vocab,
         d_model=flags.d_model,
         num_heads=flags.heads,
+        num_kv_heads=getattr(flags, "kv_heads", 0) or None,
         num_layers=flags.layers,
         attention="dense",
         dtype=jnp.float32,
@@ -92,6 +93,11 @@ def main(argv=None):
     p.add_argument("--d_model", type=int, default=64)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--heads", type=int, default=2)
+    p.add_argument(
+        "--kv_heads", type=int, default=0,
+        help="grouped-query attention (0 = heads): shrinks the decode "
+        "KV cache by heads/kv_heads",
+    )
     p.add_argument("--max_new_tokens", type=int, default=16)
     p.add_argument(
         "--mesh",

@@ -41,7 +41,9 @@ def bench_rpc(args):
         for i in range(world_size):
             rpc = Rpc()
             rpc.set_name(f"rank{i}")
-            rpc.listen("127.0.0.1:0")
+            # Bare ":0" listens on TCP *and* an auto unix socket, so same-host
+            # peers discover the ipc listener and big frames ride memfd.
+            rpc.listen(":0")
             rpc.connect(broker_addr)
             g = Group(rpc, "bench")
             g.set_timeout(60)
@@ -60,24 +62,42 @@ def bench_rpc(args):
         time.sleep(0.01)
     assert all(g.active() for g in groups), "cohort never converged"
 
-    print(f"# rpc tree allreduce, {world_size} peers, loopback")
-    print(f"{'elems':>10} {'MB':>8} {'ms':>9} {'MB/s':>10}")
-    for size in args.sizes:
-        data = [np.random.randn(size).astype(np.float32) for _ in range(world_size)]
-        # Warmup round.
-        futs = [g.all_reduce("w", d) for g, d in zip(groups, data)]
+    def wait(futs):
+        # Throttled pumping: the IO engines and reduce math run on their own
+        # threads; a busy pump() loop would starve them of the core.
         while not all(f.done() for f in futs):
             pump()
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            futs = [g.all_reduce("x", d) for g, d in zip(groups, data)]
-            while not all(f.done() for f in futs):
-                pump()
-            for f in futs:
-                f.result(0)
-        dt = (time.perf_counter() - t0) / args.iters
-        mb = size * 4 / 1e6
-        print(f"{size:>10} {mb:>8.2f} {dt*1e3:>9.2f} {mb/dt:>10.1f}")
+            time.sleep(0.002)
+
+    def run_rows(algo: str, threshold: str):
+        os.environ["MOOLIB_RING_THRESHOLD"] = threshold
+        print(
+            f"# rpc {algo} allreduce, {world_size} peers, loopback "
+            f"(max_peer_tx = busiest peer's wire bytes per op; the ring "
+            f"spreads load evenly, the tree root serializes ~2x payloads)"
+        )
+        print(f"{'elems':>10} {'MB':>8} {'ms':>9} {'MB/s':>10} {'max_peer_tx_MB':>15}")
+        for size in args.sizes:
+            data = [np.random.randn(size).astype(np.float32) for _ in range(world_size)]
+            futs = [g.all_reduce("w" + algo, d) for g, d in zip(groups, data)]
+            wait(futs)  # warmup round
+            before = [rpc.transport_stats()["tx_bytes"] for rpc, _ in peers]
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                futs = [g.all_reduce("x" + algo, d) for g, d in zip(groups, data)]
+                wait(futs)
+                for f in futs:
+                    f.result(0)
+            dt = (time.perf_counter() - t0) / args.iters
+            after = [rpc.transport_stats()["tx_bytes"] for rpc, _ in peers]
+            max_tx = max(a - b for a, b in zip(after, before)) / args.iters / 1e6
+            mb = size * 4 / 1e6
+            print(
+                f"{size:>10} {mb:>8.2f} {dt*1e3:>9.2f} {mb/dt:>10.1f} {max_tx:>15.2f}"
+            )
+
+    run_rows("tree", "99999999999999")
+    run_rows("ring", "0")
     for rpc, _ in peers:
         rpc.close()
     broker.close()

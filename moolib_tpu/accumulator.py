@@ -145,6 +145,11 @@ class Accumulator:
         self._wire_dtype = None  # e.g. jnp.bfloat16: halves allreduce bytes
         self._wire_q8 = False  # int8 + error feedback (4x compression)
         self._q_residual = None  # EF residual carried between rounds
+        # Chunked ring allreduce for the big gradient payload (None = auto by
+        # model size vs MOOLIB_RING_THRESHOLD). The choice must be identical
+        # cohort-wide: it is derived from config + the synced model only.
+        self._chunked_allreduce: Optional[bool] = None
+        self._ring_size_cache: Optional[int] = None
         # In-flight reduction rounds, oldest first.  With
         # set_parallel_gradients(n) up to n rounds overlap; results are
         # applied strictly in issue order — the Group sequences same-name ops
@@ -266,6 +271,46 @@ class Accumulator:
             self._wire_q8 = False
         self._q_residual = None
 
+    def set_chunked_allreduce(self, enabled: Optional[bool]) -> None:
+        """Route the big gradient allreduce over the Group's chunked ring
+        (reduce-scatter + all-gather) instead of the binary tree.
+
+        ``None`` (default) auto-enables once the f32 gradient payload exceeds
+        ``MOOLIB_RING_THRESHOLD`` bytes (1 MiB default).  The ring spreads
+        wire bytes evenly across the cohort (``2(n-1)/n`` payloads per peer vs
+        the tree root's 2) and pipelines chunks, which is what large models
+        need on DCN.  Must be configured identically on every peer.  Note:
+        with ``int8`` wire compression the ring quantizes per chunk per hop
+        (no error-feedback residual — EF is a per-contributor concept that
+        does not compose with re-quantizing partial sums mid-ring).
+        """
+        self._chunked_allreduce = enabled
+
+    def _use_ring_locked(self) -> bool:
+        if self._chunked_allreduce is not None:
+            return self._chunked_allreduce
+        from .group import _ring_threshold
+
+        if self._ring_size_cache is None:
+            leaves = jax.tree_util.tree_leaves(self._params)
+            self._ring_size_cache = sum(int(l.size) for l in leaves) * 4
+        return self._ring_size_cache >= _ring_threshold()
+
+    def _ring_wire_locked(self):
+        if self._wire_q8:
+            return "q8"
+        if self._wire_dtype is not None:
+            return np.dtype(self._wire_dtype).name
+        return None
+
+    def _ring_template_locked(self):
+        """Shape/dtype template for a skip (None) ring contribution: the
+        gradient tree matches the parameter tree by construction.  Broadcast
+        views cost no memory — the ring only reads shapes off a template."""
+        return jax.tree_util.tree_map(
+            lambda p: np.broadcast_to(np.float32(0.0), p.shape), self._params
+        )
+
     def set_ici_backend(self, enabled: bool = True) -> None:
         """Reduce gradients with an XLA collective over the device mesh (ICI
         data plane) instead of the RPC tree (DCN), when the cohort spans
@@ -384,6 +429,17 @@ class Accumulator:
             )
             self._start_round("count", stats, local)
             return
+        if self._use_ring_locked():
+            # Ring path: contribute f32; compression (if any) happens per
+            # chunk per hop inside the ring codec.
+            self._grad_dtypes = jax.tree_util.tree_map(
+                lambda g: np.asarray(g).dtype, gradients
+            )
+            gradients = jax.tree_util.tree_map(
+                lambda g: np.asarray(g, np.float32), gradients
+            )
+            self._start_round("ring_full", stats, gradients)
+            return
         if self._wire_dtype is not None:
             self._grad_dtypes = jax.tree_util.tree_map(
                 lambda g: np.asarray(g).dtype, gradients
@@ -409,7 +465,18 @@ class Accumulator:
             )
             self._ici_round(stats, zeros)
             return
-        kind = "count" if self._virtual_batch_size is not None else "full"
+        if self._virtual_batch_size is not None:
+            kind = "count"
+        elif self._use_ring_locked():
+            kind = "ring_full"
+            if self._grad_dtypes is None:
+                # Ring results come back f32; restore to the param dtypes
+                # (gradient trees match the param tree by construction).
+                self._grad_dtypes = jax.tree_util.tree_map(
+                    lambda p: np.dtype(p.dtype), self._params
+                )
+        else:
+            kind = "full"
         self._start_round(kind, stats, None)
 
     def _start_round(self, kind: str, stats: Dict[str, int], gradients):
@@ -436,6 +503,23 @@ class Accumulator:
                     f"__accum_count:{self._name}", dict(stats), op=_count_reduce_op
                 )
                 round_ = _Round(fut, kind="count", local=gradients)
+            elif kind == "ring_full":
+                fut = self._group.all_reduce(
+                    f"__accum_grad:{self._name}",
+                    gradients,
+                    op="sum",
+                    meta=dict(stats),
+                    meta_op=_count_reduce_op,
+                    wire=self._ring_wire_locked(),
+                    chunked=True,
+                    template=None if gradients is not None else self._ring_template_locked(),
+                )
+                round_ = _Round(fut, kind="full")
+                if gradients is not None:
+                    self._reduce_bytes["rpc"] += _tree_nbytes(gradients)
+                self._inflight.append(round_)
+                fut.add_done_callback(lambda f, r=round_: self._on_ring_round_done(r, f))
+                return
             else:
                 payload = {
                     "grads": gradients,
@@ -607,6 +691,29 @@ class Accumulator:
         peer reaches this decision at the same count-round index (the count
         results are identical cohort-wide), so the op sequence matches."""
         grads = self._fire_accum
+        if self._use_ring_locked():
+            # Phase 2 over the chunked ring: the accumulated f32 sum ships
+            # directly; counts were settled in phase 1 so the meta rides as
+            # zeros (every peer sends the same — protocol uniformity).
+            zero = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+            fut = self._group.all_reduce(
+                f"__accum_grad:{self._name}",
+                grads,
+                op="sum",
+                meta=dict(zero),
+                meta_op=_count_reduce_op,
+                wire=self._ring_wire_locked(),
+                chunked=True,
+                template=None if grads is not None else self._ring_template_locked(),
+            )
+            round_ = _Round(fut, kind="grad", stats=dict(self._fire_stats))
+            if grads is not None:
+                self._reduce_bytes["rpc"] += _tree_nbytes(grads)
+            self._fire_accum = None
+            self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+            self._inflight.append(round_)
+            fut.add_done_callback(lambda f, r=round_: self._on_ring_round_done(r, f))
+            return
         wire_name = np.dtype(self._wire_dtype).name if self._wire_dtype is not None else None
         if grads is not None:
             if self._wire_q8:
@@ -641,6 +748,21 @@ class Accumulator:
             round_.error = fut.exception()
             if round_.error is None:
                 round_.result = fut.result(0)
+            self._drain_rounds_locked()
+
+    def _on_ring_round_done(self, round_, fut):
+        """Adapter: a ring round resolves to ``(grads_f32, meta)``; normalize
+        into the tree payload-dict shape so the drain logic stays single."""
+        err = fut.exception()
+        norm = None
+        if err is None:
+            value, meta = fut.result(0)
+            norm = {"grads": value, "wire": None}
+            norm.update(meta)
+        with self._lock:
+            round_.done = True
+            round_.error = err
+            round_.result = norm
             self._drain_rounds_locked()
 
     def _drain_rounds_locked(self):

@@ -18,6 +18,7 @@ Accumulator's mesh backend.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -38,6 +39,62 @@ _OPS: Dict[str, Callable] = {
 
 def _is_arr(x) -> bool:
     return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _ring_threshold() -> int:
+    """Payload size (bytes) above which ``all_reduce`` auto-selects the
+    chunked ring path.  Read per call so tests can force it; MUST be set
+    identically on every peer (path choice is part of the op's protocol)."""
+    return int(os.environ.get("MOOLIB_RING_THRESHOLD", 1 << 20))
+
+
+def _ring_codec(wire):
+    """(encode, decode, acc_cast) for per-hop ring wire compression.
+
+    ``encode`` maps an accumulation-dtype chunk to its wire form before every
+    hop; ``decode`` maps a wire object back to the accumulation dtype;
+    ``acc_cast`` lifts a local contribution into the accumulation dtype.
+    With a wire dtype set, partial sums accumulate in float32 and are
+    re-rounded once per hop — the same contract as the tree's ``finalize``
+    (see ``accumulator._wire_finalize``).  ``wire="q8"`` is symmetric int8
+    with one scale per chunk (the per-tensor scheme of the accumulator's
+    q8 path, applied at chunk granularity).
+    """
+    if wire is None:
+        ident = lambda a: a  # noqa: E731
+        return ident, ident, ident
+    if wire == "q8":
+
+        def enc(a):
+            a = np.asarray(a, np.float32)
+            amax = float(np.max(np.abs(a))) if a.size else 0.0
+            if amax == 0.0:
+                return {"q8": np.zeros(a.shape, np.int8), "s": 0.0}
+            scale = amax / 127.0
+            return {"q8": np.round(a / scale).astype(np.int8), "s": scale}
+
+        def dec(obj):
+            return obj["q8"].astype(np.float32) * obj["s"]
+
+        return enc, dec, lambda a: np.asarray(a, np.float32)
+    wd = np.dtype(wire)
+    return (
+        lambda a: np.asarray(a).astype(wd),
+        lambda a: np.asarray(a).astype(np.float32),
+        lambda a: np.asarray(a, np.float32),
+    )
+
+
+def _ring_nbytes(value) -> int:
+    """Payload bytes if ring-eligible (all-array pytree, one dtype), else -1."""
+    leaves = list(nest.flatten(value))
+    if not leaves or not all(_is_arr(l) for l in leaves):
+        return -1
+    dtypes = {np.dtype(l.dtype) for l in leaves}
+    if len(dtypes) != 1:
+        return -1
+    itemsize = dtypes.pop().itemsize
+    return sum(int(l.size) for l in leaves) * itemsize
 
 
 def _resolve_op(op) -> Callable:
@@ -69,6 +126,200 @@ class _Op:
         self.started_at = time.monotonic()
 
 
+class _RingOp:
+    """State of one chunked ring allreduce (reduce-scatter + all-gather).
+
+    Bandwidth-optimal counterpart of the reference's benchmark-only chunked
+    ring (``test/test_multinode_allreduce.cc:16-150``), made a first-class
+    epoch-keyed Group op: each of the N members sends ``2*(N-1)/N`` of the
+    payload instead of the tree's full payload per hop (and the tree root's
+    ``2x`` full payloads), so serialization cost is spread evenly across the
+    cohort and chunks pipeline across ring steps.
+
+    Protocol (rank r, ring next = (r+1) % n, chunks split near-equally):
+      - reduce-scatter step s in [0, n-2]: send chunk ``(r - s) % n``
+        (local contribution at s=0, accumulated partial after), receive
+        chunk ``(r - 1 - s) % n`` and fold in the local contribution.
+        After the last step, rank r owns the fully reduced chunk
+        ``(r + 1) % n`` plus the fully combined ``meta``.
+      - all-gather step s in [0, n-2]: send the completed chunk
+        ``(r + 1 - s) % n``; receive ``(r - s) % n`` and forward its wire
+        bytes unchanged (every rank decodes identical bytes, so wire
+        compression stays bit-consistent cohort-wide).
+
+    ``local[c] is None`` marks a zero (skip) contribution: markers forward
+    without materializing zero payloads, so an all-skip round costs ~nothing
+    on the wire (sum only).  Out-of-order frames park in ``pending`` keyed by
+    (phase, step); steps are processed strictly in order per phase.
+    """
+
+    __slots__ = (
+        "key", "future", "started_at", "members", "rank", "n", "local",
+        "chunk_sizes", "dtype", "template", "leaf_shapes", "has_value",
+        "enc", "dec", "acc_cast", "leaf_op", "op_name", "meta", "has_meta", "meta_op",
+        "meta_total", "rs_next", "ag_next", "pending", "final", "done_chunks",
+        "pumping", "repump", "sent_initial",
+    )
+
+    def __init__(self, key, value, op_name, future, members, rank, wire,
+                 meta, meta_op, template):
+        self.key = key
+        self.future = future
+        self.started_at = time.monotonic()
+        self.members = members
+        self.rank = rank
+        self.n = len(members)
+        self.enc, self.dec, self.acc_cast = _ring_codec(wire)
+        self.leaf_op = _OPS[op_name]
+        self.op_name = op_name
+        self.meta = meta
+        self.has_meta = meta is not None
+        self.meta_op = meta_op
+        self.meta_total = None
+        self.rs_next = 0
+        self.ag_next = 0
+        self.pending: Dict[Tuple[str, int], Tuple] = {}
+        self.final: List[Any] = [None] * self.n
+        self.done_chunks = 0
+        self.pumping = False
+        self.repump = False
+        self.sent_initial = False
+
+        self.has_value = value is not None
+        shape_src = value if value is not None else template
+        if shape_src is None:
+            raise RpcError("ring allreduce with value=None requires template=")
+        leaves = [np.asarray(l) for l in nest.flatten(shape_src)]
+        if not leaves:
+            raise RpcError("ring allreduce needs at least one array leaf")
+        dtypes = {l.dtype for l in leaves}
+        if len(dtypes) != 1:
+            raise RpcError(f"ring allreduce needs one uniform dtype, got {dtypes}")
+        self.dtype = leaves[0].dtype
+        self.template = shape_src
+        self.leaf_shapes = [l.shape for l in leaves]
+        total = sum(l.size for l in leaves)
+        base, rem = divmod(total, self.n)
+        self.chunk_sizes = [base + (1 if c < rem else 0) for c in range(self.n)]
+        if value is not None:
+            flat = np.concatenate([l.ravel() for l in leaves]) if len(leaves) > 1 \
+                else leaves[0].ravel()
+            self.local = []
+            off = 0
+            for sz in self.chunk_sizes:
+                self.local.append(self.acc_cast(flat[off:off + sz]))
+                off += sz
+        else:
+            self.local = [None] * self.n
+
+    # -- pure state transitions (call under the group lock) -----------------
+    def drain(self):
+        """Process every ready pending frame; return deferred actions
+        (sends / completion) for the caller to perform outside the lock."""
+        actions: List[Tuple] = []
+        if not self.sent_initial:
+            self.sent_initial = True
+            c = self.rank
+            data = None if self.local[c] is None else self.enc(self.local[c])
+            actions.append(("send", "rs", 0, c, data, self.meta))
+        progressed = True
+        while progressed:
+            progressed = False
+            if self.rs_next <= self.n - 2 and ("rs", self.rs_next) in self.pending:
+                actions.extend(self._rs_step(*self.pending.pop(("rs", self.rs_next))))
+                progressed = True
+            if self.ag_next <= self.n - 2 and ("ag", self.ag_next) in self.pending:
+                actions.extend(self._ag_step(*self.pending.pop(("ag", self.ag_next))))
+                progressed = True
+        if self.done_chunks == self.n:
+            actions.append(("done",))
+        return actions
+
+    def _combine(self, incoming, c):
+        mine = self.local[c]
+        if incoming is None:
+            return mine
+        if mine is None:
+            return incoming
+        if (
+            self.op_name == "sum"
+            and isinstance(incoming, np.ndarray)
+            and incoming.flags.writeable
+            and incoming.dtype == np.asarray(mine).dtype
+        ):
+            # The decoded chunk is ours alone — accumulate in place instead
+            # of allocating a fresh array every hop.
+            np.add(incoming, mine, out=incoming)
+            return incoming
+        return self.leaf_op(incoming, mine)
+
+    def _rs_step(self, chunk_idx, data, meta_in):
+        s = self.rs_next
+        self.rs_next += 1
+        c = (self.rank - 1 - s) % self.n
+        if chunk_idx != c:
+            raise RpcError(
+                f"ring protocol error: got chunk {chunk_idx} at rs step {s}, "
+                f"expected {c} (peers disagree on membership?)")
+        incoming = None if data is None else self.dec(data)
+        if incoming is not None and incoming.size != self.chunk_sizes[c]:
+            raise RpcError(
+                f"ring chunk size mismatch ({incoming.size} != "
+                f"{self.chunk_sizes[c]}): peers contributed different shapes")
+        combined = self._combine(incoming, c)
+        meta_acc = meta_in
+        if self.has_meta:
+            meta_acc = self.meta_op(meta_in, self.meta)
+        if s == self.n - 2:
+            # Chunk c is fully reduced; this rank owns it. Round-trip the
+            # wire encoding so every rank decodes identical bytes.
+            encoded = None if combined is None else self.enc(combined)
+            self.final[c] = None if encoded is None else self.dec(encoded)
+            self.meta_total = meta_acc
+            self.done_chunks += 1
+            return [("send", "ag", 0, c, encoded, meta_acc)]
+        encoded = None if combined is None else self.enc(combined)
+        return [("send", "rs", s + 1, c, encoded, meta_acc)]
+
+    def _ag_step(self, chunk_idx, data, meta_total):
+        s = self.ag_next
+        self.ag_next += 1
+        c = (self.rank - s) % self.n
+        if chunk_idx != c:
+            raise RpcError(
+                f"ring protocol error: got chunk {chunk_idx} at ag step {s}, "
+                f"expected {c}")
+        self.final[c] = None if data is None else self.dec(data)
+        if self.meta_total is None:
+            self.meta_total = meta_total
+        self.done_chunks += 1
+        if s < self.n - 2:
+            return [("send", "ag", s + 1, c, data, meta_total)]
+        return []
+
+    def assemble(self):
+        """Reassemble the reduced pytree from final chunks (outside lock)."""
+        if all(f is None for f in self.final):
+            value = None
+        else:
+            parts = []
+            for c, f in enumerate(self.final):
+                if f is None:
+                    parts.append(np.zeros(self.chunk_sizes[c], self.dtype))
+                else:
+                    parts.append(np.asarray(f).astype(self.dtype, copy=False))
+            flat = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            leaves, off = [], 0
+            for shape in self.leaf_shapes:
+                size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                leaves.append(flat[off:off + size].reshape(shape))
+                off += size
+            value = nest.pack_as(self.template, leaves)
+        if self.has_meta:
+            return value, self.meta_total
+        return value
+
+
 class Group:
     """A group of Rpc peers allowing coordinated AllReduce (reference API:
     update/set_broker_name/set_timeout/set_sort_order/members/sync_id/name/
@@ -87,8 +338,9 @@ class Group:
         self._ping_interval = 1.0
         self._ping_inflight = False
         self._stale_since: Optional[float] = None
-        self._ops: Dict[Tuple, _Op] = {}
+        self._ops: Dict[Tuple, Any] = {}  # key -> _Op | _RingOp
         self._parked: Dict[Tuple, List[Any]] = {}
+        self._ring_parked: Dict[Tuple, List[Tuple]] = {}
         self._seq: Dict[Tuple, int] = {}  # (sync_id, op name) -> next seq
         self._recv_seq: Dict[Tuple, int] = {}
         self._on_change_callbacks: List[Callable] = []
@@ -116,6 +368,7 @@ class Group:
             rpc.define("__group_update", dispatch(Group._on_update))
             rpc.define("__group_reduce", dispatch(Group._on_reduce))
             rpc.define("__group_share", dispatch(Group._on_share))
+            rpc.define("__group_ring", dispatch(Group._on_ring))
         if self._name in registry:
             raise RpcError(f"group {self._name!r} already exists on this Rpc")
         registry[self._name] = self
@@ -219,6 +472,7 @@ class Group:
             # (reference cancels with "group change", src/group.h:453-460).
             ops, self._ops = list(self._ops.values()), {}
             self._parked.clear()
+            self._ring_parked.clear()
             self._seq.clear()
             self._recv_seq.clear()
         for op in ops:
@@ -244,7 +498,9 @@ class Group:
         return idx, parent, children
 
     # -------------------------------------------------------------- allreduce
-    def all_reduce(self, name: str, value, op="sum", finalize=None) -> AllReduce:
+    def all_reduce(self, name: str, value, op="sum", finalize=None, *,
+                   meta=None, meta_op=None, wire=None, chunked=None,
+                   template=None) -> AllReduce:
         """Start an allreduce of ``value`` under ``name``; all active members
         must call with the same name (and call order per name).
 
@@ -252,9 +508,46 @@ class Group:
         before it travels on the wire (and to the root's final result).  This
         lets an op accumulate in a wide dtype at each hop and re-round only
         once per hop — the Accumulator's wire-compression contract.
+
+        Large uniform-dtype array payloads with a builtin string ``op``
+        automatically take the bandwidth-optimal **chunked ring** path
+        (reduce-scatter + all-gather, see ``_RingOp``) once they exceed
+        ``MOOLIB_RING_THRESHOLD`` bytes (default 1 MiB); ``chunked=True/False``
+        forces the choice.  The path choice is part of the op's wire protocol,
+        so it must be deterministic cohort-wide: same threshold env, same
+        payload shapes, same kwargs on every peer.  Ring-only extras:
+
+        - ``meta``/``meta_op``: a small side value combined exactly once per
+          member along the ring (e.g. batch counts); the future then resolves
+          to ``(value, meta)``.
+        - ``wire``: per-hop chunk compression — a numpy dtype name (e.g.
+          ``"bfloat16"``: accumulate f32, re-round per hop) or ``"q8"``
+          (symmetric int8, one scale per chunk).
+        - ``value=None`` (sum only) contributes zero at near-zero wire cost;
+          ``template`` must then supply the pytree of array shapes.
         """
         future = AllReduce()
-        reduce_fn = _resolve_op(op)
+        if (meta is not None or wire is not None or template is not None) and chunked is not True:
+            # Ring-only kwargs must not silently change meaning with cohort
+            # or payload size: they require the explicit chunked=True path.
+            raise RpcError("meta=/wire=/template= require chunked=True")
+        use_ring = chunked
+        if use_ring is None:
+            use_ring = (
+                meta is None and wire is None and template is None
+                and finalize is None and isinstance(op, str) and value is not None
+                and _ring_nbytes(value) >= _ring_threshold()
+            )
+        if use_ring:
+            if not isinstance(op, str):
+                raise RpcError("chunked allreduce needs a builtin string op")
+            if finalize is not None:
+                raise RpcError("chunked allreduce: use wire= instead of finalize=")
+            if value is None and op != "sum":
+                raise RpcError("value=None (skip) only composes with op='sum'")
+            if meta is not None and meta_op is None:
+                raise RpcError("meta= requires meta_op=")
+        reduce_fn = None if use_ring else _resolve_op(op)
         with self._lock:
             if self._sync_id is None or self._rpc.get_name() not in self._members:
                 future.set_exception(RpcError("group not active"))
@@ -264,14 +557,42 @@ class Group:
             self._seq[seq_key] = seq + 1
             key = (self._sync_id, name, seq)
             if len(self._members) == 1:
-                future.set_result(value)
+                future.set_result((value, meta) if meta is not None else value)
                 return future
-            opstate = _Op(key, value, reduce_fn, finalize, future)
-            self._ops[key] = opstate
-            parked = self._parked.pop(key, [])
-            opstate.contribs.extend(parked)
-            action = self._check_op_locked(opstate)
-        self._finish_op(opstate, action)
+            if use_ring:
+                try:
+                    opstate = _RingOp(
+                        key, value, op, future, list(self._members),
+                        self._members.index(self._rpc.get_name()), wire,
+                        meta, meta_op, template)
+                except RpcError as e:
+                    future.set_exception(e)
+                    return future
+                self._ops[key] = opstate
+                for frame in self._ring_parked.pop(key, []):
+                    opstate.pending[(frame[0], frame[1])] = frame[2:]
+                if self._parked.pop(key, None) is not None:
+                    del self._ops[key]
+                    future.set_exception(RpcError(
+                        "peers disagree on allreduce path: tree contribution "
+                        f"received for chunked op {key}"))
+                    return future
+            else:
+                opstate = _Op(key, value, reduce_fn, finalize, future)
+                self._ops[key] = opstate
+                parked = self._parked.pop(key, [])
+                opstate.contribs.extend(parked)
+                if self._ring_parked.pop(key, None) is not None:
+                    del self._ops[key]
+                    future.set_exception(RpcError(
+                        "peers disagree on allreduce path: ring frame "
+                        f"received for tree op {key}"))
+                    return future
+                action = self._check_op_locked(opstate)
+        if use_ring:
+            self._ring_pump(opstate)
+        else:
+            self._finish_op(opstate, action)
         return future
 
     def _on_reduce(self, key, value):
@@ -283,8 +604,18 @@ class Group:
             if op is None:
                 self._parked.setdefault(key, []).append(value)
                 return None
-            op.contribs.append(value)
-            action = self._check_op_locked(op)
+            if isinstance(op, _RingOp):
+                del self._ops[key]
+                mismatch = op
+            else:
+                mismatch = None
+                op.contribs.append(value)
+                action = self._check_op_locked(op)
+        if mismatch is not None:
+            mismatch.future.set_exception(RpcError(
+                "peers disagree on allreduce path: tree contribution "
+                f"received for chunked op {key}"))
+            return None
         self._finish_op(op, action)
         return None
 
@@ -340,8 +671,17 @@ class Group:
             op = self._ops.pop(key, None)
             if op is None:
                 return None
-            idx, _, _ = self._tree()
-            members = self._members
+            if isinstance(op, _RingOp):
+                mismatch = op
+            else:
+                mismatch = None
+                idx, _, _ = self._tree()
+                members = self._members
+        if mismatch is not None:
+            mismatch.future.set_exception(RpcError(
+                "peers disagree on allreduce path: tree share "
+                f"received for chunked op {key}"))
+            return None
         self._share_down(key, result, idx, members)
         op.future.set_result(result)
         return None
@@ -353,3 +693,90 @@ class Group:
                 self._rpc.async_callback(
                     members[c], "__group_share", lambda r, e: None, self._name, key, result
                 )
+
+    # ------------------------------------------------------------ ring path
+    def _on_ring(self, key, phase, step, chunk_idx, data, meta):
+        key = tuple(key) if isinstance(key, list) else key
+        with self._lock:
+            if self._sync_id is None or key[0] != self._sync_id:
+                return None  # frame from a dead epoch
+            op = self._ops.get(key)
+            if op is None:
+                self._ring_parked.setdefault(key, []).append(
+                    (phase, step, chunk_idx, data, meta))
+                return None
+            if not isinstance(op, _RingOp):
+                del self._ops[key]
+                mismatch = op
+            else:
+                mismatch = None
+                op.pending[(phase, step)] = (chunk_idx, data, meta)
+        if mismatch is not None:
+            # Complete outside the lock: done-callbacks (the Accumulator's)
+            # take their own locks — inline completion would invert the lock
+            # order against all_reduce callers (same rule as the timeout sweep).
+            mismatch.future.set_exception(RpcError(
+                "peers disagree on allreduce path: ring frame "
+                f"received for tree op {key}"))
+            return None
+        self._ring_pump(op)
+        return None
+
+    def _ring_pump(self, op: _RingOp) -> None:
+        """Drive a ring op: drain ready steps under the lock, perform the
+        resulting sends / completion outside it.  A ``pumping`` flag keeps one
+        driver at a time per op (concurrent frame arrivals set ``repump``)."""
+        with self._lock:
+            if op.pumping:
+                op.repump = True
+                return
+            op.pumping = True
+        while True:
+            with self._lock:
+                op.repump = False
+                if op.key not in self._ops and op.done_chunks < op.n:
+                    op.pumping = False
+                    return  # cancelled (epoch change / timeout / error)
+                try:
+                    actions = op.drain()
+                except RpcError as e:
+                    self._ops.pop(op.key, None)
+                    op.pumping = False
+                    err = e
+                    break
+                if any(a[0] == "done" for a in actions):
+                    self._ops.pop(op.key, None)
+                if not actions and not op.repump:
+                    op.pumping = False
+                    return
+            err = None
+            done = False
+            for a in actions:
+                if a[0] == "done":
+                    done = True
+                else:
+                    _, phase, step, chunk_idx, data, meta = a
+                    self._ring_send(op, phase, step, chunk_idx, data, meta)
+            if done:
+                try:
+                    op.future.set_result(op.assemble())
+                except Exception as e:  # noqa: BLE001 - surface assembly bugs
+                    op.future.set_exception(e)
+                with self._lock:
+                    op.pumping = False
+                return
+        op.future.set_exception(err)
+
+    def _ring_send(self, op: _RingOp, phase, step, chunk_idx, data, meta):
+        nxt = op.members[(op.rank + 1) % op.n]
+
+        def _sent(result, error, op=op):
+            if error is not None:
+                with self._lock:
+                    self._ops.pop(op.key, None)
+                op.future.set_exception(
+                    RpcError(f"ring allreduce send failed: {error}"))
+
+        self._rpc.async_callback(
+            nxt, "__group_ring", _sent, self._name, op.key, phase, step,
+            chunk_idx, data, meta)

@@ -253,6 +253,26 @@ def _local_addresses() -> List[str]:
     return addrs
 
 
+_BOOT_ID: Optional[str] = None
+
+
+def _boot_id() -> str:
+    """Machine identity for same-host detection (the reference's network key
+    is the boot id too, ``src/transports/ipc.cc:280-315`` getNetworkKey).
+    When the boot id is unreadable, fall back to a per-process random value:
+    Rpcs in this process still match each other (genuinely same host), while
+    cross-process peers never match — the upgrade quietly disables rather
+    than treating two arbitrary machines as same-host."""
+    global _BOOT_ID
+    if _BOOT_ID is None:
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                _BOOT_ID = f.read().strip()
+        except OSError:
+            _BOOT_ID = f"noboot-{utils.create_uid()}"
+    return _BOOT_ID
+
+
 def parse_address(addr: str) -> Tuple[str, Any]:
     """Parse "tcp://host:port", "ipc://path", "host:port", ":port"."""
     if addr.startswith("tcp://"):
@@ -280,6 +300,8 @@ class _Connection:
         "peer_uid",
         "send_count",
         "recv_count",
+        "bytes_out",
+        "bytes_in",
         "latency",
         "bandit",
         "bandit_t",
@@ -302,6 +324,8 @@ class _Connection:
         self.peer_uid: Optional[str] = None
         self.send_count = 0
         self.recv_count = 0
+        self.bytes_out = 0
+        self.bytes_in = 0
         self.latency: Optional[float] = None  # EMA seconds
         # Bandit value in [-1, 1] (reference banditValue, src/rpc.cc:640-716):
         # nudged up when this transport currently has the peer's best latency,
@@ -343,6 +367,7 @@ class _Connection:
             off += n
         self.writer.write(buf)
         self.send_count += 1
+        self.bytes_out += total
 
     def close(self) -> None:
         if not self.closed:
@@ -384,10 +409,12 @@ class _NativeConnection(_Connection):
             if peer is not None and peer.fdp_ok:
                 if self.net.send_memfd(self.conn_id, chunks):
                     self.send_count += 1
+                    self.bytes_out += total
                     return
         if not self.net.send_iov(self.conn_id, chunks):
             raise RpcError("native send failed (engine destroyed)")
         self.send_count += 1
+        self.bytes_out += total
 
     def close(self) -> None:
         if not self.closed:
@@ -409,11 +436,14 @@ class _Peer:
         "find_inflight",
         "native_ok",
         "fdp_ok",
+        "upgrade_attempts",
     )
 
     def __init__(self, name: str):
         self.name = name
         self.uid: Optional[str] = None
+        # ipc addresses we dialed for same-host transport upgrade -> when.
+        self.upgrade_attempts: Dict[str, float] = {}
         # Whether the peer can decode the native codec (negotiated in the
         # greeting; until/unless true we send pickle-codec payloads).
         self.native_ok = False
@@ -427,12 +457,22 @@ class _Peer:
         self.executing: set = set()
         self.find_inflight = False
 
-    def best_connection(self, order: List[str]) -> Optional[_Connection]:
+    def best_connection(self, order: List[str], big: bool = False) -> Optional[_Connection]:
         """Pick the transport for one message: softmax over per-connection
         bandit values (reference banditSend, ``src/rpc.cc:640-716``) —
         mostly-exploit with a sliver of exploration so a transport that went
         bad (or got one unlucky sample) keeps producing fresh latency data.
+
+        ``big`` payloads (at/above the memfd zero-copy threshold) pick a live
+        ipc connection outright: the latency bandit can't see throughput, and
+        a same-host unix stream with SCM_RIGHTS memfd frames always beats
+        loopback TCP on bytes/sec — size-aware selection is the upgrade over
+        the reference's latency-only bandit.
         """
+        if big:
+            c = self.connections.get("ipc")
+            if c is not None and not c.closed:
+                return c
         conns = [c for c in self.connections.values() if not c.closed]
         if not conns:
             return None
@@ -744,6 +784,14 @@ class Rpc:
         self._transport_order = list(transports)
 
     def listen(self, address: str) -> None:
+        # A bare ":port" listens on every default transport (reference
+        # Rpc::listen, src/rpc.cc:3102-3136): all TCP interfaces plus an
+        # auto-pathed unix listener, so same-host peers can transport-upgrade
+        # to ipc/memfd no matter which address they dialed.
+        if address.startswith(":") and not any(
+            a.startswith("ipc://") for a in self._listen_addrs
+        ):
+            self.listen(f"ipc:///tmp/moolib_tpu_{self._uid}.sock")
         kind, target = parse_address(address)
         if self._net is not None:
             if kind == "tcp":
@@ -866,7 +914,8 @@ class Rpc:
             for t, c in p.connections.items():
                 lat = f"{c.latency*1e6:.0f}us" if c.latency is not None else "?"
                 lines.append(
-                    f"    {t}: sent={c.send_count} recv={c.recv_count} latency={lat}"
+                    f"    {t}: sent={c.send_count} recv={c.recv_count}"
+                    f" tx={c.bytes_out} rx={c.bytes_in} latency={lat}"
                     f" bandit={c.bandit:+.2f}"
                     f" age={time.monotonic()-c.created:.1f}s closed={c.closed}"
                 )
@@ -875,6 +924,20 @@ class Rpc:
             f" functions={list(self._functions)}"
         )
         return "\n".join(lines)
+
+    def transport_stats(self) -> Dict[str, int]:
+        """Aggregate wire counters across every live/dead-but-tracked
+        connection: {"tx_bytes", "rx_bytes", "tx_frames", "rx_frames"}.
+        The allreduce benchmark uses the per-peer spread of these to show
+        the chunked ring's even load (vs the tree root's 2x hotspot)."""
+        with self._state:
+            tx = rx = txf = rxf = 0
+            for c in self._conns:
+                tx += c.bytes_out
+                rx += c.bytes_in
+                txf += c.send_count
+                rxf += c.recv_count
+            return {"tx_bytes": tx, "rx_bytes": rx, "tx_frames": txf, "rx_frames": rxf}
 
     def close(self) -> None:
         if self._closed:
@@ -962,7 +1025,8 @@ class Rpc:
     def _try_send(self, out: _Outgoing):
         # Caller holds self._state.
         peer = self._peers.get(out.peer_name)
-        conn = peer.best_connection(self._transport_order) if peer else None
+        big = sum(_chunk_len(c) for c in out.chunks) >= _MEMFD_MIN
+        conn = peer.best_connection(self._transport_order, big=big) if peer else None
         if conn is not None:
             try:
                 conn.send_frame(self._chunks_for(peer, out))
@@ -1138,6 +1202,7 @@ class Rpc:
             if conn is None or conn.closed:
                 return
             conn.recv_count += 1
+            conn.bytes_in += len(frame)
             conn.last_recv = time.monotonic()
         self._on_frame(conn, frame)
 
@@ -1188,6 +1253,7 @@ class Rpc:
                 "name": self._name,
                 "uid": self._uid,
                 "addrs": list(self._listen_addrs),
+                "host": _boot_id(),
                 "native": serialization.native_available(),
                 # fd-passing capability: our engine can receive SCM_RIGHTS
                 # memfd frames (native transport only).
@@ -1226,6 +1292,7 @@ class Rpc:
                         conn.last_recv = time.monotonic()
                     frame = bytes(buf)
                 conn.recv_count += 1
+                conn.bytes_in += length
                 conn.last_recv = time.monotonic()
                 self._on_frame(conn, frame)
         except (asyncio.IncompleteReadError, ConnectionError, OSError, asyncio.CancelledError):
@@ -1368,6 +1435,32 @@ class Rpc:
         for out in list(self._outgoing.values()):
             if out.peer_name == name and out.rid not in seen:
                 self._try_send(out)
+        self._maybe_upgrade_transport(peer, info)
+
+    def _maybe_upgrade_transport(self, peer: _Peer, info: dict) -> None:
+        """Same-host transport upgrade (the reference's automatic transport
+        selection, ``README.md:17-19`` / ``src/rpc.cc:640-716``): when a peer
+        reached over TCP advertises an ipc:// listener on this machine
+        (boot-id match), dial it too.  The bandit then has both transports
+        and big frames take the unix/memfd zero-copy path outright.  Caller
+        holds ``self._state``.  Only the uid-smaller side dials, so the pair
+        doesn't create rival duplicate connections to tie-break."""
+        if info.get("host") != _boot_id():
+            return
+        if peer.uid is not None and self._uid >= peer.uid:
+            return
+        ipc = peer.connections.get("ipc")
+        if ipc is not None and not ipc.closed:
+            return
+        now = time.monotonic()
+        for a in info.get("addrs", []):
+            if not a.startswith("ipc://"):
+                continue
+            if now - peer.upgrade_attempts.get(a, -1e9) < 10.0:
+                return  # a recent dial is in flight / just failed
+            peer.upgrade_attempts[a] = now
+            self._spawn(lambda a=a: self._connect_once(a))
+            return
 
     def _on_request(self, conn: _Connection, frame: bytes):
         rid, sender_timeout, fnlen = struct.unpack_from("<QIH", frame, 1)
@@ -1419,7 +1512,11 @@ class Rpc:
                         peer.recent[rid] = (time.monotonic(), chunks, dedup_ttl)
                     # Respond over the best currently-alive connection to the
                     # peer; fall back to the one the request came in on.
-                    target = peer.best_connection(self._transport_order) if peer else None
+                    big = sum(_chunk_len(c) for c in chunks) >= _MEMFD_MIN
+                    target = (
+                        peer.best_connection(self._transport_order, big=big)
+                        if peer else None
+                    )
                     if target is None or target.closed:
                         target = conn
                     try:

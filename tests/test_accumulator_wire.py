@@ -129,14 +129,19 @@ def test_two_phase_virtual_batch_one_grad_allreduce(free_port):
         accs.append(acc)
     try:
         assert _pump(broker, accs, 30, lambda: all(a.connected() for a in accs))
-        # Count every gradient-bearing payload that leaves each peer.
+        # Count every gradient-bearing payload that leaves each peer, and the
+        # distinct allreduce op keys they belong to (tree sends ride
+        # __group_reduce, chunked-ring sends ride __group_ring; args[1] is the
+        # epoch-keyed op key on both protocols).
         grad_sends = {i: 0 for i in range(len(accs))}
+        grad_keys = set()
         for i, a in enumerate(accs):
             orig = a._rpc.async_callback
 
             def spy(peer, fn, cb, *args, _orig=orig, _i=i):
-                if fn == "__group_reduce" and "__accum_grad" in str(args[1]):
+                if fn in ("__group_reduce", "__group_ring") and "__accum_grad" in str(args[1]):
                     grad_sends[_i] += 1
+                    grad_keys.add(tuple(args[1]))
                 return _orig(peer, fn, cb, *args)
 
             a._rpc.async_callback = spy
@@ -155,10 +160,14 @@ def test_two_phase_virtual_batch_one_grad_allreduce(free_port):
             assert stats == {"num_gradients": 8, "num_skipped": 0, "batch_size": 16}
             # mean over 8 contributions of (1+2+3+4) pairs = (1+2+3+4)*2/8
             np.testing.assert_allclose(np.asarray(a.gradients()["w"]), 2.5)
-        # Wire-level assertion: per peer, the gradient op name was used for at
-        # most ONE up-the-tree send this virtual batch (the non-root peer
-        # sends once; the root sends zero __group_reduce but shares down).
-        assert sum(grad_sends.values()) == 1, grad_sends
+        # Wire-level assertion: all gradient traffic this virtual batch
+        # belonged to exactly ONE allreduce op.  On the tree that is one
+        # up-the-tree send total (the root only shares down); on the chunked
+        # ring it is 2(n-1) frames per peer, all under the same op key.
+        assert len(grad_keys) == 1, grad_keys
+        ring = accs[0]._use_ring_locked()
+        expected_sends = 2 * (len(accs) - 1) * len(accs) if ring else 1
+        assert sum(grad_sends.values()) == expected_sends, (grad_sends, ring)
         # And the op-sequence bookkeeping agrees: 4 count rounds, 1 grad round.
         sid = accs[0]._group.sync_id()
         assert accs[0]._group._seq[(sid, "__accum_count:m")] == 4

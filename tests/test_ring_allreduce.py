@@ -1,0 +1,257 @@
+"""Chunked ring allreduce (reduce-scatter + all-gather) over RPC.
+
+Counterpart of the reference's benchmark-only chunked ring
+(``test/test_multinode_allreduce.cc:16-150``), promoted here to a first-class
+epoch-keyed Group op (VERDICT round-3 ask #2).  Uses the one-process
+many-peers loopback pattern of the reference test suite (SURVEY §4).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu import Broker, Group, Rpc
+from moolib_tpu.rpc import RpcError
+
+
+@pytest.fixture
+def cohort(free_port):
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    peers = []
+    for i in range(4):
+        rpc = Rpc()
+        rpc.set_name(f"rank{i}")
+        rpc.listen("127.0.0.1:0")
+        rpc.connect(addr)
+        g = Group(rpc, "t")
+        g.set_timeout(30)
+        peers.append((rpc, g))
+    groups = [g for _, g in peers]
+
+    def pump():
+        broker.update()
+        for g in groups:
+            g.update()
+
+    deadline = time.time() + 30
+    while not all(g.active() for g in groups) and time.time() < deadline:
+        pump()
+        time.sleep(0.01)
+    assert all(g.active() for g in groups), "cohort never converged"
+    try:
+        yield groups, pump
+    finally:
+        for rpc, _ in peers:
+            rpc.close()
+        broker.close()
+
+
+def _wait(futs, pump, timeout=30):
+    deadline = time.time() + timeout
+    while not all(f.done() for f in futs):
+        assert time.time() < deadline, "allreduce did not complete"
+        pump()
+        time.sleep(0.002)
+
+
+def test_ring_sum_matches_tree(cohort):
+    groups, pump = cohort
+    data = [np.random.randn(1000).astype(np.float32) + i for i in range(4)]
+    ring = [g.all_reduce("r", d, chunked=True) for g, d in zip(groups, data)]
+    tree = [g.all_reduce("t", d, chunked=False) for g, d in zip(groups, data)]
+    _wait(ring + tree, pump)
+    expect = tree[0].result(0)
+    for f in ring:
+        np.testing.assert_allclose(f.result(0), expect, rtol=1e-5)
+
+
+def test_ring_pytree_and_meta(cohort):
+    groups, pump = cohort
+    data = [
+        {"w": np.full((3, 4), float(i + 1), np.float32), "b": np.arange(5, dtype=np.float32)}
+        for i in range(4)
+    ]
+    futs = [
+        g.all_reduce(
+            "m", d, chunked=True,
+            meta={"n": 1, "bs": i + 1},
+            meta_op=lambda a, b: {k: a[k] + b[k] for k in a},
+        )
+        for i, (g, d) in enumerate(zip(groups, data))
+    ]
+    _wait(futs, pump)
+    for f in futs:
+        value, meta = f.result(0)
+        assert meta == {"n": 4, "bs": 10}
+        np.testing.assert_allclose(value["w"], np.full((3, 4), 10.0, np.float32))
+        np.testing.assert_allclose(value["b"], 4 * np.arange(5, dtype=np.float32))
+
+
+def test_ring_skip_contributions(cohort):
+    groups, pump = cohort
+    tmpl = {"w": np.zeros((3, 4), np.float32)}
+    futs = []
+    for i, g in enumerate(groups):
+        if i % 2 == 0:
+            futs.append(g.all_reduce("s", {"w": np.full((3, 4), float(i + 1), np.float32)}, chunked=True))
+        else:
+            futs.append(g.all_reduce("s", None, chunked=True, template=tmpl))
+    _wait(futs, pump)
+    for f in futs:
+        np.testing.assert_allclose(f.result(0)["w"], np.full((3, 4), 4.0, np.float32))
+    # All-skip round resolves to None on every peer.
+    futs = [g.all_reduce("s2", None, chunked=True, template=tmpl) for g in groups]
+    _wait(futs, pump)
+    assert all(f.result(0) is None for f in futs)
+
+
+@pytest.mark.parametrize("wire", ["bfloat16", "q8"])
+def test_ring_wire_compression_bit_consistent(cohort, wire):
+    """Wire-compressed ring results must be bit-identical cohort-wide: every
+    rank decodes the same encoded chunk bytes (the all-gather forwards wire
+    bytes unchanged)."""
+    groups, pump = cohort
+    data = [np.random.randn(4096).astype(np.float32) for _ in range(4)]
+    futs = [g.all_reduce("w" + wire, d, chunked=True, wire=wire) for g, d in zip(groups, data)]
+    _wait(futs, pump)
+    r0 = futs[0].result(0)
+    for f in futs[1:]:
+        np.testing.assert_array_equal(f.result(0), r0)
+    np.testing.assert_allclose(r0, sum(data), rtol=0.05, atol=0.5)
+
+
+def test_ring_min_max_ops(cohort):
+    groups, pump = cohort
+    data = [np.arange(100, dtype=np.float32) + 10 * i for i in range(4)]
+    mins = [g.all_reduce("mn", d, chunked=True, op="min") for g, d in zip(groups, data)]
+    maxs = [g.all_reduce("mx", d, chunked=True, op="max") for g, d in zip(groups, data)]
+    _wait(mins + maxs, pump)
+    for f in mins:
+        np.testing.assert_allclose(f.result(0), data[0])
+    for f in maxs:
+        np.testing.assert_allclose(f.result(0), data[3])
+
+
+def test_ring_auto_threshold(cohort, monkeypatch):
+    """Payloads over MOOLIB_RING_THRESHOLD auto-select the ring (internal op
+    type checked), smaller ones keep the tree."""
+    from moolib_tpu.group import _Op, _RingOp
+
+    groups, pump = cohort
+    monkeypatch.setenv("MOOLIB_RING_THRESHOLD", str(1 << 12))
+    big = [np.random.randn(2048).astype(np.float32) for _ in range(4)]  # 8 KiB
+    small = [np.random.randn(16).astype(np.float32) for _ in range(4)]
+    futs = [g.all_reduce("auto", d) for g, d in zip(groups, big)]
+    kinds = {type(op) for g in groups for op in g._ops.values()}
+    assert kinds <= {_RingOp}, kinds
+    _wait(futs, pump)
+    np.testing.assert_allclose(futs[0].result(0), sum(big), rtol=1e-4, atol=1e-4)
+    futs = [g.all_reduce("auto", d) for g, d in zip(groups, small)]
+    kinds = {type(op) for g in groups for op in g._ops.values()}
+    assert kinds <= {_Op}, kinds
+    _wait(futs, pump)
+    np.testing.assert_allclose(futs[0].result(0), sum(small), rtol=1e-5)
+
+
+def test_ring_cancelled_on_membership_change(cohort, free_port):
+    """Epoch change mid-ring cancels the op with "group changed" — the
+    elasticity contract (reference cancel-on-change, src/group.h:453-460)."""
+    groups, pump = cohort
+    g0 = groups[0]
+    # Start a ring op on ONE peer only: it sends its first chunk and parks
+    # waiting for the others, which never contribute.
+    fut = g0.all_reduce("c", np.ones(64, np.float32), chunked=True)
+    pump()
+    assert not fut.done()
+    # A new peer joining bumps the membership epoch.
+    rpc = Rpc()
+    rpc.set_name("latecomer")
+    rpc.listen("127.0.0.1:0")
+    rpc.connect(f"127.0.0.1:{free_port}")
+    g = Group(rpc, "t")
+    try:
+        deadline = time.time() + 30
+        while not fut.done() and time.time() < deadline:
+            pump()
+            g.update()
+            time.sleep(0.005)
+        with pytest.raises(RpcError, match="group changed"):
+            fut.result(0)
+    finally:
+        rpc.close()
+
+
+def test_ring_rejects_bad_combinations(cohort):
+    groups, _ = cohort
+    g = groups[0]
+    with pytest.raises(RpcError, match="skip"):
+        g.all_reduce("b1", None, chunked=True, op="min", template=np.zeros(4, np.float32))
+    with pytest.raises(RpcError, match="meta_op"):
+        g.all_reduce("b2", np.zeros(4, np.float32), chunked=True, meta={"n": 1})
+    with pytest.raises(RpcError, match="finalize"):
+        g.all_reduce("b3", np.zeros(4, np.float32), chunked=True, finalize=lambda x: x)
+    f = g.all_reduce("b4", None, chunked=True)
+    with pytest.raises(RpcError, match="template"):
+        f.result(0)
+    f = g.all_reduce(
+        "b5", {"a": np.zeros(4, np.float32), "b": np.zeros(4, np.float64)}, chunked=True
+    )
+    with pytest.raises(RpcError, match="uniform dtype"):
+        f.result(0)
+
+
+def test_accumulator_rides_ring(free_port, monkeypatch):
+    """With the threshold forced to 0, the Accumulator's gradient rounds go
+    over the chunked ring and produce the same averages (VERDICT ask #2:
+    "churn tests pass with chunking on" — the full churn suite runs in
+    test_accumulator_churn.py under MOOLIB_RING_THRESHOLD)."""
+    monkeypatch.setenv("MOOLIB_RING_THRESHOLD", "0")
+    from moolib_tpu import Accumulator
+
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    accs = []
+    for i in range(3):
+        acc = Accumulator("m", {"w": np.zeros((8,), np.float32)})
+        acc.set_name(f"p{i}")
+        acc.listen()
+        acc.connect(addr)
+        accs.append(acc)
+    def pump_until(cond, seconds=30):
+        deadline = time.time() + seconds
+        while time.time() < deadline:
+            broker.update()
+            for a in accs:
+                a.update()
+                if a.wants_state():
+                    a.set_state({})
+            if cond():
+                return True
+            time.sleep(0.01)
+        return cond()
+
+    try:
+        assert pump_until(lambda: all(a.connected() for a in accs))
+        assert all(a._use_ring_locked() for a in accs)
+        gs = [{"w": np.full((8,), float(i + 1), np.float32)} for i in range(3)]
+        accs[0].skip_gradients()
+        for a, gv in zip(accs[1:], gs[1:]):
+            a.reduce_gradients(4, gv)
+        assert pump_until(lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            stats = a.get_gradient_stats()
+            assert stats["num_gradients"] == 2
+            assert stats["num_skipped"] == 1
+            np.testing.assert_allclose(
+                np.asarray(a.gradients()["w"]), np.full((8,), 2.5, np.float32)
+            )
+    finally:
+        for a in accs:
+            a.close()
+        broker.close()

@@ -117,7 +117,18 @@ def bench_ici(args):
     apply_platform_env()
     devices = jax.devices()
     mesh = parallel.make_mesh({"dp": len(devices)})
-    print(f"# XLA psum over {len(devices)} x {devices[0].platform} (ICI data plane)")
+    note = ""
+    if devices[0].platform == "cpu":
+        note = (
+            " — host-mesh sanity row (no ICI on CPU; collective cost is "
+            "memcpy); run on a TPU slice for real interconnect bandwidth"
+        )
+        if len(devices) == 1:
+            note = (
+                " — 1-device row is a pure memcpy, NOT a collective; set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+            )
+    print(f"# XLA psum over {len(devices)} x {devices[0].platform} (ICI data plane){note}")
     print(f"{'elems':>10} {'MB':>8} {'ms':>9} {'MB/s':>10}")
 
     for size in args.sizes:

@@ -23,7 +23,7 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(cmd, timeout=600):
+def _run(cmd, timeout=600, extra_env=None):
     t0 = time.time()
     # Children import moolib_tpu by path: make the repo root importable and
     # pin the CPU backend (a hung TPU tunnel must not stall a CPU bench).
@@ -31,6 +31,7 @@ def _run(cmd, timeout=600):
         os.environ,
         PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
         JAX_PLATFORMS="cpu",
+        **(extra_env or {}),
     )
     # Capture via temp FILES, not pipes: jax's plugin discovery can fork a
     # daemon that inherits the pipe fds, and communicate() then blocks on
@@ -68,9 +69,17 @@ def main():
     # The ici bench imports jax, whose plugin registration can hang for
     # minutes when the TPU tunnel is mid-failure (even pinned to CPU):
     # bound it and retry once rather than eating the whole collection budget.
-    ici = _run([py, "benchmarks/allreduce_bench.py", "ici"], timeout=240)
+    # 8 virtual host devices: a 1-device "psum" is a memcpy, not a
+    # collective — the 8-way mesh row at least pays cross-device traffic.
+    ici_env = {
+        "XLA_FLAGS": (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    }
+    ici = _run([py, "benchmarks/allreduce_bench.py", "ici"], timeout=240, extra_env=ici_env)
     if ici.get("rc") != 0:
-        ici = _run([py, "benchmarks/allreduce_bench.py", "ici"], timeout=240)
+        ici = _run([py, "benchmarks/allreduce_bench.py", "ici"], timeout=240, extra_env=ici_env)
     results = {
         "env": env_note,
         "rpc": _run([py, "benchmarks/rpc_bench.py", "--backend", "both"]),

@@ -157,18 +157,20 @@ class NativeNet:
 
         def _frame(ud, conn_id, datas, lens, n):
             # One callback per burst of frames (a single GIL acquisition
-            # covers the whole batch). Each view is zero-copy into the
-            # engine's read buffer, valid only for the duration of this
-            # callback — consumers deserialize synchronously (array leaves
-            # are copied during materialization).
+            # covers the whole batch).  Small frames are snapshotted with one
+            # string_at memcpy — much cheaper than building a ctypes view
+            # and free of lifetime constraints.  Large frames stay zero-copy
+            # into the engine's read buffer, valid only for the duration of
+            # this callback — consumers deserialize synchronously (array
+            # leaves are copied during materialization).
             for i in range(n):
                 length = lens[i]
-                if length:
+                if length < 65536:
+                    view = ctypes.string_at(datas[i], length) if length else b""
+                else:
                     view = memoryview(
                         (ctypes.c_ubyte * length).from_address(datas[i])
                     ).cast("B")
-                else:
-                    view = memoryview(b"")
                 on_frame(conn_id, view)
 
         def _close(ud, conn_id):
@@ -235,6 +237,19 @@ class NativeNet:
         refcounted tensor buffers on the wire)."""
         if not self._ctx:
             return False
+        # Small frames: one join + one primitive-args ctypes call — the
+        # iov/pin machinery costs ~15us per call, pure overhead below the
+        # zero-copy threshold where nothing can pin anyway.
+        total = 0
+        for c in chunks:
+            total += len(c) if isinstance(c, bytes) else memoryview(c).nbytes
+            if total >= 65536:
+                break
+        if total < 65536:
+            data = b"".join(
+                c if isinstance(c, bytes) else bytes(c) for c in chunks
+            )
+            return self._lib.moolib_net_send(self._ctx, conn_id, data, len(data)) == 0
         # keep: buffer-exporting objects; pinned if the engine borrows.
         bufs, lens, keep = _marshal_chunks(chunks)
         token = next(self._token_counter)

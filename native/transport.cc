@@ -81,6 +81,26 @@ struct Seg {
   size_t size() const { return ext ? ext_len : owned.size(); }
 };
 
+// Inbound reassembly buffer with malloc-only growth: vector::resize would
+// value-initialize (memset) the read headroom on EVERY wake — ~30us/MB on a
+// small core, which dominated small-message RTT.  Capacity is reused across
+// reads; only recvmsg touches the bytes.
+struct RdBuf {
+  std::unique_ptr<uint8_t[]> p;
+  size_t cap = 0;
+  size_t size = 0;
+  void ensure(size_t extra) {
+    if (size + extra <= cap) return;
+    size_t ncap = cap ? cap : (1 << 20);
+    while (ncap < size + extra) ncap *= 2;
+    std::unique_ptr<uint8_t[]> np(new uint8_t[ncap]);
+    if (size) memcpy(np.get(), p.get(), size);
+    p = std::move(np);
+    cap = ncap;
+  }
+  uint8_t* data() { return p.get(); }
+};
+
 struct Conn {
   int fd = -1;
   int64_t id = 0;
@@ -89,8 +109,14 @@ struct Conn {
   bool is_tcp = true;
   bool closed = false;
   bool want_write = false;
-  // Inbound reassembly buffer: [consumed, size) is live data.
-  std::vector<uint8_t> rd;
+  // Guards the write side (outq, sent, want_write, the fd for writes):
+  // sends run INLINE on the calling thread when the queue is empty — the
+  // reference writes on the caller's thread too (Socket::writev) — so the
+  // epoll thread's flush and any sender serialize here.  Lock order:
+  // conns_mu (lookup) -> wmu; never call destroy_conn while holding wmu.
+  std::mutex wmu;
+  // Inbound reassembly buffer: [consumed, rd.size) is live data.
+  RdBuf rd;
   size_t consumed = 0;
   // File descriptors received via SCM_RIGHTS, in byte-stream order; each
   // memfd control frame consumes one.
@@ -101,12 +127,14 @@ struct Conn {
 };
 
 struct Cmd {
-  enum Kind { kSend, kConnectTcp, kConnectUnix, kCloseConn, kStop } kind;
-  int64_t id = 0;      // conn id (kSend/kCloseConn) or req id (kConnect*)
+  // Sends no longer ride the command ring: they append to the connection's
+  // out-queue on the calling thread (inline writev when it was empty), so
+  // the ring only carries rare control operations.
+  enum Kind { kConnectTcp, kConnectUnix, kCloseConn, kStop } kind;
+  int64_t id = 0;      // conn id (kCloseConn) or req id (kConnect*)
   std::string data;    // host/path (kConnect*)
-  std::vector<Seg> segs;  // frame segments (kSend)
-  int64_t token = 0;      // release token (kSend; 0 = none)
   int port = 0;
+  bool notify = false;  // kCloseConn: report the close to the owner
 };
 
 struct Engine {
@@ -128,6 +156,15 @@ struct Engine {
 
   std::mutex cmd_mu;
   std::deque<Cmd> cmds;
+
+  // Cross-thread conn registry for inline sends: conns_mu guards the map
+  // only for the lookup — senders copy the shared_ptr and release conns_mu
+  // BEFORE taking the conn's write lock, so one connection's long flush
+  // never head-of-line-blocks sends to the others.  destroy_conn erases the
+  // entry and barriers on wmu; a sender still holding a ref then finds
+  // `closed` set and bails, and the Conn frees when the last ref drops.
+  std::mutex conns_mu;
+  std::unordered_map<int64_t, std::shared_ptr<Conn>> shared;
 
   std::atomic<int64_t> next_id{1};
   // Byte-level link activity per conn (rx reads / tx writev completions),
@@ -177,17 +214,34 @@ void epoll_update(Engine* e, Conn* c, bool add) {
 
 void destroy_conn(Engine* e, Conn* c, bool notify) {
   if (c->closed) return;
-  c->closed = true;
-  epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
-  close(c->fd);
+  // Unpublish first: after this no inline sender can find the conn; one
+  // already holding a ref either has wmu (everything destructive below
+  // serializes behind it) or will observe `closed` once it gets wmu.  The
+  // local ref keeps *c alive through this function; the object frees when
+  // the last sender ref drops.
+  std::shared_ptr<Conn> keep;
+  {
+    std::lock_guard<std::mutex> g(e->conns_mu);
+    auto it = e->shared.find(c->id);
+    if (it != e->shared.end()) {
+      keep = std::move(it->second);
+      e->shared.erase(it);
+    }
+  }
   e->by_fd.erase(c->fd);
   e->conns.erase(c->id);
-  // Unpin every undelivered zero-copy buffer; close undelivered/unclaimed fds.
-  for (Seg& s : c->outq) {
-    e->release(s.token);
-    if (s.pass_fd >= 0) close(s.pass_fd);
+  {
+    std::lock_guard<std::mutex> g(c->wmu);
+    c->closed = true;
+    epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    // Unpin every undelivered zero-copy buffer; close undelivered/unclaimed fds.
+    for (Seg& s : c->outq) {
+      e->release(s.token);
+      if (s.pass_fd >= 0) close(s.pass_fd);
+    }
+    c->outq.clear();
   }
-  c->outq.clear();
   for (int fd : c->in_fds) close(fd);
   c->in_fds.clear();
   {
@@ -200,7 +254,7 @@ void destroy_conn(Engine* e, Conn* c, bool notify) {
     else
       e->on_close(e->ud, c->id);
   }
-  delete c;
+  // `keep` (and any sender's ref) frees the Conn when the last one drops.
 }
 
 Conn* add_conn(Engine* e, int fd, bool is_tcp) {
@@ -212,19 +266,27 @@ Conn* add_conn(Engine* e, int fd, bool is_tcp) {
   int sz = kSockBuf;
   setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof sz);
   setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof sz);
-  Conn* c = new Conn();
+  auto sp = std::make_shared<Conn>();
+  Conn* c = sp.get();
   c->fd = fd;
   c->id = e->next_id.fetch_add(1);
   c->is_tcp = is_tcp;
   e->conns[c->id] = c;
   e->by_fd[fd] = c;
+  {
+    std::lock_guard<std::mutex> g(e->conns_mu);
+    e->shared[c->id] = std::move(sp);
+  }
   epoll_update(e, c, /*add=*/true);
   return c;
 }
 
 // Flush as much of the out-queue as the socket accepts (writev batching —
-// the reference's scatter-gather send, src/transports/socket.cc).
-void flush_out(Engine* e, Conn* c) {
+// the reference's scatter-gather send, src/transports/socket.cc).  Caller
+// holds c->wmu (epoll thread or an inline sender).  Returns false on a fatal
+// socket error: the caller must hand the conn to the epoll thread for
+// destruction WITHOUT holding wmu (destroy_conn barriers on it).
+bool flush_wlocked(Engine* e, Conn* c) {
   while (!c->outq.empty()) {
     // A segment carrying a memfd goes out alone via sendmsg: the fd rides
     // as SCM_RIGHTS ancillary data attached to its first byte.
@@ -246,8 +308,7 @@ void flush_out(Engine* e, Conn* c) {
       if (w < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         if (errno == EINTR) continue;
-        destroy_conn(e, c, true);
-        return;
+        return false;
       }
       e->add_tx(c->id, static_cast<uint64_t>(w));
       close(f.pass_fd);  // delivered with the first byte; receiver owns it now
@@ -274,8 +335,7 @@ void flush_out(Engine* e, Conn* c) {
     if (w < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
-      destroy_conn(e, c, true);
-      return;
+      return false;
     }
     if (w > 0) e->add_tx(c->id, static_cast<uint64_t>(w));
     size_t left = static_cast<size_t>(w);
@@ -298,6 +358,58 @@ void flush_out(Engine* e, Conn* c) {
     c->want_write = want;
     epoll_update(e, c, false);
   }
+  return true;
+}
+
+// Epoll-thread wrapper: flush under the write lock, destroy on fatal error.
+void flush_out(Engine* e, Conn* c) {
+  bool ok;
+  {
+    std::lock_guard<std::mutex> g(c->wmu);
+    ok = c->closed ? true : flush_wlocked(e, c);
+  }
+  if (!ok) destroy_conn(e, c, true);
+}
+
+// Inline send path: append the frame's segments and, if the queue was idle,
+// write straight from the calling thread — the hot small-message case then
+// never touches the command ring, the eventfd, or a thread handoff (the
+// reference likewise writes on the caller's thread, Socket::writev).  On
+// EAGAIN the remainder stays queued and EPOLLOUT interest (set under wmu)
+// wakes the epoll thread.  On a fatal error the conn is handed to the epoll
+// thread via a kCloseConn command.  Returns false iff the conn is gone.
+bool send_segs(Engine* e, int64_t conn_id, std::vector<Seg>&& segs) {
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> g(e->conns_mu);
+    auto it = e->shared.find(conn_id);
+    if (it == e->shared.end()) return false;
+    c = it->second;  // ref keeps the Conn alive; conns_mu released before wmu
+  }
+  bool ok = true;
+  {
+    std::lock_guard<std::mutex> g(c->wmu);
+    if (c->closed) return false;
+    bool was_idle = c->outq.empty();
+    for (Seg& s : segs) c->outq.push_back(std::move(s));
+    if (c->connecting) {
+      // Queued until the connect resolves; resolve_connect flushes (the
+      // connect itself keeps EPOLLOUT armed).
+    } else if (was_idle) {
+      ok = flush_wlocked(e, c.get());
+    } else if (!c->want_write) {
+      c->want_write = true;
+      epoll_update(e, c.get(), false);
+    }
+  }
+  if (!ok) {
+    Cmd cmd;
+    cmd.kind = Cmd::kCloseConn;
+    cmd.id = conn_id;
+    cmd.notify = true;  // write error: destroy WITH owner notification
+    e->push(std::move(cmd));
+  }
+  return true;
 }
 
 constexpr int kFrameBurst = 128;
@@ -316,11 +428,10 @@ void handle_readable(Engine* e, Conn* c) {
     maps.clear();
   };
   for (;;) {
-    size_t old = c->rd.size();
-    c->rd.resize(old + kReadChunk);
+    c->rd.ensure(kReadChunk);
     // recvmsg instead of read: unix-domain peers may attach SCM_RIGHTS
     // memfds (same-host zero-copy frames); on TCP the cmsg space is unused.
-    iovec iov{c->rd.data() + old, kReadChunk};
+    iovec iov{c->rd.data() + c->rd.size, kReadChunk};
     msghdr msg{};
     msg.msg_iov = &iov;
     msg.msg_iovlen = 1;
@@ -329,14 +440,12 @@ void handle_readable(Engine* e, Conn* c) {
     msg.msg_controllen = sizeof cbuf;
     ssize_t r = recvmsg(c->fd, &msg, MSG_CMSG_CLOEXEC);
     if (r < 0) {
-      c->rd.resize(old);
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
       destroy_conn(e, c, true);
       return;
     }
     if (r == 0) {
-      c->rd.resize(old);
       destroy_conn(e, c, true);
       return;
     }
@@ -347,14 +456,14 @@ void handle_readable(Engine* e, Conn* c) {
         for (int i = 0; i < nfds; ++i) c->in_fds.push_back(fds[i]);
       }
     }
-    c->rd.resize(old + static_cast<size_t>(r));
+    c->rd.size += static_cast<size_t>(r);
     e->add_rx(c->id, static_cast<uint64_t>(r));
     // Parse every complete frame in the buffer; deliver them in bursts
     // (one callback — one GIL acquisition — per batch of frames).
     int n = 0;
     bool dead = false;
     for (;;) {
-      size_t have = c->rd.size() - c->consumed;
+      size_t have = c->rd.size - c->consumed;
       if (have < 4) break;
       const uint8_t* p = c->rd.data() + c->consumed;
       uint32_t len = static_cast<uint32_t>(p[0]) | (uint32_t)p[1] << 8 |
@@ -411,11 +520,12 @@ void handle_readable(Engine* e, Conn* c) {
       destroy_conn(e, c, true);
       return;
     }
-    if (c->consumed == c->rd.size()) {
-      c->rd.clear();
+    if (c->consumed == c->rd.size) {
+      c->rd.size = 0;
       c->consumed = 0;
-    } else if (c->consumed > (1u << 20) && c->consumed > c->rd.size() / 2) {
-      c->rd.erase(c->rd.begin(), c->rd.begin() + c->consumed);
+    } else if (c->consumed > (1u << 20) && c->consumed > c->rd.size / 2) {
+      memmove(c->rd.data(), c->rd.data() + c->consumed, c->rd.size - c->consumed);
+      c->rd.size -= c->consumed;
       c->consumed = 0;
     }
     if (static_cast<size_t>(r) < kReadChunk) break;  // drained the socket
@@ -440,21 +550,6 @@ void run_cmds(Engine* e) {
   }
   for (Cmd& cmd : batch) {
     switch (cmd.kind) {
-      case Cmd::kSend: {
-        auto it = e->conns.find(cmd.id);
-        if (it == e->conns.end()) {
-          // Already closed: the pinned buffers must still be released and
-          // any undelivered memfd closed.
-          e->release(cmd.token);
-          for (Seg& s : cmd.segs)
-            if (s.pass_fd >= 0) close(s.pass_fd);
-          break;
-        }
-        Conn* c = it->second;
-        for (Seg& s : cmd.segs) c->outq.push_back(std::move(s));
-        if (!c->connecting) flush_out(e, c);  // else: flush after connect
-        break;
-      }
       case Cmd::kConnectTcp: {
         // Numeric addresses only (AI_NUMERICHOST): hostname resolution would
         // block the IO thread — the Python binding resolves names first.
@@ -507,8 +602,10 @@ void run_cmds(Engine* e) {
         break;
       }
       case Cmd::kCloseConn: {
+        // notify marks an inline sender's write error (the owner must hear
+        // about it); an explicit owner-initiated close stays silent.
         auto it = e->conns.find(cmd.id);
-        if (it != e->conns.end()) destroy_conn(e, it->second, false);
+        if (it != e->conns.end()) destroy_conn(e, it->second, cmd.notify);
         break;
       }
       case Cmd::kStop:
@@ -522,20 +619,16 @@ void resolve_connect(Engine* e, Conn* c) {
   int err = 0;
   socklen_t len = sizeof err;
   getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &len);
-  int64_t req = c->connect_req;
   if (err != 0) {
-    c->connecting = false;  // report as a failed connect, not a close
-    epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
-    close(c->fd);
-    e->by_fd.erase(c->fd);
-    e->conns.erase(c->id);
-    delete c;
-    if (!e->stopping.load()) e->on_connect(e->ud, req, -1);
+    // destroy_conn unpublishes from the shared registry and barriers on the
+    // write lock before freeing; with `connecting` still set it reports a
+    // failed connect (not a close) to the owner.
+    destroy_conn(e, c, true);
     return;
   }
   c->connecting = false;
   epoll_update(e, c, false);
-  if (!e->stopping.load()) e->on_connect(e->ud, req, c->id);
+  if (!e->stopping.load()) e->on_connect(e->ud, c->connect_req, c->id);
   flush_out(e, c);  // anything queued while connecting
 }
 
@@ -585,26 +678,34 @@ void loop(Engine* e) {
     // timeout-driven iteration).
     run_cmds(e);
   }
-  // Teardown on the loop thread: unpin everything still queued; the release
-  // callback is the one callback that still fires while stopping (the owner
-  // must not leak pinned buffers).
-  for (auto& kv : e->conns) {
-    for (Seg& s : kv.second->outq) {
-      e->release(s.token);
-      if (s.pass_fd >= 0) close(s.pass_fd);
-    }
-    for (int fd : kv.second->in_fds) close(fd);
-    close(kv.second->fd);
-    delete kv.second;
+  // Teardown on the loop thread: unpublish every conn first so no inline
+  // sender can find one, then barrier on each write lock before freeing.
+  // Unpinning still queued buffers fires the release callback — the one
+  // callback that still fires while stopping (the owner must not leak
+  // pinned buffers).
+  std::vector<std::shared_ptr<Conn>> doomed;
+  {
+    std::lock_guard<std::mutex> g(e->conns_mu);
+    doomed.reserve(e->shared.size());
+    for (auto& kv : e->shared) doomed.push_back(std::move(kv.second));
+    e->shared.clear();
   }
+  for (auto& c : doomed) {
+    {
+      std::lock_guard<std::mutex> g(c->wmu);
+      c->closed = true;
+      for (Seg& s : c->outq) {
+        e->release(s.token);
+        if (s.pass_fd >= 0) close(s.pass_fd);
+      }
+      c->outq.clear();
+      close(c->fd);
+    }
+    for (int fd : c->in_fds) close(fd);
+  }
+  doomed.clear();
   {
     std::lock_guard<std::mutex> g(e->cmd_mu);
-    for (Cmd& cmd : e->cmds)
-      if (cmd.kind == Cmd::kSend) {
-        e->release(cmd.token);
-        for (Seg& s : cmd.segs)
-          if (s.pass_fd >= 0) close(s.pass_fd);
-      }
     e->cmds.clear();
   }
   e->conns.clear();
@@ -733,9 +834,7 @@ int moolib_net_send_iov(void* ctx, int64_t conn_id, const void* const* bufs,
   uint64_t total = 0;
   for (int32_t i = 0; i < n; ++i) total += lens[i];
   if (total > kMaxFrame) return -1;
-  Cmd c;
-  c.kind = Cmd::kSend;
-  c.id = conn_id;
+  std::vector<Seg> segs;
   Seg cur;
   uint32_t l = static_cast<uint32_t>(total);
   char hdr[4] = {static_cast<char>(l & 0xff), static_cast<char>((l >> 8) & 0xff),
@@ -746,24 +845,25 @@ int moolib_net_send_iov(void* ctx, int64_t conn_id, const void* const* bufs,
   for (int32_t i = 0; i < n; ++i) {
     if (lens[i] >= kZeroCopyMin && token != 0) {
       if (!cur.owned.empty()) {
-        c.segs.push_back(std::move(cur));
+        segs.push_back(std::move(cur));
         cur = Seg();
       }
       Seg ext;
       ext.ext = static_cast<const uint8_t*>(bufs[i]);
       ext.ext_len = lens[i];
-      c.segs.push_back(std::move(ext));
+      segs.push_back(std::move(ext));
       pinned = true;
     } else {
       cur.owned.append(static_cast<const char*>(bufs[i]), lens[i]);
     }
   }
-  if (!cur.owned.empty()) c.segs.push_back(std::move(cur));
-  if (pinned) {
-    c.segs.back().token = token;
-    c.token = token;
+  if (!cur.owned.empty()) segs.push_back(std::move(cur));
+  if (pinned) segs.back().token = token;
+  if (!send_segs(e, conn_id, std::move(segs))) {
+    // Conn gone: the frame is dropped; nothing was borrowed (the caller
+    // unpins on any return != 1), matching the old drop-on-unknown-conn.
+    return 0;
   }
-  e->push(std::move(c));
   return pinned ? 1 : 0;
 }
 
@@ -795,9 +895,7 @@ int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
       left -= static_cast<uint64_t>(w);
     }
   }
-  Cmd c;
-  c.kind = Cmd::kSend;
-  c.id = conn_id;
+  std::vector<Seg> segs;
   Seg ctl;
   uint32_t flag = kMemfdFlag | 8u;
   char hdr[12];
@@ -808,8 +906,11 @@ int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
   memcpy(hdr + 4, &total, 8);
   ctl.owned.assign(hdr, sizeof hdr);
   ctl.pass_fd = fd;
-  c.segs.push_back(std::move(ctl));
-  e->push(std::move(c));
+  segs.push_back(std::move(ctl));
+  if (!send_segs(e, conn_id, std::move(segs))) {
+    close(fd);  // conn gone: frame dropped, nothing delivered
+    return 0;
+  }
   return 0;
 }
 

@@ -40,11 +40,20 @@ def _cache_dir() -> str:
 
 
 def _source_hash(path: str) -> str:
+    """Cache tag for a built artifact: source hash + sanitize mode (a
+    sanitized build must never be picked up by a normal run or vice versa)."""
     with open(path, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:16]
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    san = os.environ.get("MOOLIB_TPU_SANITIZE")
+    return f"{tag}-{san}" if san else tag
 
 
 def _build(src: str, out: str, extra_flags=()) -> bool:
+    # MOOLIB_TPU_SANITIZE=thread|address builds every native component with
+    # the given sanitizer (run python under the matching LD_PRELOAD runtime;
+    # see tests/test_native_sanitizers.py and docs/STATUS.md for the recipe).
+    san = os.environ.get("MOOLIB_TPU_SANITIZE")
+    san_flags = (f"-fsanitize={san}",) if san else ()
     cmd = [
         "g++",
         "-O2",
@@ -55,6 +64,7 @@ def _build(src: str, out: str, extra_flags=()) -> bool:
         src,
         "-o",
         out,
+        *san_flags,
         *extra_flags,
     ]
     try:

@@ -1,0 +1,242 @@
+// Concurrency stress for the native transport engine, meant to run under
+// -fsanitize=thread and -fsanitize=address (tests/test_native_sanitizers.py
+// builds + runs it both ways; docs/STATUS.md records the results).
+//
+// It hammers exactly the surfaces the inline-send redesign made concurrent:
+//   - many sender threads doing send/send_iov on the same connections while
+//     the epoll thread reads, echoes (engine-thread inline sends), and
+//     flushes EAGAIN backlogs (caller-thread vs epoll-thread wmu handoff);
+//   - zero-copy pinned frames with release callbacks firing from either the
+//     writing thread or the epoll thread;
+//   - unix-domain connections carrying memfd SCM_RIGHTS frames;
+//   - concurrent close_conn / destroy while senders race the conn registry
+//     (shared_ptr lifetime + wmu barrier);
+//   - engine destroy with traffic in flight.
+//
+// Build+run:
+//   g++ -O1 -g -std=c++17 -pthread -fsanitize=thread native/stress_transport.cc -o st && ./st
+//   g++ -O1 -g -std=c++17 -pthread -fsanitize=address,undefined native/stress_transport.cc -o sa && ./sa
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "transport.cc"
+
+#define ASSERT_TRUE(x)                                                      \
+  do {                                                                      \
+    if (!(x)) {                                                             \
+      fprintf(stderr, "ASSERT FAILED %s:%d: %s\n", __FILE__, __LINE__, #x); \
+      exit(1);                                                              \
+    }                                                                       \
+  } while (0)
+
+namespace {
+
+struct Side {
+  std::atomic<int64_t> frames{0};
+  std::atomic<uint64_t> bytes{0};
+  std::atomic<int64_t> released{0};
+  std::atomic<int> closes{0};
+  std::mutex mu;
+  std::vector<int64_t> accepted;   // server conns
+  std::vector<int64_t> connected;  // client conns
+  void* engine = nullptr;
+  bool echo = false;  // server: bounce every frame back (engine-thread send)
+};
+
+void on_accept(void* ud, int64_t conn_id, const char*) {
+  Side* s = static_cast<Side*>(ud);
+  std::lock_guard<std::mutex> g(s->mu);
+  s->accepted.push_back(conn_id);
+}
+void on_frame(void* ud, int64_t conn_id, const uint8_t** datas,
+              const uint64_t* lens, int32_t n) {
+  Side* s = static_cast<Side*>(ud);
+  for (int32_t i = 0; i < n; i++) {
+    // bytes before frames: waiters gate on the frame count, so the byte
+    // count must already be complete when the gating count lands.
+    s->bytes.fetch_add(lens[i]);
+    s->frames.fetch_add(1);
+    if (s->echo && lens[i] > 0 && lens[i] < 512) {
+      // Engine-thread inline send racing the caller-thread senders.
+      moolib_net_send(s->engine, conn_id, datas[i], lens[i]);
+    }
+  }
+}
+void on_close(void* ud, int64_t) { static_cast<Side*>(ud)->closes++; }
+void on_connect(void* ud, int64_t, int64_t conn_id) {
+  Side* s = static_cast<Side*>(ud);
+  if (conn_id < 0) return;
+  std::lock_guard<std::mutex> g(s->mu);
+  s->connected.push_back(conn_id);
+}
+void on_release(void* ud, int64_t) {
+  static_cast<Side*>(ud)->released.fetch_add(1);
+}
+
+template <typename F>
+bool wait_for(F f, int ms = 20000) {
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(ms);
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (f()) return true;
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+  return f();
+}
+
+}  // namespace
+
+int main() {
+  const int kConns = 4;
+  const int kSenders = 4;
+  const int kIters = 400;
+
+  // --- phase 1: concurrent senders over TCP with echo ---------------------
+  Side srv, cli;
+  srv.echo = true;
+  void* s = moolib_net_create(on_accept, on_frame, on_close, on_connect,
+                              on_release, &srv);
+  void* c = moolib_net_create(on_accept, on_frame, on_close, on_connect,
+                              on_release, &cli);
+  ASSERT_TRUE(s && c);
+  srv.engine = s;
+  cli.engine = c;
+  int port = moolib_net_listen_tcp(s, "127.0.0.1", 0);
+  ASSERT_TRUE(port > 0);
+  for (int i = 0; i < kConns; i++) moolib_net_connect_tcp(c, i, "127.0.0.1", port);
+  ASSERT_TRUE(wait_for([&] {
+    std::lock_guard<std::mutex> g(cli.mu);
+    return cli.connected.size() == kConns;
+  }));
+  std::vector<int64_t> conns;
+  {
+    std::lock_guard<std::mutex> g(cli.mu);
+    conns = cli.connected;
+  }
+
+  // Big buffer for pinned zero-copy sends; senders must keep it alive until
+  // its release fires, so it outlives the join below (engine holds refs).
+  std::vector<uint8_t> big(256 * 1024, 0xAB);
+  std::atomic<int64_t> pins_issued{0};
+
+  std::vector<std::thread> senders;
+  for (int t = 0; t < kSenders; t++) {
+    senders.emplace_back([&, t] {
+      std::mt19937 rng(t);
+      char small[64];
+      memset(small, 'x', sizeof small);
+      for (int i = 0; i < kIters; i++) {
+        int64_t conn = conns[rng() % conns.size()];
+        switch (rng() % 3) {
+          case 0:
+            moolib_net_send(c, conn, small, sizeof small);
+            break;
+          case 1: {
+            const void* bufs[2] = {small, small};
+            uint64_t lens[2] = {32, 16};
+            moolib_net_send_iov(c, conn, bufs, lens, 2, 0);
+            break;
+          }
+          case 2: {
+            const void* bufs[1] = {big.data()};
+            uint64_t lens[1] = {big.size()};
+            int rc = moolib_net_send_iov(c, conn, bufs, lens, 1,
+                                         /*token=*/1000 + t * kIters + i);
+            if (rc == 1) pins_issued.fetch_add(1);
+            break;
+          }
+        }
+      }
+    });
+  }
+  for (auto& th : senders) th.join();
+  // Every send delivered (frames counted server-side), every pin released.
+  ASSERT_TRUE(wait_for([&] { return srv.frames.load() >= kSenders * kIters; }));
+  ASSERT_TRUE(wait_for([&] { return cli.released.load() == pins_issued.load(); }));
+
+  // --- phase 2: senders racing close_conn (registry + wmu barrier) --------
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> racers;
+  for (int t = 0; t < kSenders; t++) {
+    racers.emplace_back([&, t] {
+      std::mt19937 rng(100 + t);
+      char buf[48];
+      memset(buf, 'y', sizeof buf);
+      while (!stop.load()) {
+        int64_t conn = conns[rng() % conns.size()];
+        moolib_net_send(c, conn, buf, sizeof buf);
+      }
+    });
+  }
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  for (int64_t conn : conns) {
+    moolib_net_close_conn(c, conn);
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  stop.store(true);
+  for (auto& th : racers) th.join();
+
+  // --- phase 3: unix + memfd frames under concurrency ---------------------
+  Side usrv, ucli;
+  void* us = moolib_net_create(on_accept, on_frame, on_close, on_connect,
+                               on_release, &usrv);
+  void* uc = moolib_net_create(on_accept, on_frame, on_close, on_connect,
+                               on_release, &ucli);
+  usrv.engine = us;
+  ucli.engine = uc;
+  char path[64];
+  snprintf(path, sizeof path, "/tmp/moolib_stress_%d.sock", getpid());
+  ASSERT_TRUE(moolib_net_listen_unix(us, path) == 0);
+  moolib_net_connect_unix(uc, 1, path);
+  ASSERT_TRUE(wait_for([&] {
+    std::lock_guard<std::mutex> g(ucli.mu);
+    return !ucli.connected.empty();
+  }));
+  int64_t uconn;
+  {
+    std::lock_guard<std::mutex> g(ucli.mu);
+    uconn = ucli.connected[0];
+  }
+  std::vector<std::thread> uthreads;
+  for (int t = 0; t < kSenders; t++) {
+    uthreads.emplace_back([&, t] {
+      std::vector<uint8_t> payload(128 * 1024, static_cast<uint8_t>(t));
+      const void* bufs[1] = {payload.data()};
+      uint64_t lens[1] = {payload.size()};
+      for (int i = 0; i < 50; i++) {
+        ASSERT_TRUE(moolib_net_send_memfd(uc, uconn, bufs, lens, 1) == 0);
+      }
+    });
+  }
+  for (auto& th : uthreads) th.join();
+  ASSERT_TRUE(wait_for([&] { return usrv.frames.load() == kSenders * 50; }));
+  ASSERT_TRUE(usrv.bytes.load() == uint64_t(kSenders) * 50 * 128 * 1024);
+
+  // --- phase 4: destroy engines with senders mid-flight -------------------
+  std::atomic<bool> dstop{false};
+  std::thread dsender([&] {
+    char buf[32];
+    memset(buf, 'z', sizeof buf);
+    while (!dstop.load()) moolib_net_send(uc, uconn, buf, sizeof buf);
+  });
+  std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  moolib_net_destroy(us);
+  dstop.store(true);
+  dsender.join();
+  moolib_net_destroy(uc);
+  unlink(path);
+
+  moolib_net_destroy(c);
+  moolib_net_destroy(s);
+  printf("native transport stress passed\n");
+  return 0;
+}

@@ -331,7 +331,13 @@ bool flush_wlocked(Engine* e, Conn* c) {
       skip = 0;
       ++n;
     }
-    ssize_t w = writev(c->fd, iov, n);
+    // sendmsg, not writev: MSG_NOSIGNAL turns a peer-closed-mid-write into
+    // EPIPE instead of a process-killing SIGPIPE (found by the TSAN stress
+    // harness — Python hosts ignore SIGPIPE, bare C++ hosts would die).
+    msghdr wmsg{};
+    wmsg.msg_iov = iov;
+    wmsg.msg_iovlen = static_cast<size_t>(n);
+    ssize_t w = sendmsg(c->fd, &wmsg, MSG_NOSIGNAL);
     if (w < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;

@@ -150,6 +150,11 @@ class Accumulator:
         # cohort-wide: it is derived from config + the synced model only.
         self._chunked_allreduce: Optional[bool] = None
         self._ring_size_cache: Optional[int] = None
+        # Debug checksums (reference src/accumulator.cc:324-370): verify the
+        # applied gradient result is bit-identical cohort-wide per round.
+        self._debug_checksums = False
+        self._checksum_divergences = 0
+        self._checksum_failures = 0  # verify rounds that errored/timed out
         # In-flight reduction rounds, oldest first.  With
         # set_parallel_gradients(n) up to n rounds overlap; results are
         # applied strictly in issue order — the Group sequences same-name ops
@@ -270,6 +275,13 @@ class Accumulator:
             self._wire_dtype = dtype
             self._wire_q8 = False
         self._q_residual = None
+
+    def set_debug_checksums(self, enabled: bool = True) -> None:
+        """CRC32-verify every applied gradient result across the cohort
+        (reference debug checksums, ``src/accumulator.cc:324-370``).  One
+        tiny extra allreduce per gradient round; enable on every peer or on
+        none.  Divergences are logged and counted in ``debug_info()``."""
+        self._debug_checksums = bool(enabled)
 
     def set_chunked_allreduce(self, enabled: Optional[bool]) -> None:
         """Route the big gradient allreduce over the Group's chunked ring
@@ -822,6 +834,7 @@ class Accumulator:
                     self._result_stats = dict(round_.stats)
                     self._result_epoch = self._group.sync_id()
                     self._has_gradients = True
+                    self._maybe_checksum_locked()
                 continue
             # kind == "full": single-phase — accumulate across rounds until
             # the (trivial) target is met, in f32 when compression is on
@@ -854,6 +867,67 @@ class Accumulator:
                 self._accum_grads = None
                 self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
                 self._has_gradients = True
+                self._maybe_checksum_locked()
+
+    def _maybe_checksum_locked(self) -> None:
+        """Debug checksums (reference ``src/accumulator.cc:324-370``): CRC32
+        the applied gradient result and allreduce (min, max) of the checksum
+        across the cohort — every peer must have produced bit-identical
+        bytes (the tree shares one result; the ring's all-gather forwards
+        encoded bytes unchanged), so min != max means divergence, logged and
+        counted.  Must be enabled on every peer or on none (the verify round
+        is part of the op sequence)."""
+        if not self._debug_checksums or self._result_grads is None:
+            return
+        import zlib
+
+        crc = 0
+        for leaf in jax.tree_util.tree_leaves(self._result_grads):
+            crc = zlib.crc32(np.ascontiguousarray(np.asarray(leaf)).tobytes(), crc)
+        version = self._model_version
+
+        def minmax(a, b):
+            return {"min": min(a["min"], b["min"]), "max": max(a["max"], b["max"])}
+
+        # The round identity (cohort-synced model version at apply time) is
+        # part of the op NAME: a peer that enabled checksums mid-epoch can
+        # never pair its first verify with another peer's later round (that
+        # would report false divergence forever).  During an enable
+        # transition the op instead times out and counts as a failure below.
+        fut = self._group.all_reduce(
+            f"__accum_crc:{self._name}:{version}", {"min": crc, "max": crc}, op=minmax
+        )
+
+        def _done(f, crc=crc, version=version):
+            try:
+                r = f.result(0)
+            except Exception as e:  # noqa: BLE001
+                # Epoch churn cancels verify rounds benignly; anything else
+                # (timeouts, path disagreement) must be visible — an operator
+                # reading divergences == 0 needs to know verification RAN.
+                with self._lock:
+                    self._checksum_failures += 1
+                log = utils.log_verbose if "group changed" in str(e) else utils.log_error
+                log(
+                    "accumulator %s: gradient checksum round (version %s) failed: %s",
+                    self._name, version, e,
+                )
+                return
+            if r["min"] != r["max"]:
+                with self._lock:
+                    self._checksum_divergences += 1
+                utils.log_error(
+                    "accumulator %s: GRADIENT DIVERGENCE at model version %s: "
+                    "crc32 min=%08x max=%08x (local %08x)",
+                    self._name, version, r["min"], r["max"], crc,
+                )
+            else:
+                utils.log_verbose(
+                    "accumulator %s: gradient crc32 %08x verified cohort-wide",
+                    self._name, crc,
+                )
+
+        fut.add_done_callback(_done)
 
     def gradients(self):
         """The cohort-averaged gradient pytree (valid while has_gradients())."""
@@ -882,6 +956,8 @@ class Accumulator:
             return {
                 "ici_reduces": self._ici_reduces,
                 "rpc_reduces": self._rpc_reduces,
+                "checksum_divergences": self._checksum_divergences,
+                "checksum_failures": self._checksum_failures,
                 "last_plane": self._last_plane,
                 "ici_eligible": self._ici_eligible(),
                 "wire_dtype": wire,

@@ -685,6 +685,11 @@ class Rpc:
         self._name = utils.create_uid()
         self._uid = utils.create_uid()
         self._timeout = _DEFAULT_TIMEOUT
+        # Which remote failures are reported back to the caller (reference
+        # ExceptionMode None/DeserializationOnly/All, src/rpc.h:201-205).
+        # Default "all": handler exceptions return as RpcError with the full
+        # remote traceback — richer than the reference's default.
+        self._exception_mode = "all"
         self._state = threading.RLock()
         self._transport_order = ["ipc", "tcp"]
         self._functions: Dict[str, _FnDef] = {}
@@ -782,6 +787,27 @@ class Rpc:
 
     def set_transports(self, transports: List[str]) -> None:
         self._transport_order = list(transports)
+
+    def set_exception_mode(self, mode: str) -> None:
+        """Choose which remote failures travel back to callers (reference
+        ``Rpc::setExceptionMode``, ``src/rpc.h:201-205``):
+
+        - ``"none"``: nothing is reported; a failing call times out on the
+          caller while the host logs the error.
+        - ``"deserialization"``: only argument-deserialization errors are
+          reported (the reference's default); handler exceptions are logged
+          host-side and the call times out.
+        - ``"all"`` (default): handler exceptions are reported with the full
+          remote traceback text.
+
+        Unknown-function errors are protocol-level and always reported.
+        Swallowed failures leave the request uncached, so a sender resend
+        may re-execute the handler — these modes are debugging tools, not a
+        consistency mechanism.
+        """
+        if mode not in ("none", "deserialization", "all"):
+            raise ValueError(f"exception mode must be none|deserialization|all, got {mode!r}")
+        self._exception_mode = mode
 
     def listen(self, address: str) -> None:
         # A bare ":port" listens on every default transport (reference
@@ -1484,9 +1510,22 @@ class Rpc:
                     return  # duplicate while executing; response will go out
                 peer.executing.add(rid)
 
-        def respond(value, error: Optional[str]):
+        def respond(value, error: Optional[str], stage: str = "handler"):
             # Serialize outside the state lock (can be large); then publish
             # the dedup entry and send under it.
+            if error is not None and not self._report_error(stage):
+                # Swallowed by the exception mode: log host-side, free the
+                # in-flight dedup slot (no response will ever go out), and
+                # let the caller time out — reference None/DeserializationOnly
+                # behavior (src/rpc.h:271-293).
+                utils.log_error(
+                    "rpc %s: %s error swallowed (exception_mode=%s): %s",
+                    self._name, stage, self._exception_mode, error,
+                )
+                with self._state:
+                    if peer is not None:
+                        peer.executing.discard(rid)
+                return
             ser_fn = (
                 serialization.serialize
                 if (peer is None or peer.native_ok)
@@ -1500,6 +1539,18 @@ class Rpc:
                     body = serialization.pack(ser_fn(value))
                     chunks = [struct.pack("<BQ", KIND_RESPONSE, rid)] + body
             except Exception as e:  # noqa: BLE001
+                # A response that cannot serialize is a handler-stage failure:
+                # it obeys the same exception-mode gate as a raising handler.
+                if not self._report_error("handler"):
+                    utils.log_error(
+                        "rpc %s: response serialization error swallowed "
+                        "(exception_mode=%s): %s",
+                        self._name, self._exception_mode, e,
+                    )
+                    with self._state:
+                        if peer is not None:
+                            peer.executing.discard(rid)
+                    return
                 body = serialization.pack(
                     serialization._py_serialize(f"response serialization error: {e}")
                 )
@@ -1537,15 +1588,27 @@ class Rpc:
 
         fdef = self._functions.get(fn_name)
         if fdef is None:
-            respond(None, f"function {fn_name!r} is not defined on peer {self._name!r}")
+            respond(
+                None,
+                f"function {fn_name!r} is not defined on peer {self._name!r}",
+                stage="protocol",
+            )
             return
         try:
             sp = serialization.unpack(frame, off)
             args, kwargs = serialization.deserialize(sp)
         except Exception as e:  # noqa: BLE001
-            respond(None, f"argument deserialization error: {e}")
+            respond(None, f"argument deserialization error: {e}", stage="deserialization")
             return
         self._dispatch(fdef, args, kwargs, respond)
+
+    def _report_error(self, stage: str) -> bool:
+        """Is this error stage reported to the caller under the current mode?"""
+        if stage == "protocol":
+            return True
+        if stage == "deserialization":
+            return self._exception_mode in ("deserialization", "all")
+        return self._exception_mode == "all"
 
     def _dispatch(self, fdef: _FnDef, args, kwargs, respond):
         if fdef.kind == "queue":

@@ -207,3 +207,56 @@ def test_bf16_hop_accumulates_in_f32():
     # Leaf pass-through: finalize leaves raw (non-partial) payloads alone.
     raw = mk(7.0)
     assert _wire_finalize("bfloat16")(raw) is raw
+
+
+def test_debug_checksums_verify_and_detect_divergence(free_port):
+    """CRC32 gradient checksums (reference src/accumulator.cc:324-370): a
+    healthy cohort verifies every round; a peer whose applied result is
+    tampered with gets flagged as a divergence on every peer."""
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    accs = []
+    for i in range(2):
+        acc = Accumulator("m", {"w": np.zeros((8,), np.float32)})
+        acc.set_name(f"p{i}")
+        acc.listen()
+        acc.set_debug_checksums(True)
+        acc.connect(addr)
+        accs.append(acc)
+    try:
+        assert _pump(broker, accs, 30, lambda: all(a.connected() for a in accs))
+        gs = [{"w": np.full((8,), float(i + 1), np.float32)} for i in range(2)]
+        for a, g in zip(accs, gs):
+            a.reduce_gradients(2, g)
+        assert _pump(broker, accs, 15, lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            a.zero_gradients()
+        # The verify allreduce is asynchronous; give it a pump cycle.
+        _pump(broker, accs, 2, lambda: False)
+        assert all(a.debug_info()["checksum_divergences"] == 0 for a in accs)
+
+        # Tamper with peer 1's applied result: wrap _maybe_checksum_locked's
+        # input by corrupting _result_grads right as the round completes.
+        orig = accs[1]._maybe_checksum_locked
+
+        def corrupt():
+            if accs[1]._result_grads is not None:
+                accs[1]._result_grads = {
+                    "w": np.asarray(accs[1]._result_grads["w"]) + 1.0
+                }
+            orig()
+
+        accs[1]._maybe_checksum_locked = corrupt
+        for a, g in zip(accs, gs):
+            a.reduce_gradients(2, g)
+        assert _pump(broker, accs, 15, lambda: all(a.has_gradients() for a in accs))
+        assert _pump(
+            broker, accs, 15,
+            lambda: all(a.debug_info()["checksum_divergences"] == 1 for a in accs),
+        )
+    finally:
+        for a in accs:
+            a.close()
+        broker.close()

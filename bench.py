@@ -336,7 +336,35 @@ def main():
         stale = _last_good_tpu()
         if stale is not None:
             result["last_good_tpu"] = stale
+    agent = _agent_row()
+    if agent is not None:
+        result["agent_sps"] = agent
     print(json.dumps(result))
+
+
+def _agent_row():
+    """Whole-agent SPS (act + env stepping + learn overlapped) beside the
+    learner-only headline.  Measured by benchmarks/agent_bench.py — too
+    heavy for the driver's bench budget, so the battery captures it into
+    BENCH_TPU.json and this republishes it with provenance."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU.json")
+    try:
+        with open(path) as f:
+            agent = json.load(f).get("impala_agent")
+        if not agent:
+            return None
+        return {
+            "metric": "impala_agent_sps",
+            "value": agent.get("value"),
+            "unit": agent.get("unit", "env_frames/s"),
+            "config": agent.get("config"),
+            "provenance": (
+                "battery-captured (benchmarks/agent_bench.py, committed "
+                f"BENCH_TPU.json, when={agent.get('captured_when', 'unknown')})"
+            ),
+        }
+    except Exception:  # noqa: BLE001 — no record yet
+        return None
 
 
 if __name__ == "__main__":

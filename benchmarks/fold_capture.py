@@ -56,6 +56,33 @@ def parse_flash(path):
     return lines if any(re.match(r"\s*\d+\s", l) for l in lines) else None
 
 
+def parse_agent(path):
+    """agent_bench prints one {'metric': 'impala_agent_sps', ...} JSON line."""
+    try:
+        with open(path) as f:
+            for line in reversed(f.read().splitlines()):
+                if line.startswith("{") and "impala_agent_sps" in line:
+                    row = json.loads(line)
+                    return row if row.get("platform") != "cpu" else None
+    except (OSError, json.JSONDecodeError):
+        return None
+    return None
+
+
+def parse_envpool(path):
+    """envpool_bench prints one {'env': ..., 'env_steps_per_s': ...} line.
+    EnvPool runs host-side, so there is no platform gate — the row is valid
+    wherever the battery ran (it matters next to the chip's learner rows)."""
+    try:
+        with open(path) as f:
+            for line in reversed(f.read().splitlines()):
+                if line.startswith("{") and "env_steps_per_s" in line:
+                    return json.loads(line)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return None
+
+
 def parse_roofline(path):
     try:
         with open(path) as f:
@@ -118,6 +145,14 @@ def main():
     if roof:
         data["impala_roofline"] = dict(roof, captured_when=today)
         updated.append("impala_roofline")
+    agent = parse_agent(os.path.join(cap, "agent_bench.log"))
+    if agent:
+        data["impala_agent"] = dict(agent, captured_when=today)
+        updated.append("impala_agent")
+    pool = parse_envpool(os.path.join(cap, "envpool_atari.log"))
+    if pool:
+        data["envpool_atari"] = dict(pool, captured_when=today)
+        updated.append("envpool_atari")
 
     if not updated:
         print("fold_capture: nothing to fold (no TPU results in capture dir)")

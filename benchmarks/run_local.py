@@ -86,6 +86,17 @@ def main():
         "allreduce_rpc": _run([py, "benchmarks/allreduce_bench.py", "rpc"]),
         "allreduce_ici": ici,
         "envpool": _run([py, "benchmarks/envpool_bench.py"]),
+        # Atari geometry (84x84x4 x 128 x 2 buffers): the reference flagship
+        # actor shape — shm->host MB/s is the row that matters.
+        "envpool_atari": _run(
+            [py, "benchmarks/envpool_bench.py", "--env", "synthetic",
+             "--batch_size", "128", "--num_processes", "8", "--steps", "50"]
+        ),
+        # Whole-agent smoke row (small scale; the reference-scale number is
+        # the TPU battery's job — one CPU core can't feed the flagship shape).
+        "agent_small": _run(
+            [py, "benchmarks/agent_bench.py", "--scale", "small"], timeout=900
+        ),
     }
     out = os.path.join(ROOT, "BENCH_LOCAL.json")
     with open(out, "w") as f:

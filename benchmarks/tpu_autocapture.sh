@@ -29,6 +29,11 @@ run flash_tests 1200 env MOOLIB_RUN_TPU_TESTS=1 \
 # 5. Roofline bound analysis + profiler trace for the IMPALA step.
 run impala_roofline 900 python benchmarks/impala_roofline.py \
   --trace_dir "$OUT/impala_trace"
+# 5b. Whole-agent SPS at the reference flagship scale (act+step+learn on
+#     the chip) and EnvPool ingestion at Atari geometry.
+run agent_bench 1200 python benchmarks/agent_bench.py --scale reference
+run envpool_atari 600 python benchmarks/envpool_bench.py --env synthetic \
+  --batch_size 128 --num_processes 8 --steps 100
 # 6. Fold results into BENCH_TPU.json so bench.py's last_good_tpu picks
 #    them up even if nobody is around when the battery fires.
 run fold_capture 120 python benchmarks/fold_capture.py "$OUT" /root/repo/BENCH_TPU.json

@@ -278,9 +278,16 @@ class Accumulator:
 
     def set_debug_checksums(self, enabled: bool = True) -> None:
         """CRC32-verify every applied gradient result across the cohort
-        (reference debug checksums, ``src/accumulator.cc:324-370``).  One
-        tiny extra allreduce per gradient round; enable on every peer or on
-        none.  Divergences are logged and counted in ``debug_info()``."""
+        (reference debug checksums, ``src/accumulator.cc:324-370``).
+        Enable on every peer or on none; divergences are logged and counted
+        in ``debug_info()``.
+
+        Cost: beyond the tiny verify allreduce, every gradient round
+        synchronously copies the full result to host and CRCs it while
+        holding the accumulator lock — for large models this stalls
+        concurrent update()/reduce_gradients() callers noticeably.  A
+        debugging tool, not a production setting.
+        """
         self._debug_checksums = bool(enabled)
 
     def set_chunked_allreduce(self, enabled: Optional[bool]) -> None:

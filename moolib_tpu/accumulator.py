@@ -76,7 +76,9 @@ class _Round:
         (identical on every peer — derived from identical count results).
     """
 
-    __slots__ = ("future", "done", "result", "error", "kind", "local", "stats", "plane")
+    __slots__ = (
+        "future", "done", "result", "error", "kind", "local", "stats", "plane", "t0",
+    )
 
     def __init__(self, future, kind="full", local=None, stats=None, plane="rpc"):
         self.future = future
@@ -87,6 +89,7 @@ class _Round:
         self.local = local
         self.stats = stats
         self.plane = plane  # "rpc" (tree allreduce over DCN) | "ici" (psum)
+        self.t0 = time.monotonic()
 
 
 def _tree_nbytes(tree) -> int:
@@ -172,6 +175,12 @@ class Accumulator:
         self._use_ici = False
         self._ici_fns: Dict = {}
         self._ici_executor = None  # lazily-created single-thread FIFO
+        # A psum round whose cohort member died mid-collective can HANG in
+        # the runtime (gloo/XLA rendezvous has no membership notion). The
+        # update() pump times such rounds out so the train loop recovers on
+        # the RPC plane (SURVEY §7 hard part: elastic RPC world vs XLA's
+        # static-mesh world).
+        self._ici_timeout = 60.0
         # Observability (VERDICT r2 weak #6: plane choice must be visible):
         # completed reduction rounds per data plane, bytes contributed per
         # plane (post-compression payloads at send time), last plane used.
@@ -276,6 +285,14 @@ class Accumulator:
             self._wire_q8 = False
         self._q_residual = None
 
+    def set_ici_timeout(self, seconds: float) -> None:
+        """Age at which an in-flight ICI (psum) round is errored — but only
+        once the cohort membership no longer matches the process set (the
+        broker evicted a peer): the recovery path when a member dies
+        mid-collective and the runtime rendezvous hangs.  A slow round in a
+        healthy full cohort is never unilaterally timed out."""
+        self._ici_timeout = float(seconds)
+
     def set_debug_checksums(self, enabled: bool = True) -> None:
         """CRC32-verify every applied gradient result across the cohort
         (reference debug checksums, ``src/accumulator.cc:324-370``).
@@ -353,6 +370,14 @@ class Accumulator:
         if not self._group.active():
             return False
         return len(self._group.members()) == jax.process_count()
+
+    def _ici_eligible_locked_hint(self) -> bool:
+        """_ici_eligible for the update() sweep (caller holds the lock).
+        jax.process_count() is only safe here because an ICI round exists,
+        which means the backend initialized long ago — the FIRST backend
+        touch under jax.distributed is a cross-process rendezvous that must
+        never run under the accumulator lock."""
+        return self._ici_eligible()
 
     def parameters(self):
         """Current synced parameter pytree (jax adaptation of the reference's
@@ -618,6 +643,11 @@ class Accumulator:
         self._ici_executor.submit(self._ici_execute, round_, arrays, treedef, epoch_tag)
 
     def _ici_execute(self, round_: _Round, arrays, treedef, epoch_tag: int) -> None:
+        with self._lock:
+            # The timeout clock starts when the collective actually starts:
+            # a pipelined round queued behind another on the single-thread
+            # executor must not have its queue wait counted against it.
+            round_.t0 = time.monotonic()
         try:
             summed = self._ici_allreduce(arrays)
             ndl = jax.local_device_count()
@@ -639,12 +669,16 @@ class Accumulator:
                 "wire": None,
             }
             with self._lock:
+                if round_.done:
+                    return  # timed out by the pump while we were stuck
                 self._ici_reduces += 1
                 round_.done = True
                 round_.result = result
                 self._drain_rounds_locked()
         except Exception as e:  # noqa: BLE001 — surfaced via the round error
             with self._lock:
+                if round_.done:
+                    return  # already timed out; this is its stuck thread dying
                 round_.done = True
                 round_.error = e
                 self._drain_rounds_locked()
@@ -953,6 +987,11 @@ class Accumulator:
         plane used, current eligibility, and the wire dtype.  Accumulator-
         level analogue of the reference's ``Rpc::debugInfo`` transport dump
         (``src/rpc.cc:1599-1623``)."""
+        # _ici_eligible touches jax (process_count), whose FIRST call under
+        # jax.distributed is a cross-process rendezvous that can block for as
+        # long as peers take to touch jax — never do that holding the lock
+        # (RPC handlers like _on_request_model need it to serve peers).
+        eligible = self._ici_eligible()
         with self._lock:
             if self._wire_q8:
                 wire = "q8"
@@ -966,7 +1005,7 @@ class Accumulator:
                 "checksum_divergences": self._checksum_divergences,
                 "checksum_failures": self._checksum_failures,
                 "last_plane": self._last_plane,
-                "ici_eligible": self._ici_eligible(),
+                "ici_eligible": eligible,
                 "wire_dtype": wire,
                 "reduce_bytes": dict(self._reduce_bytes),
             }
@@ -1005,6 +1044,36 @@ class Accumulator:
             leader = self._leader
             is_leader = self._is_leader
             synced = self._epoch_synced
+            # Time out ICI rounds stranded by a cohort member dying
+            # mid-collective (the runtime rendezvous can hang forever).
+            # Gated on the membership no longer matching the process set: a
+            # round is only declared dead once the broker actually evicted a
+            # peer — a healthy-but-slow collective (first-use compile, warm
+            # barrier) never gets unilaterally timed out, which would let one
+            # peer discard a result its peers applied.  When the gate fires,
+            # the dead process can no longer complete anyone's collective, so
+            # erroring is symmetric; and the epoch change that accompanied the
+            # eviction re-elects and re-syncs the model, which reconverges any
+            # peer that raced the boundary.  The executor thread may be stuck
+            # inside the collective: abandon it (a fresh one is created on
+            # the next ICI round).
+            stuck = [
+                r for r in self._inflight
+                if r.plane == "ici" and not r.done and now - r.t0 > self._ici_timeout
+            ]
+            if stuck and not self._ici_eligible_locked_hint():
+                for round_ in stuck:
+                    round_.done = True
+                    round_.error = RpcError(
+                        f"ici reduction timed out after {self._ici_timeout:.0f}s "
+                        "with the cohort no longer matching the process set "
+                        "(member died mid-collective); falling back to the RPC plane"
+                    )
+                    utils.log_error("accumulator %s: %s", self._name, round_.error)
+                if self._ici_executor is not None:
+                    self._ici_executor.shutdown(wait=False)
+                    self._ici_executor = None
+            self._drain_rounds_locked()
             # Commit a staged model update (deferred so the user thread owns
             # the model, reference commitModelUpdate src/accumulator.cc:810-836).
             if self._staged_model is not None:

@@ -261,6 +261,8 @@ class NativeNet:
         )
         if rc != 1:  # fully copied (or error): nothing stays borrowed
             self._pinned.pop(token, None)
+        # rc -2 = conn unknown/closed at the engine: the frame did NOT go
+        # out — callers must treat it as a dead connection, not a success.
         return rc >= 0
 
     def send_memfd(self, conn_id: int, chunks) -> bool:

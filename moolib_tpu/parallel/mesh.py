@@ -53,6 +53,14 @@ def initialize_distributed(
         process_id=process_id,
         local_device_ids=local_device_ids,
     )
+    # Force backend creation NOW, while every process is at the same program
+    # point. Backend init under jax.distributed is a cross-process rendezvous
+    # (global device exchange): left lazy, the first stray jax call — e.g.
+    # process_count() on the Accumulator's reduce path — blocks that process
+    # for as long as its peers take to touch jax themselves, which stalls its
+    # broker pings and can deadlock an elastic cohort (peer A blocked in the
+    # rendezvous waiting for peer B, peer B waiting on A's RPC responses).
+    jax.devices()
 
 
 def make_mesh(

@@ -412,7 +412,7 @@ class _NativeConnection(_Connection):
                     self.bytes_out += total
                     return
         if not self.net.send_iov(self.conn_id, chunks):
-            raise RpcError("native send failed (engine destroyed)")
+            raise RpcError("native send failed (engine destroyed or conn gone)")
         self.send_count += 1
         self.bytes_out += total
 

@@ -136,10 +136,11 @@ int main() {
   Collector c2;
   void* e2 = moolib_net_create(on_accept, on_frame, on_close, on_connect,
                                on_release, &c2);
-  // Send to a nonexistent conn id: the frame drops on the calling thread and
-  // nothing pins (rc 0 tells the caller its buffers were never borrowed).
+  // Send to a nonexistent conn id: reported as -2 (dead conn) on the calling
+  // thread, nothing pins (any rc != 1 tells the caller its buffers were
+  // never borrowed).
   int rc2 = moolib_net_send_iov(e2, 999, bb, bl, 1, /*token=*/5);
-  ASSERT_TRUE(rc2 == 0);
+  ASSERT_TRUE(rc2 == -2);
   ASSERT_TRUE(c2.released.load() == 0);
 
   moolib_net_destroy(l);

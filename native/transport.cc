@@ -833,7 +833,9 @@ constexpr uint64_t kZeroCopyMin = 64 * 1024;
 // chunks coalesce into one owned segment; chunks >= kZeroCopyMin are sent
 // zero-copy — the caller keeps them alive until release_cb(token) fires
 // (token 0 = everything was copied; no release will fire). Any thread.
-// Returns 1 if the frame pins caller buffers, 0 if fully copied, -1 on error.
+// Returns 1 if the frame pins caller buffers, 0 if fully copied/queued,
+// -1 on a malformed frame, -2 if the conn is unknown/closed (the frame did
+// NOT go out; nothing was borrowed — callers should park + rediscover).
 int moolib_net_send_iov(void* ctx, int64_t conn_id, const void* const* bufs,
                         const uint64_t* lens, int32_t n, int64_t token) {
   Engine* e = static_cast<Engine*>(ctx);
@@ -866,9 +868,10 @@ int moolib_net_send_iov(void* ctx, int64_t conn_id, const void* const* bufs,
   if (!cur.owned.empty()) segs.push_back(std::move(cur));
   if (pinned) segs.back().token = token;
   if (!send_segs(e, conn_id, std::move(segs))) {
-    // Conn gone: the frame is dropped; nothing was borrowed (the caller
-    // unpins on any return != 1), matching the old drop-on-unknown-conn.
-    return 0;
+    // Conn gone: report it (-2) so the caller can park + rediscover instead
+    // of believing the frame landed. Nothing was borrowed (callers unpin on
+    // any return != 1).
+    return -2;
   }
   return pinned ? 1 : 0;
 }
@@ -879,7 +882,8 @@ int moolib_net_send_iov(void* ctx, int64_t conn_id, const void* const* bufs,
 // touching the socket buffers (reference groundwork: src/memory/memfd.cc
 // + Socket::sendFd, src/transports/socket.h:69-70). Unix-domain
 // connections only; the caller gates on the peer's capability (greeting).
-// Returns 0 on success, -1 on error (caller falls back to send_iov).
+// Returns 0 on success, -1 on an I/O error (caller falls back to send_iov),
+// -2 if the conn is unknown/closed (same code as send_iov; nothing went out).
 int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
                           const uint64_t* lens, int32_t n) {
   Engine* e = static_cast<Engine*>(ctx);
@@ -914,13 +918,14 @@ int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
   ctl.pass_fd = fd;
   segs.push_back(std::move(ctl));
   if (!send_segs(e, conn_id, std::move(segs))) {
-    close(fd);  // conn gone: frame dropped, nothing delivered
-    return 0;
+    close(fd);  // conn gone: nothing delivered — same code as send_iov
+    return -2;
   }
   return 0;
 }
 
 // Queue one frame (length prefix added here, payload copied). Any thread.
+// Returns 0 queued/sent, -1 on error (incl. unknown/closed conn).
 int moolib_net_send(void* ctx, int64_t conn_id, const void* data,
                     uint64_t len) {
   const void* bufs[1] = {data};

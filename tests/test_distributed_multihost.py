@@ -192,3 +192,209 @@ def test_single_process_ici_backend(free_port):
     finally:
         acc.close()
         broker.close()
+
+
+_KILL_WORKER = textwrap.dedent(
+    """
+    import faulthandler, os, signal, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    # If any thread wedges, its stack shows up in the rank log.
+    faulthandler.dump_traceback_later(60, repeat=True)
+
+    rank = int(sys.argv[1]); nproc = int(sys.argv[2])
+    coord_port = sys.argv[3]; broker_port = sys.argv[4]; outdir = sys.argv[5]
+
+    def mark(name):
+        with open(os.path.join(outdir, name), "w") as f:
+            f.write(str(time.time()))
+
+    from moolib_tpu import parallel
+    parallel.initialize_distributed(
+        f"127.0.0.1:{coord_port}", num_processes=nproc, process_id=rank
+    )
+    mark(f"rank{rank}_distributed_init")
+
+    import numpy as np
+    import moolib_tpu
+    from moolib_tpu import Accumulator, Broker
+
+    moolib_tpu.set_log_level("verbose")
+
+    broker = None
+    if rank == 0:
+        broker = Broker()
+        broker.set_name("broker")
+        # Short enough to evict the killed peer promptly, long enough that a
+        # multi-second XLA compile stall on this one-core box is not a
+        # spurious eviction (which would flip planes mid-test).
+        broker.set_timeout(8.0)
+        broker.listen(f"127.0.0.1:{broker_port}")
+
+    acc = Accumulator("m", {"w": np.zeros((16,), np.float32)})
+    acc.set_name(f"p{rank}")
+    acc.listen()
+    acc.set_ici_backend(True)
+    acc.set_ici_timeout(12.0)
+    acc.connect(f"127.0.0.1:{broker_port}")
+    mark(f"rank{rank}_accumulator_up")
+
+    def pump(seconds, until):
+        dl = time.time() + seconds
+        while time.time() < dl:
+            if broker is not None:
+                broker.update()
+            acc.update()
+            if acc.wants_state():
+                acc.set_state({})
+            if until():
+                return True
+            time.sleep(0.02)
+        return until()
+
+    def dump(tag):
+        print(f"== {tag} rank={rank} ==", flush=True)
+        print("group members:", acc._group.members(), "sync_id:", acc._group.sync_id(), flush=True)
+        print(acc._rpc.debug_info(), flush=True)
+        if broker is not None:
+            with broker._lock:
+                for gname, gg in broker._groups.items():
+                    ages = {n: round(time.monotonic() - m["last_ping"], 1)
+                            for n, m in gg.members.items()}
+                    print("broker group", gname, "sync", gg.sync_id,
+                          "ping_ages", ages, "active", gg.active_members, flush=True)
+            print(broker._rpc.debug_info(), flush=True)
+
+    if not pump(100, lambda: acc.connected()):
+        dump("never_connected")
+        time.sleep(20)  # let the sibling rank dump before the parent reaps
+        raise AssertionError("never connected")
+    if not pump(120, lambda: len(acc._group.members()) == nproc):
+        dump("members_never_full")
+        time.sleep(20)
+        raise AssertionError(f"members never full: {acc._group.members()}")
+
+    g = {"w": np.full((16,), float(rank + 1), np.float32)}
+
+    def reduce_until_done(seconds=120):
+        dl = time.time() + seconds
+        while time.time() < dl:
+            if broker is not None:
+                broker.update()
+            acc.update()
+            if acc.wants_state():
+                acc.set_state({})
+            if acc.has_gradients():
+                return True
+            if acc.wants_gradients():
+                acc.reduce_gradients(4, g)
+            time.sleep(0.02)
+        return acc.has_gradients()
+
+    # Phase 1: keep reducing until a round genuinely completed over ICI.
+    # Transient broker churn on a loaded one-core box can push early rounds
+    # onto the RPC plane — that elasticity is fine; the kill test just needs
+    # a proven collective world first.
+    deadline = time.time() + 180
+    while acc.debug_info()["ici_reduces"] < 1:
+        assert time.time() < deadline, f"no ici round ever completed: {acc.debug_info()}"
+        assert reduce_until_done(), "reduction stalled in phase 1"
+        acc.zero_gradients()
+
+    if rank == 1:
+        # Signal readiness for the kill, then keep the broker pings alive
+        # WITHOUT contributing to round 2: rank 0 enters the collective and
+        # blocks on our contribution that never comes; the parent SIGKILLs
+        # this process mid-rendezvous.
+        mark("rank1_ready_for_kill")
+        pump(300, lambda: False)
+        sys.exit(0)  # unreachable: the parent kills us
+
+    # Rank 0 — the survivor. Contribute the kill round once the cohort is
+    # settled: it rides ICI (the cohort matches the process set) and strands
+    # when rank 1 dies.
+    assert pump(60, lambda: len(acc._group.members()) == nproc and acc.wants_gradients())
+    t_kill = time.time()
+    acc.reduce_gradients(4, g)
+    # Recovery: the ici timeout errors the round, the broker evicts p1 (epoch
+    # change, re-election), wants_gradients() returns, and the re-contributed
+    # round rides the RPC plane. All of it driven by the normal pump loop.
+    assert reduce_until_done(90), "survivor never recovered"
+    recovery = time.time() - t_kill
+    info = acc.debug_info()
+    assert info["last_plane"] == "rpc", info
+    assert info["ici_reduces"] >= 1, info
+    assert len(acc._group.members()) == 1, acc._group.members()
+    np.testing.assert_allclose(np.asarray(acc.gradients()["w"]), 1.0)
+    acc.zero_gradients()
+    # Training continues on the RPC plane.
+    assert reduce_until_done(30), "post-recovery round failed"
+    mark("survivor_ok")
+    print(f"SURVIVOR_OK recovery={recovery:.1f}s", flush=True)
+    acc.close()
+    if broker is not None:
+        broker.close()
+    # jax's distributed runtime is NOT elastic: its coordination service
+    # notices the killed task and errors this process during interpreter
+    # shutdown. That death rattle is exactly why the framework recovers on
+    # the RPC plane — skip jax's shutdown handlers; the test verified
+    # recovery via the marks above.
+    os._exit(0)
+    """
+)
+
+
+def test_kill_peer_mid_ici_round(tmp_path):
+    """SIGKILL one of two processes while a psum round is in flight: the
+    survivor must timeout the round, fall back to the RPC plane via the
+    normal elastic machinery, and keep training — no deadlock, no stranded
+    round (VERDICT round-3 ask #5; SURVEY §7 hard part: the elastic RPC
+    world vs XLA's static-mesh world)."""
+    import time
+
+    worker = tmp_path / "kill_worker.py"
+    worker.write_text(_KILL_WORKER)
+    coord, brok = _free_port(), _free_port()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    outdir = tmp_path / "marks"
+    outdir.mkdir()
+    logs = [open(tmp_path / f"rank{r}.log", "w") for r in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(r), "2", str(coord), str(brok), str(outdir)],
+            stdout=logs[r],
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=root,
+        )
+        for r in range(2)
+    ]
+    try:
+        # Wait for rank 1 to finish its ICI round and stand by for the kill.
+        deadline = time.time() + 180
+        marker = outdir / "rank1_ready_for_kill"
+        while not marker.exists() and time.time() < deadline:
+            assert procs[0].poll() is None, "rank 0 died early"
+            assert procs[1].poll() is None, "rank 1 died early"
+            time.sleep(0.2)
+        assert marker.exists(), "rank 1 never reached the kill point"
+        # Give rank 0 a beat to enter the round-2 collective, then kill.
+        time.sleep(3.0)
+        procs[1].kill()
+        procs[0].wait(timeout=180)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    out0 = (tmp_path / "rank0.log").read_text()
+    assert procs[0].returncode == 0, f"survivor failed:\n{out0[-4000:]}"
+    assert "SURVIVOR_OK" in out0, out0[-2000:]
+    assert (outdir / "survivor_ok").exists()

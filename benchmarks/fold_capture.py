@@ -83,6 +83,26 @@ def parse_envpool(path):
     return None
 
 
+def parse_serve(path):
+    """serve_bench prints one JSON row per config (p50/p99/tokens_per_s).
+    CPU-fallback rows are refused — a tunnel dying mid-battery must not fold
+    100x-worse latencies into the chip record (same gate as parse_impala)."""
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f.read().splitlines():
+                if line.startswith("{") and "p99_ms" in line:
+                    try:
+                        row = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if row.get("platform") not in ("cpu", "unknown"):
+                        rows.append(row)
+    except OSError:
+        return None
+    return rows or None
+
+
 def parse_roofline(path):
     try:
         with open(path) as f:
@@ -153,6 +173,10 @@ def main():
     if pool:
         data["envpool_atari"] = dict(pool, captured_when=today)
         updated.append("envpool_atari")
+    serve = parse_serve(os.path.join(cap, "serve_bench.log"))
+    if serve:
+        data["lm_serve"] = {"rows": serve, "captured_when": today}
+        updated.append("lm_serve")
 
     if not updated:
         print("fold_capture: nothing to fold (no TPU results in capture dir)")

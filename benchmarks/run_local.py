@@ -97,6 +97,12 @@ def main():
         "agent_small": _run(
             [py, "benchmarks/agent_bench.py", "--scale", "small"], timeout=900
         ),
+        # Serving under load: p50/p99 + tokens/s, dynamic batching on/off,
+        # GQA sweep (VERDICT r3 ask #8).
+        "serve": _run(
+            [py, "benchmarks/serve_bench.py", "--seconds", "6", "--clients", "8"],
+            timeout=900,
+        ),
     }
     out = os.path.join(ROOT, "BENCH_LOCAL.json")
     with open(out, "w") as f:

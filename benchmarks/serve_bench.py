@@ -1,0 +1,197 @@
+"""LM serving under load: latency percentiles + throughput per config.
+
+VERDICT round-3 ask #8.  Drives ``lm_serve`` (a real server process behind
+the RPC dynamic-batching queue) with N concurrent closed-loop clients and
+reports p50/p99 request latency, requests/s, and generated tokens/s — with
+dynamic batching on vs off, and a GQA ``kv_heads`` sweep.  The reference's
+inference batching (``src/moolib.cc:1007-1178``) never had a latency number;
+this is it.
+
+One JSON line per config:
+    {"clients": 8, "dynamic_batching": true, "kv_heads": 4, "p50_ms": ...,
+     "p99_ms": ..., "requests_per_s": ..., "tokens_per_s": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+
+def _server_platform(log_path: str) -> str:
+    """The server's jax platform, parsed from its startup line — rows carry
+    it so fold_capture can refuse CPU-fallback numbers as chip results."""
+    try:
+        with open(log_path) as f:
+            m = re.search(r"\[platform=(\w+)\]", f.read())
+        return m.group(1) if m else "unknown"
+    except OSError:
+        return "unknown"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def run_config(args, dynamic: bool, kv_heads: int):
+    port = _free_port()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        PYTHONPATH=root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    cmd = [
+        sys.executable, "-m", "moolib_tpu.examples.lm_serve",
+        "--listen", f"127.0.0.1:{port}",
+        "--vocab", str(args.vocab),
+        "--seq_len", str(args.seq_len),
+        "--d_model", str(args.d_model),
+        "--layers", str(args.layers),
+        "--heads", str(args.heads),
+        "--kv_heads", str(kv_heads),
+        "--max_new_tokens", str(args.max_new_tokens),
+    ]
+    if not dynamic:
+        cmd.append("--no_dynamic_batching")
+    # Log to a file, not a pipe: the server outlives the bench window and a
+    # full pipe would wedge it mid-measurement.
+    log_path = f"/tmp/serve_bench_{port}.log"
+    with open(log_path, "w") as log:
+        # Own session: if serve_bench itself is SIGTERMed (battery timeout),
+        # killpg below still reaps the server — an orphaned forever-serving
+        # process would hold the chip and starve every later bench.
+        server = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                  text=True, env=env, cwd=root,
+                                  start_new_session=True)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with open(log_path) as f:
+                if "serving" in f.read():
+                    break
+            if server.poll() is not None:
+                raise RuntimeError(f"server died: {open(log_path).read()[-2000:]}")
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("server never came up")
+
+        import numpy as np
+
+        from moolib_tpu import Rpc
+
+        rpc = Rpc()
+        rpc.set_name("bench_client")
+        rpc.set_timeout(120)
+        rpc.connect(f"127.0.0.1:{port}")
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(2, args.vocab, args.seq_len).astype(np.int32)
+        # Warm: first call compiles the generate step server-side.
+        rpc.sync("lm_server", "generate", prompt)
+
+        latencies: list = []
+        failures: list = []
+        lock = threading.Lock()
+        stop = time.time() + args.seconds
+
+        def client_loop(seed):
+            r = np.random.default_rng(seed)
+            while time.time() < stop:
+                p = r.integers(2, args.vocab, args.seq_len).astype(np.int32)
+                t0 = time.perf_counter()
+                try:
+                    out = rpc.sync("lm_server", "generate", p)
+                    if len(out) != args.seq_len + args.max_new_tokens:
+                        raise RuntimeError(f"bad output length {len(out)}")
+                except Exception as e:  # noqa: BLE001 — a dead client thread
+                    # would silently skew the closed-loop percentiles
+                    with lock:
+                        failures.append(str(e))
+                    return
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+
+        threads = [
+            threading.Thread(target=client_loop, args=(i,), daemon=True)
+            for i in range(args.clients)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        rpc.close()
+        if failures or not latencies:
+            raise RuntimeError(
+                f"{len(failures)}/{args.clients} clients failed "
+                f"({latencies and len(latencies)} requests completed): "
+                + "; ".join(failures[:3])
+            )
+        lat = np.sort(np.asarray(latencies))
+        row = {
+            "platform": _server_platform(log_path),
+            "clients": args.clients,
+            "dynamic_batching": dynamic,
+            "kv_heads": kv_heads,
+            "requests": int(lat.size),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 1),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 1),
+            "requests_per_s": round(lat.size / wall, 1),
+            "tokens_per_s": round(lat.size * args.max_new_tokens / wall, 1),
+        }
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        import signal
+
+        try:
+            os.killpg(server.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            server.kill()
+        server.wait()
+        try:
+            os.unlink(log_path)
+        except OSError:
+            pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--seconds", type=float, default=10.0, help="load window per config")
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--seq_len", type=int, default=16)
+    p.add_argument("--d_model", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv_heads", type=int, nargs="+", default=[4, 1],
+                   help="GQA sweep (heads value = plain MHA)")
+    p.add_argument("--max_new_tokens", type=int, default=16)
+    args = p.parse_args(argv)
+
+    cfg = (
+        f"# lm_serve load: d={args.d_model} L={args.layers} H={args.heads} "
+        f"T={args.seq_len}+{args.max_new_tokens} clients={args.clients} "
+        f"window={args.seconds}s"
+    )
+    print(cfg, flush=True)
+    for kv in args.kv_heads:
+        run_config(args, dynamic=True, kv_heads=kv)
+    # Batching-off baseline at the MHA config only (the comparison row).
+    run_config(args, dynamic=False, kv_heads=args.heads)
+
+
+if __name__ == "__main__":
+    main()

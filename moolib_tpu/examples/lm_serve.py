@@ -44,15 +44,26 @@ def make_model(flags):
 
 
 def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate",
-          batch_size: int = 16, total=None, mesh=None):
+          batch_size: int = 16, total=None, mesh=None, dynamic_batching: bool = True):
     """Coroutine serving ``total`` prompts (None = forever).  Returns the
     number of *service iterations* — with concurrent callers this is smaller
     than the prompt count, which is the point of dynamic batching.
 
     ``mesh``: serve tensor-parallel — the generate step runs sharded over
     the mesh (params via ``parallel.auto_shardings``), so one server peer
-    can front a model larger than a single chip's HBM."""
-    queue = rpc.define_queue(name, batch_size=batch_size, dynamic_batching=True)
+    can front a model larger than a single chip's HBM.  ``dynamic_batching``
+    off serves one call per iteration (the serve_bench baseline).
+
+    Dynamic batches are PADDED to ``batch_size`` before the jitted generate:
+    XLA compiles per shape, so letting the batch dimension float would turn
+    every new queue depth into a multi-second compile (measured as 100x p99
+    spikes in serve_bench).  Fixed shape = one compile, a little wasted
+    compute on pad rows — the right trade on an accelerator."""
+    queue = rpc.define_queue(
+        name,
+        batch_size=batch_size if dynamic_batching else None,
+        dynamic_batching=dynamic_batching,
+    )
     if mesh is not None:
         # Built ONCE: the returned fn is a plain jit, so repeated batches of
         # the same prompt shape hit the compile cache.
@@ -70,10 +81,16 @@ def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate
             single = prompts.ndim == 1
             if single:
                 prompts = prompts[None]
-            served += prompts.shape[0]
+            n = prompts.shape[0]
+            served += n
             iterations += 1
+            if dynamic_batching and n < batch_size:
+                pad = np.repeat(prompts[-1:], batch_size - n, axis=0)
+                batch = np.concatenate([prompts, pad], axis=0)
+            else:
+                batch = prompts
             try:
-                out = np.asarray(jgen(params, jnp.asarray(prompts)))
+                out = np.asarray(jgen(params, jnp.asarray(batch)))[:n]
             except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
                 ret_cb.error(f"generate failed: {e}")
                 continue
@@ -106,6 +123,10 @@ def main(argv=None):
         "(server side only; params sharded via auto_shardings)",
     )
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--no_dynamic_batching", action="store_true",
+        help="serve one call per iteration (latency baseline for serve_bench)",
+    )
     flags = p.parse_args(argv)
     if (flags.listen is None) == (flags.connect is None):
         raise SystemExit("pass exactly one of --listen / --connect")
@@ -124,9 +145,16 @@ def main(argv=None):
         rpc = Rpc()
         rpc.set_name("lm_server")
         rpc.listen(flags.listen)
-        print(f"serving 'generate' on {flags.listen}", flush=True)
+        print(
+            f"serving 'generate' on {flags.listen} "
+            f"[platform={jax.devices()[0].platform}]",
+            flush=True,
+        )
         try:
-            asyncio.run(serve(rpc, model, params, flags.max_new_tokens, mesh=mesh))
+            asyncio.run(serve(
+                rpc, model, params, flags.max_new_tokens, mesh=mesh,
+                dynamic_batching=not flags.no_dynamic_batching,
+            ))
         finally:
             rpc.close()
     else:

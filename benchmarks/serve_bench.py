@@ -136,7 +136,7 @@ def run_config(args, dynamic: bool, kv_heads: int):
         if failures or not latencies:
             raise RuntimeError(
                 f"{len(failures)}/{args.clients} clients failed "
-                f"({latencies and len(latencies)} requests completed): "
+                f"({len(latencies)} requests completed): "
                 + "; ".join(failures[:3])
             )
         lat = np.sort(np.asarray(latencies))
@@ -187,10 +187,19 @@ def main(argv=None):
         f"window={args.seconds}s"
     )
     print(cfg, flush=True)
-    for kv in args.kv_heads:
-        run_config(args, dynamic=True, kv_heads=kv)
+    failed = 0
+    configs = [(True, kv) for kv in args.kv_heads]
     # Batching-off baseline at the MHA config only (the comparison row).
-    run_config(args, dynamic=False, kv_heads=args.heads)
+    configs.append((False, args.heads))
+    for dynamic, kv in configs:
+        try:
+            run_config(args, dynamic=dynamic, kv_heads=kv)
+        except Exception as e:  # noqa: BLE001 — one bad config must not
+            # abort the rest of the sweep (the battery folds partial tables)
+            failed += 1
+            print(f"# config dynamic={dynamic} kv={kv} FAILED: {e}", flush=True)
+    if failed == len(configs):
+        raise SystemExit("every serve config failed")
 
 
 if __name__ == "__main__":

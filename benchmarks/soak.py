@@ -1,0 +1,234 @@
+"""Churn soak: the flagship agent under sustained peer kill/restart.
+
+VERDICT round-3 ask #9 — elasticity as a flagship property (reference
+``src/broker.h:130-237``): N vtrace agent peers train against one broker
+while a killer SIGKILLs a random peer every ``--kill_interval`` seconds and
+restarts it.  The soak asserts, continuously:
+
+- **progress**: the cohort-global step high-water mark keeps advancing —
+  no stall longer than ``--stall_bound`` seconds;
+- **consistency**: at the end, every surviving peer's model version is
+  within a small window of the cohort max (stragglers mid-resync allowed).
+
+Writes a JSON summary line; ``--out`` also saves it to a file.
+
+    python benchmarks/soak.py --seconds 600 --kill_interval 30 --peers 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _spawn_worker(i: int, addr: str, outdir: str, args) -> subprocess.Popen:
+    env = dict(
+        os.environ,
+        PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+    )
+    localdir = os.path.join(outdir, f"p{i}")
+    os.makedirs(localdir, exist_ok=True)
+    log = open(os.path.join(outdir, f"p{i}.log"), "a")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "moolib_tpu.examples.vtrace.experiment",
+            "--env", "catch",
+            "--connect", addr,
+            "--local_name", f"p{i}",
+            "--localdir", localdir,
+            "--total_steps", "1000000000",
+            "--actor_batch_size", str(args.actor_batch_size),
+            "--num_actor_batches", "2",
+            "--batch_size", str(args.batch_size),
+            "--virtual_batch_size", str(args.virtual_batch_size),
+            "--num_env_processes", "2",
+            "--stats_interval", "2",
+            "--log_interval", "2",
+            "--quiet",
+        ],
+        stdout=log,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=ROOT,
+        start_new_session=True,  # killpg reaps the EnvPool workers too
+    )
+
+
+def _last_tsv_row(outdir: str, i: int, fresher_than: float = 0.0):
+    """Last TSV row for peer i, or None; ``fresher_than`` filters out rows a
+    restarted peer wrote before it died (the file is append-mode across
+    incarnations)."""
+    path = os.path.join(outdir, f"p{i}", "logs.tsv")
+    try:
+        if fresher_than and os.path.getmtime(path) <= fresher_than:
+            return None
+        with open(path) as f:
+            rows = list(csv.DictReader(f, delimiter="\t"))
+        return rows[-1] if rows else None
+    except OSError:
+        return None
+
+
+def _kill(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (OSError, ProcessLookupError):
+        proc.kill()
+    proc.wait()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--seconds", type=float, default=600.0)
+    p.add_argument("--kill_interval", type=float, default=30.0)
+    p.add_argument("--peers", type=int, default=4)
+    p.add_argument("--stall_bound", type=float, default=120.0,
+                   help="max seconds without global-step progress")
+    p.add_argument("--version_window", type=int, default=20,
+                   help="allowed final model-version spread (stragglers mid-resync)")
+    p.add_argument("--actor_batch_size", type=int, default=8)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--virtual_batch_size", type=int, default=8)
+    p.add_argument("--outdir", default="/tmp/moolib_soak")
+    p.add_argument("--out", default=None, help="write the summary JSON here too")
+    args = p.parse_args(argv)
+
+    outdir = args.outdir
+    os.makedirs(outdir, exist_ok=True)
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    # Broker in-process: the soak's single fixed point (the reference runs
+    # the broker standalone the same way).
+    from moolib_tpu import Broker
+
+    broker = Broker()
+    broker.set_name("broker")
+    broker.set_timeout(10.0)
+    broker.listen(addr)
+
+    workers = {i: _spawn_worker(i, addr, outdir, args) for i in range(args.peers)}
+    kills = 0
+    high_water = 0.0
+    last_progress = time.time()
+    stall_max = 0.0
+    t_end = time.time() + args.seconds
+    next_kill = time.time() + args.kill_interval
+    rng = random.Random(0)
+    ok, failure = True, None
+
+    try:
+        while time.time() < t_end:
+            broker.update()
+            time.sleep(0.25)
+            now = time.time()
+            # A worker that died on its own is a soak failure.
+            for i, proc in workers.items():
+                if proc.poll() is not None:
+                    ok, failure = False, f"worker p{i} exited rc={proc.returncode}"
+                    break
+            if not ok:
+                break
+            # Progress: cohort-global steps are allreduced into every peer's
+            # stats, so the max over current TSV tails is the high-water.
+            steps = []
+            for i in workers:
+                row = _last_tsv_row(outdir, i)
+                if row and row.get("steps_done"):
+                    try:
+                        steps.append(float(row["steps_done"]))
+                    except ValueError:
+                        pass
+            if steps and max(steps) > high_water:
+                high_water = max(steps)
+                last_progress = now
+            stall = now - last_progress
+            stall_max = max(stall_max, stall)
+            if stall > args.stall_bound:
+                ok, failure = False, f"no progress for {stall:.0f}s (bound {args.stall_bound:.0f}s)"
+                break
+            if now >= next_kill and now + 15 < t_end:
+                next_kill = now + args.kill_interval
+                victim = rng.choice(list(workers))
+                _kill(workers[victim])
+                kills += 1
+                workers[victim] = _spawn_worker(victim, addr, outdir, args)
+                print(
+                    f"[{now - (t_end - args.seconds):6.0f}s] killed+restarted p{victim} "
+                    f"(kill #{kills}, high_water={high_water:.0f}, "
+                    f"max_stall={stall_max:.0f}s)",
+                    flush=True,
+                )
+        # Final consistency: give the cohort a settle window (a just-restarted
+        # peer needs jax import + compile before its first row), then compare
+        # model versions across rows written AFTER the soak window — stale
+        # pre-kill rows in a restarted peer's append-mode TSV don't count.
+        settle_start = time.time()
+        settle_end = settle_start + 120
+        versions = {}
+        while time.time() < settle_end:
+            broker.update()
+            time.sleep(0.25)
+            versions = {}
+            for i in workers:
+                row = _last_tsv_row(outdir, i, fresher_than=settle_start)
+                if row and row.get("model_version"):
+                    try:
+                        versions[i] = int(float(row["model_version"]))
+                    except ValueError:
+                        pass
+            if len(versions) == len(workers) and max(versions.values()) - min(versions.values()) <= args.version_window:
+                break
+        if ok:
+            if len(versions) < len(workers):
+                ok, failure = False, f"only {len(versions)}/{len(workers)} peers reported versions"
+            elif max(versions.values()) - min(versions.values()) > args.version_window:
+                ok, failure = False, f"version spread {versions} > {args.version_window}"
+    finally:
+        for proc in workers.values():
+            _kill(proc)
+        broker.close()
+
+    summary = {
+        "metric": "churn_soak",
+        "ok": ok,
+        "failure": failure,
+        "seconds": args.seconds,
+        "peers": args.peers,
+        "kills": kills,
+        "kill_interval_s": args.kill_interval,
+        "global_steps_high_water": high_water,
+        "max_stall_s": round(stall_max, 1),
+        "stall_bound_s": args.stall_bound,
+        "final_model_versions": versions,
+        "env": "catch",
+    }
+    print(json.dumps(summary), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+            f.write("\n")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

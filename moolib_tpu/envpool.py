@@ -228,7 +228,8 @@ class EnvRunner:
     """Worker-process loop: owns envs [lo, hi) of every batch (reference
     ``EnvRunner::run`` ``src/env.h:407-453``)."""
 
-    def __init__(self, create_env, worker_index, lo, hi, num_batches, conn, task_queue, done_sems):
+    def __init__(self, create_env, worker_index, lo, hi, num_batches, conn,
+                 task_queue, done_sems, discover: bool = False):
         self.create_env = create_env
         self.worker_index = worker_index
         self.lo = lo
@@ -237,6 +238,7 @@ class EnvRunner:
         self.conn = conn
         self.task_queue = task_queue
         self.done_sems = done_sems
+        self.discover = discover
         self.envs: Dict[Tuple[int, int], Any] = {}
         self._running = False
 
@@ -248,6 +250,25 @@ class EnvRunner:
         return self._running
 
     def run(self) -> None:
+        if self.discover:
+            # Spec discovery happens in THIS worker's first real env: the shm
+            # batch layout derives from its reset observation (reference
+            # allocateBatch-from-first-obs, ``src/env.h:214-246``) and the
+            # env is kept for stepping — no throwaway probe process.
+            try:
+                env = self.create_env()
+                obs = _normalize_obs(_reset_env(env))
+                spec = {k: (v.shape, v.dtype.str) for k, v in obs.items()}
+                self.conn.send(("ok", spec))
+                if self.lo < self.hi and self.num_batches > 0:
+                    self.envs[(0, self.lo)] = env  # freshly reset; first
+                    # step() on this slot steps it like the lazy path would
+            except Exception as e:  # noqa: BLE001 — parent raises it
+                try:
+                    self.conn.send(("error", repr(e)))
+                except Exception:
+                    pass
+                return
         # Wait for the parent to send the shm layout (created after spec
         # discovery), then serve step requests until shutdown.
         try:
@@ -322,29 +343,18 @@ class EnvRunner:
             view["done"][i] = done
 
 
-def _worker_main(create_env, worker_index, lo, hi, num_batches, conn, doorbells):
+def _worker_main(create_env, worker_index, lo, hi, num_batches, conn, doorbells,
+                 discover=False):
     task_queue, done_sems, seg = _attach_doorbells(doorbells, worker_index)
     runner = EnvRunner(
-        create_env, worker_index, lo, hi, num_batches, conn, task_queue, done_sems
+        create_env, worker_index, lo, hi, num_batches, conn, task_queue,
+        done_sems, discover=discover,
     )
     try:
         runner.start()
     finally:
         if seg is not None:
             seg.close()
-
-
-def _spec_probe(create_env, conn):
-    """Short-lived child: discover the observation spec without polluting the
-    parent with env state (reference allocates the batch layout from the
-    first obs dict, ``src/env.h:214-246``)."""
-    try:
-        env = create_env()
-        obs = _normalize_obs(_reset_env(env))
-        spec = {k: (v.shape, v.dtype.str) for k, v in obs.items()}
-        conn.send(("ok", spec))
-    except Exception as e:  # noqa: BLE001
-        conn.send(("error", repr(e)))
 
 
 class EnvStepperFuture:
@@ -476,17 +486,48 @@ class EnvPool:
                 ) from e
         ctx = mp.get_context(start)
 
-        # 1. Spec discovery in a throwaway child.
-        parent_conn, child_conn = ctx.Pipe()
-        probe = ctx.Process(target=_spec_probe, args=(create_env, child_conn), daemon=True)
-        probe.start()
-        if not parent_conn.poll(60):
-            probe.terminate()
-            raise RuntimeError("EnvPool: env spec probe timed out")
-        status, spec = parent_conn.recv()
-        probe.join()
+        # 1. Spawn worker 0 first: it discovers the observation spec from its
+        # own first env (which it keeps and steps) — the shm layout derives
+        # from a real first observation, reference ``src/env.h:214-246``.
+        self._task_queues, self._done_sems, self._doorbell_region, doorbell_desc = (
+            _make_doorbells(ctx, num_processes, num_batches)
+        )
+        per = batch_size // num_processes
+        extra = batch_size % num_processes
+        bounds = []
+        lo = 0
+        for w in range(num_processes):
+            hi = lo + per + (1 if w < extra else 0)
+            bounds.append((lo, hi))
+            lo = hi
+
+        def spawn(w, discover=False):
+            pconn, cconn = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker_main,
+                args=(
+                    create_env,
+                    w,
+                    bounds[w][0],
+                    bounds[w][1],
+                    num_batches,
+                    cconn,
+                    _worker_doorbell_desc(doorbell_desc, w),
+                    discover,
+                ),
+                daemon=True,
+            )
+            p.start()
+            return p, pconn
+
+        p0, p0conn = spawn(0, discover=True)
+        self._procs = [p0]
+        self._worker_conns = [p0conn]
+        if not p0conn.poll(60):
+            raise RuntimeError("EnvPool: env spec discovery timed out")
+        status, spec = p0conn.recv()
         if status != "ok":
-            raise RuntimeError(f"EnvPool: create_env failed in probe process: {spec}")
+            raise RuntimeError(f"EnvPool: create_env failed in worker 0: {spec}")
         for key in _FIELD_RESERVED:
             if key in spec:
                 raise ValueError(f"observation key {key!r} is reserved")
@@ -522,36 +563,14 @@ class EnvPool:
             self._act_views.append(av)
             layout_act.append((seg.name, act_shape, np.dtype(action_dtype).str))
 
-        # 3. Spawn workers, hand each its env slice + the shm layout.
-        self._task_queues, self._done_sems, self._doorbell_region, doorbell_desc = (
-            _make_doorbells(ctx, num_processes, num_batches)
-        )
-        self._procs: List = []
-        self._worker_conns: List = []
-        per = batch_size // num_processes
-        extra = batch_size % num_processes
-        lo = 0
-        for w in range(num_processes):
-            hi = lo + per + (1 if w < extra else 0)
-            pconn, cconn = ctx.Pipe()
-            p = ctx.Process(
-                target=_worker_main,
-                args=(
-                    create_env,
-                    w,
-                    lo,
-                    hi,
-                    num_batches,
-                    cconn,
-                    _worker_doorbell_desc(doorbell_desc, w),
-                ),
-                daemon=True,
-            )
-            p.start()
-            pconn.send({"obs": layout_obs, "act": layout_act})
+        # 3. Ship the layout to worker 0 and spawn the rest with it.
+        layout = {"obs": layout_obs, "act": layout_act}
+        p0conn.send(layout)
+        for w in range(1, num_processes):
+            p, pconn = spawn(w)
+            pconn.send(layout)
             self._procs.append(p)
             self._worker_conns.append(pconn)
-            lo = hi
         self._stepper = EnvStepper(self)
 
     def _check_workers(self) -> None:
@@ -591,6 +610,14 @@ class EnvPool:
         for q in self._task_queues:
             try:
                 q.put(_SHUTDOWN)
+            except Exception:
+                pass
+        # Close the pipes first: a worker still blocked in its layout recv
+        # (ctor failed between spec discovery and layout send) wakes with
+        # EOFError and exits instead of eating the 5 s join timeout.
+        for conn in self._worker_conns:
+            try:
+                conn.close()
             except Exception:
                 pass
         for p in self._procs:

@@ -632,6 +632,7 @@ def train(flags, on_stats=None) -> dict:
                         reduce_plane=adbg["last_plane"],
                         ici_reduces=adbg["ici_reduces"],
                         rpc_reduces=adbg["rpc_reduces"],
+                        model_version=accumulator.model_version(),
                     )
                     if tsv is not None:
                         tsv.log(**row)

@@ -106,7 +106,7 @@ def _make_bad():
 
 
 def test_bad_env_raises():
-    with pytest.raises(RuntimeError, match="probe process"):
+    with pytest.raises(RuntimeError, match="failed in worker 0"):
         EnvPool(_make_bad, num_processes=1, batch_size=1, num_batches=1)
 
 

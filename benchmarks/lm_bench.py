@@ -34,8 +34,11 @@ def main():
 
     from moolib_tpu.models.transformer import TransformerLM
 
-    if jax.default_backend() == "cpu":
-        raise SystemExit("lm_bench needs an accelerator backend")
+    if jax.default_backend() == "cpu" and os.environ.get("MOOLIB_ALLOW_CPU") != "1":
+        raise SystemExit(
+            "lm_bench needs an accelerator backend "
+            "(MOOLIB_ALLOW_CPU=1 for a labeled plumbing-proof run)"
+        )
     dev = jax.devices()[0]
     peak = next((p for s, p in _PEAK if s in dev.device_kind.lower()), None)
     # Model scale is env-tunable; the default (d=1024, L=12, ~220M params)
@@ -52,10 +55,19 @@ def main():
     rows = []
     # (T, B, remat): constant 16k-token steps, plus remat rows at long T
     # where checkpointing lets the batch double within the same HBM.
-    for T, B, remat in (
-        (1024, 16, False), (2048, 8, False), (4096, 4, False),
-        (4096, 8, True), (8192, 2, False), (8192, 4, True),
-    ):
+    # MOOLIB_LM_CONFIGS="T,B,remat;..." overrides (CPU plumbing runs).
+    cfg_env = os.environ.get("MOOLIB_LM_CONFIGS")
+    if cfg_env:
+        configs = [
+            (int(t), int(b), r.strip().lower() in ("1", "true"))
+            for t, b, r in (c.split(",") for c in cfg_env.split(";") if c.strip())
+        ]
+    else:
+        configs = [
+            (1024, 16, False), (2048, 8, False), (4096, 4, False),
+            (4096, 8, True), (8192, 2, False), (8192, 4, True),
+        ]
+    for T, B, remat in configs:
         model = TransformerLM(
             vocab_size=32768, d_model=D, num_heads=H, num_kv_heads=KV,
             num_layers=L, max_len=8192, attention="flash",

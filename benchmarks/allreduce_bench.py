@@ -51,16 +51,41 @@ def bench_rpc(args):
         pump = lambda: (broker.update(), [g.update() for _, g in peers])
         groups = [g for _, g in peers]
     else:
-        raise SystemExit(
-            "multi-process mode: run one process per rank with RANK set and "
-            "rank 0 also running `python -m moolib_tpu.broker`"
+        # Multi-process/multi-host mode (the reference's env-var pattern,
+        # test/test_multinode_allreduce.cc:155-181): one process per rank,
+        # WORLD_SIZE/RANK set, rank 0 hosts the broker.  Every rank runs the
+        # same rows; each prints its own table (rank 0's is the record).
+        rank = int(rank)
+        broker = None
+        if rank == 0:
+            broker = Broker()
+            broker.set_name("broker")
+            host, _, port = broker_addr.rpartition(":")
+            broker.listen(f":{port}" if host in ("", "127.0.0.1", "0.0.0.0") else broker_addr)
+        rpc = Rpc()
+        rpc.set_name(f"rank{rank}")
+        rpc.listen(":0")
+        rpc.connect(broker_addr)
+        g = Group(rpc, "bench")
+        g.set_timeout(120)
+        peers = [(rpc, g)]
+        groups = [g]
+
+        def pump():
+            if broker is not None:
+                broker.update()
+            g.update()
+
+    def converged():
+        return all(
+            g.active() and len(g.members()) == world_size for g in groups
         )
 
-    deadline = time.time() + 30
-    while not all(g.active() for g in groups) and time.time() < deadline:
+    deadline = time.time() + 120
+    while not converged() and time.time() < deadline:
         pump()
         time.sleep(0.01)
-    assert all(g.active() for g in groups), "cohort never converged"
+    assert converged(), f"cohort never converged: {[g.members() for g in groups]}"
 
     def wait(futs):
         # Throttled pumping: the IO engines and reduce math run on their own
@@ -78,7 +103,8 @@ def bench_rpc(args):
         )
         print(f"{'elems':>10} {'MB':>8} {'ms':>9} {'MB/s':>10} {'max_peer_tx_MB':>15}")
         for size in args.sizes:
-            data = [np.random.randn(size).astype(np.float32) for _ in range(world_size)]
+            # One array per local peer (multi-process mode has exactly one).
+            data = [np.random.randn(size).astype(np.float32) for _ in peers]
             futs = [g.all_reduce("w" + algo, d) for g, d in zip(groups, data)]
             wait(futs)  # warmup round
             before = [rpc.transport_stats()["tx_bytes"] for rpc, _ in peers]
@@ -90,7 +116,16 @@ def bench_rpc(args):
                     f.result(0)
             dt = (time.perf_counter() - t0) / args.iters
             after = [rpc.transport_stats()["tx_bytes"] for rpc, _ in peers]
-            max_tx = max(a - b for a, b in zip(after, before)) / args.iters / 1e6
+            local_max = max(a - b for a, b in zip(after, before)) / args.iters / 1e6
+            # The busiest-PEER number must span the whole cohort: in
+            # multi-process mode each process sees only its own counters, so
+            # max-allreduce the local figure (tiny scalar, tree path).
+            mfuts = [
+                g.all_reduce(f"tx{algo}{size}", local_max, op=lambda a, b: max(a, b))
+                for g in groups
+            ]
+            wait(mfuts)
+            max_tx = max(f.result(0) for f in mfuts)
             mb = size * 4 / 1e6
             print(
                 f"{size:>10} {mb:>8.2f} {dt*1e3:>9.2f} {mb/dt:>10.1f} {max_tx:>15.2f}"
@@ -98,9 +133,12 @@ def bench_rpc(args):
 
     run_rows("tree", "99999999999999")
     run_rows("ring", "0")
+    # Exit barrier: no rank tears down while another is mid-row.
+    wait([g.all_reduce("bye", 1) for g in groups])
     for rpc, _ in peers:
         rpc.close()
-    broker.close()
+    if broker is not None:
+        broker.close()
 
 
 def bench_ici(args):

@@ -32,12 +32,16 @@ def parse_impala(path):
 
 
 def parse_lm(path):
-    """lm_bench prints one {'lm_train': {...}} JSON line at the end."""
+    """lm_bench prints one {'lm_train': {...}} JSON line at the end.  CPU
+    plumbing runs (MOOLIB_ALLOW_CPU=1) are refused — same gate as every
+    other parser here (older captures without a platform field predate the
+    CPU escape hatch and are genuine chip rows)."""
     try:
         with open(path) as f:
             for line in reversed(f.read().splitlines()):
                 if line.startswith("{") and "lm_train" in line:
-                    return json.loads(line)["lm_train"]
+                    row = json.loads(line)["lm_train"]
+                    return row if row.get("platform", "tpu") != "cpu" else None
     except (OSError, json.JSONDecodeError, KeyError):
         return None
     return None

@@ -33,7 +33,11 @@ def main():
     import optax
 
     from moolib_tpu.models.transformer import TransformerLM
+    from moolib_tpu.utils import apply_platform_env
 
+    # Honor JAX_PLATFORMS over a sitecustomized backend pin — a CPU plumbing
+    # run must not hang in a dead accelerator tunnel's backend init.
+    apply_platform_env()
     if jax.default_backend() == "cpu" and os.environ.get("MOOLIB_ALLOW_CPU") != "1":
         raise SystemExit(
             "lm_bench needs an accelerator backend "
@@ -68,9 +72,12 @@ def main():
             (4096, 8, True), (8192, 2, False), (8192, 4, True),
         ]
     for T, B, remat in configs:
+        # MOOLIB_LM_ATTENTION=dense for CPU plumbing runs: pallas interpret
+        # mode is orders of magnitude too slow to even smoke-test there.
         model = TransformerLM(
             vocab_size=32768, d_model=D, num_heads=H, num_kv_heads=KV,
-            num_layers=L, max_len=8192, attention="flash",
+            num_layers=L, max_len=8192,
+            attention=os.environ.get("MOOLIB_LM_ATTENTION", "flash"),
             dtype=jnp.bfloat16, remat=remat,
         )
         rng = np.random.default_rng(T)
@@ -125,14 +132,18 @@ def main():
         # Standard 6*N*D transformer FLOPs (fwd+bwd) + attention term
         # 12*L*H*hd*T^2... keep the 6ND convention and report it as such.
         flops = 6.0 * n_params * B * T
-        mfu = flops / sec / peak if peak else float("nan")
+        # None (json null) when no peak is known (CPU plumbing runs): NaN
+        # would make the JSON line unparseable for strict consumers.
+        mfu = flops / sec / peak if peak else None
         print(f"{T:>6} {B:>3} {str(remat):>5} {sec * 1e3:>9.2f} "
-              f"{tokens_s:>10.0f} {mfu:>6.3f}")
+              f"{tokens_s:>10.0f} {mfu if mfu is None else round(mfu, 3):>6}")
         rows.append(
             {"T": T, "B": B, "remat": remat, "step_ms": round(sec * 1e3, 2),
-             "tokens_per_s": round(tokens_s, 1), "mfu_6nd": round(mfu, 4)}
+             "tokens_per_s": round(tokens_s, 1),
+             "mfu_6nd": None if mfu is None else round(mfu, 4)}
         )
     print(json.dumps({"lm_train": {
+        "platform": dev.platform, "device_kind": dev.device_kind,
         "d_model": D, "layers": L, "kv_heads": KV or H, "rows": rows}}))
 
 

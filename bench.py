@@ -199,6 +199,17 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
         peak = _peak_for(device.device_kind)
         if peak:
             out["mfu"] = round(flops_per_step * timed / dt / peak, 4)
+            try:
+                sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+                from impala_roofline import analytic_mxu_ceiling
+
+                ceiling = analytic_mxu_ceiling()["weighted_mxu_ceiling"]
+                # The 16/32-channel convs cap MXU lane occupancy; MFU is only
+                # meaningful against this geometry ceiling (docs/PERF.md).
+                out["mfu_geometry_ceiling"] = ceiling
+                out["mfu_vs_ceiling"] = round(out["mfu"] / ceiling, 3)
+            except Exception:  # noqa: BLE001 — ceiling context is best-effort
+                pass
     return out
 
 

@@ -4,7 +4,7 @@
 # step is individually time-boxed, and steps are ordered by artifact value
 # so a tunnel that dies mid-battery still leaves the headline numbers.
 set -u
-OUT=${1:-/root/repo/BENCH_CAPTURE_r04}
+OUT=${1:-/root/repo/BENCH_CAPTURE_r05}
 mkdir -p "$OUT"
 cd /root/repo
 

@@ -94,8 +94,11 @@ def bench_rpc(args):
             pump()
             time.sleep(0.002)
 
-    def run_rows(algo: str, threshold: str):
-        os.environ["MOOLIB_RING_THRESHOLD"] = threshold
+    def run_rows(algo: str):
+        # chunked= forces the path: the auto rule (Group.ring_auto) would
+        # keep a same-host loopback cohort on the tree, and the bench's job
+        # is to measure BOTH algorithms wherever it runs.
+        chunked = algo == "ring"
         print(
             f"# rpc {algo} allreduce, {world_size} peers, loopback "
             f"(max_peer_tx = busiest peer's wire bytes per op; the ring "
@@ -105,12 +108,12 @@ def bench_rpc(args):
         for size in args.sizes:
             # One array per local peer (multi-process mode has exactly one).
             data = [np.random.randn(size).astype(np.float32) for _ in peers]
-            futs = [g.all_reduce("w" + algo, d) for g, d in zip(groups, data)]
+            futs = [g.all_reduce("w" + algo, d, chunked=chunked) for g, d in zip(groups, data)]
             wait(futs)  # warmup round
             before = [rpc.transport_stats()["tx_bytes"] for rpc, _ in peers]
             t0 = time.perf_counter()
             for _ in range(args.iters):
-                futs = [g.all_reduce("x" + algo, d) for g, d in zip(groups, data)]
+                futs = [g.all_reduce("x" + algo, d, chunked=chunked) for g, d in zip(groups, data)]
                 wait(futs)
                 for f in futs:
                     f.result(0)
@@ -131,8 +134,8 @@ def bench_rpc(args):
                 f"{size:>10} {mb:>8.2f} {dt*1e3:>9.2f} {mb/dt:>10.1f} {max_tx:>15.2f}"
             )
 
-    run_rows("tree", "99999999999999")
-    run_rows("ring", "0")
+    run_rows("tree")
+    run_rows("ring")
     # Exit barrier: no rank tears down while another is mid-row.
     wait([g.all_reduce("bye", 1) for g in groups])
     for rpc, _ in peers:

@@ -68,7 +68,8 @@ class Broker:
         return self._rpc
 
     # service -----------------------------------------------------------------
-    def _on_ping(self, group_name: str, peer_name: str, sort_order: int, client_sync_id):
+    def _on_ping(self, group_name: str, peer_name: str, sort_order: int, client_sync_id,
+                 host: Optional[str] = None):
         with self._lock:
             g = self._groups.setdefault(group_name, _BrokerGroup(group_name))
             # Stateless restart safety: clients ignore epoch pushes that don't
@@ -81,12 +82,29 @@ class Broker:
                 g.needs_update = True
             m = g.members.get(peer_name)
             if m is None:
-                g.members[peer_name] = {"last_ping": time.monotonic(), "sort_order": sort_order}
+                g.members[peer_name] = {
+                    "last_ping": time.monotonic(), "sort_order": sort_order, "host": host,
+                }
                 g.needs_update = True
             else:
                 m["last_ping"] = time.monotonic()
                 m["sort_order"] = sort_order
+                if m.get("host") != host:
+                    # A member's machine changed (same-name restart elsewhere
+                    # within the ping timeout): the host map is part of the
+                    # epoch contract (ring_auto input), so it must reach the
+                    # cohort via a push — never by silent divergence.
+                    m["host"] = host
+                    g.needs_update = True
             return {"sync_id": g.sync_id, "timeout": self._timeout}
+
+    def _hosts_locked(self, g: _BrokerGroup, members: list) -> Dict[str, Optional[str]]:
+        """Machine identity (boot id) per member, as pinged in.  Pushed with
+        every membership epoch so all members share ONE consistent view —
+        the tree-vs-ring auto-selection (``Group.ring_auto``) is part of the
+        wire protocol and must be decided identically cohort-wide."""
+        return {name: (g.members[name].get("host") if name in g.members else None)
+                for name in members}
 
     def _on_resync(self, group_name: str, peer_name: str):
         """A client whose sync_id went stale asks for the member list again."""
@@ -94,7 +112,8 @@ class Broker:
             g = self._groups.get(group_name)
             if g is None:
                 return None
-            push = (g.name, g.sync_id, list(g.active_members))
+            members = list(g.active_members)
+            push = (g.name, g.sync_id, members, self._hosts_locked(g, members))
         self._push_to(peer_name, *push)
         return {"sync_id": push[1]}
 
@@ -130,18 +149,20 @@ class Broker:
                         g.active_members,
                     )
                     members = list(g.active_members)
+                    hosts = self._hosts_locked(g, members)
                     for name in members:
-                        pushes.append((name, g.name, g.sync_id, members))
+                        pushes.append((name, g.name, g.sync_id, members, hosts))
         for push in pushes:
             self._push_to(*push)
 
-    def _push_to(self, peer_name: str, group_name: str, sync_id: int, members: list) -> None:
+    def _push_to(self, peer_name: str, group_name: str, sync_id: int, members: list,
+                 hosts: Optional[dict] = None) -> None:
         def _ignore(result, error):
             if error is not None:
                 utils.log_verbose("broker: push to %s failed: %s", peer_name, error)
 
         self._rpc.async_callback(
-            peer_name, "__group_update", _ignore, group_name, sync_id, members
+            peer_name, "__group_update", _ignore, group_name, sync_id, members, hosts
         )
 
     def close(self) -> None:

@@ -344,6 +344,12 @@ class Group:
         self._seq: Dict[Tuple, int] = {}  # (sync_id, op name) -> next seq
         self._recv_seq: Dict[Tuple, int] = {}
         self._on_change_callbacks: List[Callable] = []
+        self._member_hosts: Dict[str, Optional[str]] = {}
+        # Machine identity sent with every broker ping (tests override it to
+        # simulate cross-host cohorts on one box).
+        from .rpc.core import _boot_id
+
+        self._host_key = _boot_id()
         self._register_handlers()
 
     # ------------------------------------------------------------------ setup
@@ -387,6 +393,51 @@ class Group:
         with self._lock:
             return list(self._members)
 
+    def member_hosts(self) -> Dict[str, Optional[str]]:
+        """Machine identity (boot id) per member, from the broker's epoch
+        push — every member sees the same mapping for a given ``sync_id``.
+        ``None`` for members whose ping predates the host field."""
+        with self._lock:
+            return dict(self._member_hosts)
+
+    def ring_auto(self, nbytes: int) -> bool:
+        """The environment-aware tree-vs-ring choice for a payload of
+        ``nbytes`` (VERDICT r4 weak #3: payload size alone is not enough).
+        Ring when ALL of:
+
+        - payload >= ``MOOLIB_RING_THRESHOLD`` (1 MiB default): below it the
+          tree's single hop beats the ring's 2(n-1) message latency;
+        - cohort size >= 3: at n=2 both algorithms move exactly one payload
+          per peer and the tree is simpler;
+        - the cohort spans more than one machine: same-host frames ride
+          memfd zero-copy where wire bytes are nearly free, and the tree
+          wins wall-clock (BENCH_LOCAL round 4); the ring's even per-peer
+          load only pays on real NIC/DCN links.
+
+        Deterministic cohort-wide: every input (threshold env, member list,
+        host map) comes from the same broker epoch push, so peers at the
+        same ``sync_id`` always agree — the path choice is wire protocol.
+        """
+        if nbytes < _ring_threshold():
+            return False
+        with self._lock:
+            members = list(self._members)
+            hosts = dict(self._member_hosts)
+        if len(members) < 3:
+            return False
+        # "noboot-" keys are _boot_id's per-process random fallback (boot id
+        # unreadable): they would make a same-host cohort look multi-machine.
+        # Treat them as unknown — same policy as members with no host at all:
+        # missing info must not silently disable the DCN optimization, and
+        # must not manufacture a multi-host signal either.
+        known = [
+            None if h is None or h.startswith("noboot-") else h
+            for h in (hosts.get(m) for m in members)
+        ]
+        if known and all(h is not None for h in known) and len(set(known)) == 1:
+            return False
+        return True
+
     def sync_id(self):
         return self._sync_id
 
@@ -419,6 +470,7 @@ class Group:
                 self._rpc.get_name(),
                 self._sort_order,
                 self._sync_id,
+                self._host_key,
             )
         with self._lock:
             expired = [
@@ -461,12 +513,13 @@ class Group:
             )
 
     # ------------------------------------------------------------ membership
-    def _on_update(self, sync_id: int, members: List[str]):
+    def _on_update(self, sync_id: int, members: List[str], hosts=None):
         with self._lock:
             if self._sync_id is not None and sync_id <= self._sync_id:
                 return None  # stale push
             self._sync_id = sync_id
             self._members = list(members)
+            self._member_hosts = dict(hosts) if hosts else {}
             self._stale_since = None
             # Cancel everything in flight: the tree changed under it
             # (reference cancels with "group change", src/group.h:453-460).
@@ -511,11 +564,14 @@ class Group:
 
         Large uniform-dtype array payloads with a builtin string ``op``
         automatically take the bandwidth-optimal **chunked ring** path
-        (reduce-scatter + all-gather, see ``_RingOp``) once they exceed
-        ``MOOLIB_RING_THRESHOLD`` bytes (default 1 MiB); ``chunked=True/False``
-        forces the choice.  The path choice is part of the op's wire protocol,
-        so it must be deterministic cohort-wide: same threshold env, same
-        payload shapes, same kwargs on every peer.  Ring-only extras:
+        (reduce-scatter + all-gather, see ``_RingOp``) when ``ring_auto``
+        says so (payload >= ``MOOLIB_RING_THRESHOLD``, cohort >= 3, spans
+        more than one machine); ``chunked=True/False`` forces the choice.
+        The path choice is part of the op's wire protocol, so it must be
+        deterministic cohort-wide: same threshold env, same payload shapes,
+        same kwargs on every peer (``ring_auto``'s other inputs come from
+        the broker's epoch push and agree by construction).  Ring-only
+        extras:
 
         - ``meta``/``meta_op``: a small side value combined exactly once per
           member along the ring (e.g. batch counts); the future then resolves
@@ -531,24 +587,29 @@ class Group:
             # Ring-only kwargs must not silently change meaning with cohort
             # or payload size: they require the explicit chunked=True path.
             raise RpcError("meta=/wire=/template= require chunked=True")
-        use_ring = chunked
-        if use_ring is None:
-            use_ring = (
-                meta is None and wire is None and template is None
-                and finalize is None and isinstance(op, str) and value is not None
-                and _ring_nbytes(value) >= _ring_threshold()
-            )
-        if use_ring:
-            if not isinstance(op, str):
-                raise RpcError("chunked allreduce needs a builtin string op")
-            if finalize is not None:
-                raise RpcError("chunked allreduce: use wire= instead of finalize=")
-            if value is None and op != "sum":
-                raise RpcError("value=None (skip) only composes with op='sum'")
-            if meta is not None and meta_op is None:
-                raise RpcError("meta= requires meta_op=")
-        reduce_fn = None if use_ring else _resolve_op(op)
         with self._lock:
+            # The auto decision MUST be read under the same lock acquisition
+            # that assigns the op's sync_id key (RLock — ring_auto re-enters):
+            # an epoch push landing between decide and register would attach
+            # an old-epoch path choice to a new-epoch op key, and peers at
+            # one key must always agree on the path.
+            use_ring = chunked
+            if use_ring is None:
+                use_ring = (
+                    meta is None and wire is None and template is None
+                    and finalize is None and isinstance(op, str) and value is not None
+                    and self.ring_auto(_ring_nbytes(value))
+                )
+            if use_ring:
+                if not isinstance(op, str):
+                    raise RpcError("chunked allreduce needs a builtin string op")
+                if finalize is not None:
+                    raise RpcError("chunked allreduce: use wire= instead of finalize=")
+                if value is None and op != "sum":
+                    raise RpcError("value=None (skip) only composes with op='sum'")
+                if meta is not None and meta_op is None:
+                    raise RpcError("meta= requires meta_op=")
+            reduce_fn = None if use_ring else _resolve_op(op)
             if self._sync_id is None or self._rpc.get_name() not in self._members:
                 future.set_exception(RpcError("group not active"))
                 return future

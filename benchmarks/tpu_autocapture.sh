@@ -38,7 +38,7 @@ run envpool_atari 600 python benchmarks/envpool_bench.py --env synthetic \
 #     tokens/s, dynamic batching on/off, GQA sweep.
 run serve_bench 1500 python benchmarks/serve_bench.py --seconds 20 \
   --clients 16 --d_model 512 --layers 8 --heads 8 --kv_heads 8 2 \
-  --seq_len 128 --max_new_tokens 64 --vocab 32000
+  --batch_sizes 16 4 32 --seq_len 128 --max_new_tokens 64 --vocab 32000
 # 6. Fold results into BENCH_TPU.json so bench.py's last_good_tpu picks
 #    them up even if nobody is around when the battery fires.
 run fold_capture 120 python benchmarks/fold_capture.py "$OUT" /root/repo/BENCH_TPU.json

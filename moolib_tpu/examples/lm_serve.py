@@ -64,6 +64,12 @@ def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate
         batch_size=batch_size if dynamic_batching else None,
         dynamic_batching=dynamic_batching,
     )
+    # Service-quality introspection for load benches: queue wait/fill/depth
+    # counters plus the server's own iteration count (serve_bench diffs two
+    # snapshots around its measurement window).
+    counters = {"served": 0, "iterations": 0}
+    rpc.define(f"{name}_stats", lambda: {**queue.stats(), **counters,
+                                         "batch_size": batch_size if dynamic_batching else 1})
     if mesh is not None:
         # Built ONCE: the returned fn is a plain jit, so repeated batches of
         # the same prompt shape hit the compile cache.
@@ -84,6 +90,7 @@ def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate
             n = prompts.shape[0]
             served += n
             iterations += 1
+            counters["served"], counters["iterations"] = served, iterations
             if dynamic_batching and n < batch_size:
                 pad = np.repeat(prompts[-1:], batch_size - n, axis=0)
                 batch = np.concatenate([prompts, pad], axis=0)
@@ -116,6 +123,11 @@ def main(argv=None):
         "KV cache by heads/kv_heads",
     )
     p.add_argument("--max_new_tokens", type=int, default=16)
+    p.add_argument(
+        "--batch_size", type=int, default=16,
+        help="dynamic-batching cap: batches are padded to exactly this "
+        "(one XLA compile); the crossover vs batch-1 is serve_bench's job",
+    )
     p.add_argument(
         "--mesh",
         default="",
@@ -153,6 +165,7 @@ def main(argv=None):
         try:
             asyncio.run(serve(
                 rpc, model, params, flags.max_new_tokens, mesh=mesh,
+                batch_size=flags.batch_size,
                 dynamic_batching=not flags.no_dynamic_batching,
             ))
         finally:

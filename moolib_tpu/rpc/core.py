@@ -596,11 +596,19 @@ class Queue:
         self._waiters: collections.deque = collections.deque()  # (loop, asyncio.Future)
         self._batch_size = batch_size
         self._dynamic = dynamic_batching
+        # Cumulative service-quality counters (serve_bench reads these to
+        # make the batching crossover visible: how full batches run and how
+        # long calls sat queued before service).
+        self._stats = {
+            "items": 0, "takes": 0, "wait_s_sum": 0.0, "wait_s_max": 0.0,
+            "depth_max": 0,
+        }
 
     # producer (rpc engine or user's enqueue) ------------------------------
     def enqueue(self, return_callback, args=None, kwargs=None) -> None:
         with self._lock:
-            self._items.append((return_callback, args or (), kwargs or {}))
+            self._items.append((return_callback, args or (), kwargs or {}, time.monotonic()))
+            self._stats["depth_max"] = max(self._stats["depth_max"], len(self._items))
             self._maybe_wake_locked()
 
     def _maybe_wake_locked(self) -> None:
@@ -610,17 +618,36 @@ class Queue:
             batch = self._take_locked()
             loop.call_soon_threadsafe(_set_async_result, af, batch)
 
+    def _account_locked(self, calls) -> list:
+        now = time.monotonic()
+        s = self._stats
+        s["takes"] += 1
+        s["items"] += len(calls)
+        for c in calls:
+            wait = now - c[3]
+            s["wait_s_sum"] += wait
+            s["wait_s_max"] = max(s["wait_s_max"], wait)
+        return [c[:3] for c in calls]
+
     def _take_locked(self):
         if self._batch_size is None:
-            return self._items.popleft()
+            return self._account_locked([self._items.popleft()])[0]
         n = len(self._items) if self._dynamic else self._batch_size
         n = min(n, self._batch_size, len(self._items))
-        calls = [self._items.popleft() for _ in range(n)]
+        calls = self._account_locked([self._items.popleft() for _ in range(n)])
         return _batch_calls(calls)
 
     def size(self) -> int:
         with self._lock:
             return len(self._items)
+
+    def stats(self) -> Dict[str, float]:
+        """Cumulative queue service counters: ``items`` serviced, service
+        ``takes`` (batches — average batch fill is items/takes), queue
+        ``wait_s_sum``/``wait_s_max`` (enqueue to service start), and
+        high-water ``depth_max``."""
+        with self._lock:
+            return dict(self._stats)
 
     def __await__(self):
         loop = asyncio.get_event_loop()

@@ -5,6 +5,7 @@ TSV logging."""
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -70,48 +71,71 @@ class GlobalStatsAccumulator:
         self._last = {k: v.snapshot() for k, v in stats.items()}
         self._pending_delta: Optional[dict] = None
         self._inflight = None
+        # Serializes reduce()/local_reset()/reset() (train thread) against
+        # on_done (RPC callback thread): both sides mutate the delta
+        # baseline, and an unserialized local_reset concurrent with a
+        # result application would broadcast a negative-delta storm.
+        self._mutex = threading.Lock()
 
     def reduce(self, stats: Dict) -> None:
-        if self._inflight is not None and not self._inflight.done():
-            return
-        delta = {k: v.delta(self._last[k]) for k, v in stats.items()}
-        if self._pending_delta is not None:
-            for k, d in self._pending_delta.items():
-                delta[k] = _delta_add(delta[k], d)
-        self._last = {k: v.snapshot() for k, v in stats.items()}
-        # Subtract our own contribution after the reduce (we already hold it).
-        fut = self._group.all_reduce("__global_stats", delta, op=_delta_reduce_op)
-        self._pending_delta = None
+        with self._mutex:
+            if self._inflight is not None:
+                return
+            delta = {k: v.delta(self._last[k]) for k, v in stats.items()}
+            if self._pending_delta is not None:
+                for k, d in self._pending_delta.items():
+                    delta[k] = _delta_add(delta[k], d)
+            self._last = {k: v.snapshot() for k, v in stats.items()}
+            self._pending_delta = None
+            self._inflight = object()  # block re-entry before the callback binds
 
         def on_done(f, delta=delta):
-            exc = f.exception()
-            if exc is not None:
-                # Failed (churn): re-queue our delta so nothing is lost.
-                self._pending_delta = (
-                    delta
-                    if self._pending_delta is None
-                    else {k: _delta_add(self._pending_delta[k], d) for k, d in delta.items()}
-                )
-                return
-            total = f.result(0)
-            for k, v in self._stats.items():
-                # Apply everyone else's contribution (total minus ours).
-                v.apply_delta(_delta_sub(total[k], delta[k]))
+            with self._mutex:
+                try:
+                    exc = f.exception()
+                    if exc is not None:
+                        # Failed (churn): re-queue our delta so nothing is lost.
+                        self._pending_delta = (
+                            delta
+                            if self._pending_delta is None
+                            else {k: _delta_add(self._pending_delta[k], d)
+                                  for k, d in delta.items()}
+                        )
+                        return
+                    total = f.result(0)
+                    for k, v in self._stats.items():
+                        # Apply everyone else's contribution (total minus
+                        # ours) to the value AND the delta baseline: remote
+                        # contributions we merely learned about are not OUR
+                        # progress, and leaving them out of the baseline
+                        # re-broadcasts them as our next delta — a
+                        # (n-1)x-per-round amplification that inflated
+                        # steps_done ~1000x in the round-5 soak (which then
+                        # hit the agents' total_steps budget years early).
+                        rem = _delta_sub(total[k], delta[k])
+                        v.apply_delta(rem)
+                        self._last[k].apply_delta(rem)
+                finally:
+                    # ALWAYS cleared, or one malformed cohort result would
+                    # wedge reduce() (it early-returns while this is set).
+                    self._inflight = None
 
+        fut = self._group.all_reduce("__global_stats", delta, op=_delta_reduce_op)
         fut.add_done_callback(on_done)
-        self._inflight = fut
 
     def reset(self) -> None:
-        for k, v in self._stats.items():
-            v.reset()
-        self._last = {k: v.snapshot() for k, v in self._stats.items()}
+        with self._mutex:
+            for k, v in self._stats.items():
+                v.reset()
+            self._last = {k: v.snapshot() for k, v in self._stats.items()}
 
     def local_reset(self, *keys: str) -> None:
         """Reset chosen stats for local windowing without desyncing the delta
         protocol (re-snapshots them so the next reduce sends a zero delta)."""
-        for k in keys:
-            self._stats[k].reset()
-            self._last[k] = self._stats[k].snapshot()
+        with self._mutex:
+            for k in keys:
+                self._stats[k].reset()
+                self._last[k] = self._stats[k].snapshot()
 
 
 def _delta_add(a, b):

@@ -1,0 +1,147 @@
+"""GlobalStatsAccumulator delta protocol: values must track the true global
+sum, not amplify.
+
+Regression for a real bug the round-5 soak exposed: remote deltas were
+applied to the stat value but not to the delta baseline, so every peer
+re-broadcast everyone else's contributions as its own next delta —
+(n-1)x amplification per reduce round.  steps_done inflated ~1000x and
+agents quit early against their total_steps budget.
+"""
+
+import numpy as np
+
+from moolib_tpu.examples.common import GlobalStatsAccumulator, _delta_reduce_op
+from moolib_tpu.utils.stats import StatMean, StatSum
+
+
+class _Fut:
+    def __init__(self, result=None, exc=None):
+        self._r, self._e = result, exc
+
+    def done(self):
+        return True
+
+    def exception(self):
+        return self._e
+
+    def result(self, timeout=None):
+        if self._e is not None:
+            raise self._e
+        return self._r
+
+    def add_done_callback(self, cb):
+        cb(self)
+
+
+class _SyncCohortGroup:
+    """Completes each peer's allreduce synchronously once all N peers of a
+    round have contributed — the lockstep the real Group provides."""
+
+    def __init__(self):
+        self.pending = []
+
+    @staticmethod
+    def wire(n):
+        groups = [_SyncCohortGroup() for _ in range(n)]
+        for g in groups:
+            g.cohort = groups
+        return groups
+
+    def all_reduce(self, name, value, op):
+        self.calls = getattr(self, "calls", [])
+        self.calls.append(value)
+        self._value = value
+
+        class _Deferred:
+            # Like the real AllReduce: a callback added after completion
+            # fires immediately (the last contributor registers its
+            # callback after its own call completed the round).
+            def __init__(s):
+                s.cbs = []
+                s.fired = None
+
+            def add_done_callback(s, cb):
+                if s.fired is not None:
+                    cb(s.fired)
+                else:
+                    s.cbs.append(cb)
+
+            def fire(s, fut):
+                s.fired = fut
+                for cb in s.cbs:
+                    cb(fut)
+
+        d = _Deferred()
+        self.pending.append((value, d))
+        # Complete the round once every cohort member contributed.
+        if all(g.pending for g in self.cohort):
+            contribs = [g.pending[0][0] for g in self.cohort]
+            total = contribs[0]
+            for c in contribs[1:]:
+                total = op(total, c)
+            fut = _Fut(result=total)
+            for dd in [g.pending.pop(0)[1] for g in self.cohort]:
+                dd.fire(fut)
+        return d
+
+
+def test_no_amplification_over_rounds():
+    n, rounds, inc = 4, 12, 100.0
+    groups = _SyncCohortGroup.wire(n)
+    stats = [{"steps": StatSum(), "loss": StatMean()} for _ in range(n)]
+    accs = [GlobalStatsAccumulator(g, s) for g, s in zip(groups, stats)]
+    for r in range(rounds):
+        for s in stats:
+            s["steps"] += inc
+            s["loss"] += 0.5
+        for a, s in zip(accs, stats):
+            a.reduce(s)
+        true_total = inc * n * (r + 1)
+        for s in stats:
+            assert s["steps"].value == true_total, (r, s["steps"].value, true_total)
+    # Mean stats also track the global (sum, count) exactly.
+    for s in stats:
+        assert s["loss"].count == n * rounds
+        np.testing.assert_allclose(s["loss"].result(), 0.5)
+
+
+def test_failed_round_requeues_delta():
+    class _FailGroup:
+        def all_reduce(self, name, value, op):
+            return _Fut(exc=RuntimeError("group changed"))
+
+    stats = {"steps": StatSum()}
+    acc = GlobalStatsAccumulator(_FailGroup(), stats)
+    stats["steps"] += 7
+    acc.reduce(stats)
+    assert acc._pending_delta == {"steps": 7.0}
+    assert acc._inflight is None  # a failed round must not wedge reduce()
+    # The re-queued delta joins the next (successful) round.
+    class _OkGroup:
+        def all_reduce(self, name, value, op):
+            self.sent = value
+            return _Fut(result=value)
+
+    ok = _OkGroup()
+    acc._group = ok
+    stats["steps"] += 3
+    acc.reduce(stats)
+    assert ok.sent == {"steps": 10.0}
+    assert stats["steps"].value == 10.0
+
+
+def test_local_reset_windowing_stays_synced():
+    groups = _SyncCohortGroup.wire(2)
+    stats = [{"w": StatMean()} for _ in range(2)]
+    accs = [GlobalStatsAccumulator(g, s) for g, s in zip(groups, stats)]
+    for s in stats:
+        s["w"] += 1.0
+    for a, s in zip(accs, stats):
+        a.reduce(s)
+    assert stats[0]["w"].count == 2
+    accs[0].local_reset("w")
+    assert stats[0]["w"].count == 0
+    # The reset peer's next delta is zero-based: no negative delta storm.
+    for a, s in zip(accs, stats):
+        a.reduce(s)
+    assert stats[1]["w"].count == 2  # unchanged by peer 0's local windowing

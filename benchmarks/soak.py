@@ -1,18 +1,25 @@
 """Churn soak: the flagship agent under sustained peer kill/restart.
 
-VERDICT round-3 ask #9 — elasticity as a flagship property (reference
-``src/broker.h:130-237``): N vtrace agent peers train against one broker
-while a killer SIGKILLs a random peer every ``--kill_interval`` seconds and
-restarts it.  The soak asserts, continuously:
+VERDICT round-3 ask #9 / round-4 ask #7 — elasticity as a flagship property
+(reference ``src/broker.h:130-237``): N vtrace agent peers train against one
+broker while a killer SIGKILLs a random peer every ``--kill_interval``
+seconds and restarts it.  The soak asserts, continuously:
 
-- **progress**: the cohort-global step high-water mark keeps advancing —
-  no stall longer than ``--stall_bound`` seconds;
+- **progress**: the cohort-max MODEL VERSION keeps advancing.  Version is
+  monotone per epoch and restarted peers re-sync to the cohort's version,
+  so this metric is immune to the counter resets that made round 4's
+  global-steps stall metric nearly trip its bound on an artifact
+  (SOAK_r04: max_stall 179.5 s explained by stats resets, not stalls);
+- **recovery**: each killed+restarted peer re-reports a model version
+  within ``--version_window`` of the cohort max; the per-kill recovery
+  times are recorded and summarized (p50/max);
 - **consistency**: at the end, every surviving peer's model version is
-  within a small window of the cohort max (stragglers mid-resync allowed).
+  within the window of the cohort max (stragglers mid-resync allowed).
 
 Writes a JSON summary line; ``--out`` also saves it to a file.
 
-    python benchmarks/soak.py --seconds 600 --kill_interval 30 --peers 4
+    python benchmarks/soak.py --seconds 600 --kill_interval 30 --peers 8 \
+        --env pixel_catch --stall_bound 60
 """
 
 from __future__ import annotations
@@ -44,6 +51,11 @@ def _spawn_worker(i: int, addr: str, outdir: str, args) -> subprocess.Popen:
         os.environ,
         PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
         JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+        # Shared persistent compile cache: peer 0 compiles, the other N-1
+        # cold starts and every kill/restart reload from disk — without it
+        # 8 peers serially compiling on one core dominates the soak.
+        JAX_COMPILATION_CACHE_DIR=os.path.join(outdir, "jax_cache"),
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5",
     )
     localdir = os.path.join(outdir, f"p{i}")
     os.makedirs(localdir, exist_ok=True)
@@ -51,16 +63,17 @@ def _spawn_worker(i: int, addr: str, outdir: str, args) -> subprocess.Popen:
     return subprocess.Popen(
         [
             sys.executable, "-m", "moolib_tpu.examples.vtrace.experiment",
-            "--env", "catch",
+            "--env", args.env,
             "--connect", addr,
             "--local_name", f"p{i}",
             "--localdir", localdir,
             "--total_steps", "1000000000",
             "--actor_batch_size", str(args.actor_batch_size),
+            "--unroll_length", str(args.unroll_length),
             "--num_actor_batches", "2",
             "--batch_size", str(args.batch_size),
             "--virtual_batch_size", str(args.virtual_batch_size),
-            "--num_env_processes", "2",
+            "--num_env_processes", str(args.num_env_processes),
             "--stats_interval", "2",
             "--log_interval", "2",
             "--quiet",
@@ -102,8 +115,17 @@ def main(argv=None):
     p.add_argument("--seconds", type=float, default=600.0)
     p.add_argument("--kill_interval", type=float, default=30.0)
     p.add_argument("--peers", type=int, default=4)
+    p.add_argument("--env", default="catch",
+                   help="catch | pixel_catch | pixel_catch84 | ... "
+                   "(vtrace experiment env; pixel_catch = soak-v2 pixel bar)")
     p.add_argument("--stall_bound", type=float, default=120.0,
-                   help="max seconds without global-step progress")
+                   help="max seconds without cohort model-version progress "
+                   "(armed once the cohort first reports a version)")
+    p.add_argument("--startup_bound", type=float, default=300.0,
+                   help="max seconds until the cohort's first completed "
+                   "gradient round (N cold jax starts share one core)")
+    p.add_argument("--num_env_processes", type=int, default=2)
+    p.add_argument("--unroll_length", type=int, default=20)
     p.add_argument("--version_window", type=int, default=20,
                    help="allowed final model-version spread (stragglers mid-resync)")
     p.add_argument("--actor_batch_size", type=int, default=8)
@@ -128,9 +150,15 @@ def main(argv=None):
 
     workers = {i: _spawn_worker(i, addr, outdir, args) for i in range(args.peers)}
     kills = 0
-    high_water = 0.0
+    high_water = 0.0         # informational: cohort-global env steps
+    version_high = -1        # progress metric: cohort-max model version
+    armed = False            # stall clock arms at the first reported version
+    t_start = time.time()
     last_progress = time.time()
     stall_max = 0.0
+    pending_recovery = {}    # peer -> kill wall-clock time
+    recoveries = []          # seconds from kill to re-synced fresh row
+    unrecovered_kills = 0    # victim re-killed before it ever re-synced
     t_end = time.time() + args.seconds
     next_kill = time.time() + args.kill_interval
     rng = random.Random(0)
@@ -148,34 +176,86 @@ def main(argv=None):
                     break
             if not ok:
                 break
-            # Progress: cohort-global steps are allreduced into every peer's
-            # stats, so the max over current TSV tails is the high-water.
-            steps = []
+            # Progress: cohort-max model version (monotone, reset-immune —
+            # restarted peers re-sync to the cohort version rather than
+            # starting a counter from zero).  Steps stay as a side metric.
+            steps, versions_now = [], {}
             for i in workers:
                 row = _last_tsv_row(outdir, i)
-                if row and row.get("steps_done"):
-                    try:
+                if not row:
+                    continue
+                try:
+                    if row.get("steps_done"):
                         steps.append(float(row["steps_done"]))
-                    except ValueError:
-                        pass
-            if steps and max(steps) > high_water:
-                high_water = max(steps)
+                    if row.get("model_version"):
+                        versions_now[i] = int(float(row["model_version"]))
+                except ValueError:
+                    pass
+            if steps:
+                high_water = max(high_water, max(steps))
+            if versions_now and max(versions_now.values()) > version_high:
+                version_high = max(versions_now.values())
                 last_progress = now
+                if not armed and version_high >= 1:
+                    # First completed round: the cohort is genuinely live.
+                    # Arm the stall clock here, not at first report — the
+                    # staggered N-process cold start (each join bumps the
+                    # epoch, cancelling in-flight rounds) is startup, not a
+                    # stall.  Kills wait one interval from here, and the
+                    # soak window starts now: --seconds measures churn on a
+                    # live cohort, not jax imports.
+                    armed = True
+                    t_end = now + args.seconds
+                    next_kill = now + args.kill_interval
+            if not armed:
+                if now - t_start > args.startup_bound:
+                    ok, failure = (
+                        False,
+                        f"cohort never completed a gradient round within "
+                        f"{args.startup_bound:.0f}s",
+                    )
+                    break
+                continue
             stall = now - last_progress
             stall_max = max(stall_max, stall)
             if stall > args.stall_bound:
-                ok, failure = False, f"no progress for {stall:.0f}s (bound {args.stall_bound:.0f}s)"
+                ok, failure = (
+                    False,
+                    f"no model-version progress for {stall:.0f}s "
+                    f"(bound {args.stall_bound:.0f}s, version_high={version_high})",
+                )
                 break
+            # Per-kill recovery: the restarted victim has recovered once a
+            # row written AFTER its kill carries a version within the window
+            # of the cohort max.
+            for i, t_kill in list(pending_recovery.items()):
+                row = _last_tsv_row(outdir, i, fresher_than=t_kill)
+                if not row or not row.get("model_version"):
+                    continue
+                try:
+                    v = int(float(row["model_version"]))
+                except ValueError:
+                    continue
+                if v >= version_high - args.version_window:
+                    recoveries.append(round(now - t_kill, 1))
+                    del pending_recovery[i]
             if now >= next_kill and now + 15 < t_end:
                 next_kill = now + args.kill_interval
                 victim = rng.choice(list(workers))
                 _kill(workers[victim])
                 kills += 1
+                if victim in pending_recovery:
+                    unrecovered_kills += 1
+                # Stamped AFTER the kill returned: a row the victim wrote in
+                # the scan-to-kill gap must not pass the freshness filter
+                # and record a false sub-second recovery.
+                pending_recovery[victim] = time.time()
                 workers[victim] = _spawn_worker(victim, addr, outdir, args)
                 print(
                     f"[{now - (t_end - args.seconds):6.0f}s] killed+restarted p{victim} "
-                    f"(kill #{kills}, high_water={high_water:.0f}, "
-                    f"max_stall={stall_max:.0f}s)",
+                    f"(kill #{kills}, version_high={version_high}, "
+                    f"high_water={high_water:.0f}, max_stall={stall_max:.0f}s, "
+                    f"recoveries={len(recoveries)})",
                     flush=True,
                 )
         # Final consistency: give the cohort a settle window (a just-restarted
@@ -208,6 +288,7 @@ def main(argv=None):
             _kill(proc)
         broker.close()
 
+    rec_sorted = sorted(recoveries)
     summary = {
         "metric": "churn_soak",
         "ok": ok,
@@ -216,11 +297,17 @@ def main(argv=None):
         "peers": args.peers,
         "kills": kills,
         "kill_interval_s": args.kill_interval,
+        "model_version_high_water": version_high,
         "global_steps_high_water": high_water,
         "max_stall_s": round(stall_max, 1),
         "stall_bound_s": args.stall_bound,
+        "recovery_s": rec_sorted,
+        "recovery_p50_s": rec_sorted[len(rec_sorted) // 2] if rec_sorted else None,
+        "recovery_max_s": rec_sorted[-1] if rec_sorted else None,
+        "unrecovered_kills": unrecovered_kills,
+        "pending_recoveries_at_end": len(pending_recovery),
         "final_model_versions": versions,
-        "env": "catch",
+        "env": args.env,
     }
     print(json.dumps(summary), flush=True)
     if args.out:

@@ -1,0 +1,191 @@
+"""Generate the markdown API reference from live docstrings (no sphinx in
+the toolchain; stdlib inspect is enough for a faithful reference).
+
+Counterpart of the reference's Sphinx tree (``/root/reference/docs/``,
+``docs/source/``): the reference writes its pybind docstrings for a docs
+build, this walks the real import surface so the docs can never drift from
+the code unnoticed — CI runs ``--check`` which fails when the committed
+pages differ from a fresh render.
+
+    python docs/gen_api.py            # (re)write docs/api/*.md
+    python docs/gen_api.py --check    # exit 1 if committed pages are stale
+"""
+
+from __future__ import annotations
+
+import argparse
+import inspect
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "docs", "api")
+
+# (module path, page title): the public surface, in reading order.
+MODULES = [
+    ("moolib_tpu", "Package exports"),
+    ("moolib_tpu.rpc.core", "RPC core"),
+    ("moolib_tpu.broker", "Broker"),
+    ("moolib_tpu.group", "Group / AllReduce"),
+    ("moolib_tpu.accumulator", "Accumulator"),
+    ("moolib_tpu.envpool", "EnvPool"),
+    ("moolib_tpu.batcher", "Batcher"),
+    ("moolib_tpu.replay", "Replay"),
+    ("moolib_tpu.checkpoint", "Checkpointing"),
+    ("moolib_tpu.parallel", "Parallelism (package)"),
+    ("moolib_tpu.parallel.mesh", "Parallelism: mesh + shardings"),
+    ("moolib_tpu.parallel.collectives", "Parallelism: collectives"),
+    ("moolib_tpu.parallel.ring_attention", "Parallelism: ring attention"),
+    ("moolib_tpu.parallel.pipeline", "Parallelism: pipeline (GPipe/circular)"),
+    ("moolib_tpu.parallel.moe", "Parallelism: mixture-of-experts"),
+    ("moolib_tpu.parallel.train", "Parallelism: train-step assembly"),
+    ("moolib_tpu.models.impala", "Models: IMPALA ResNet"),
+    ("moolib_tpu.models.transformer", "Models: Transformer LM"),
+    ("moolib_tpu.ops.vtrace", "Ops: V-trace"),
+    ("moolib_tpu.ops.flash_attention", "Ops: Flash attention (pallas)"),
+    ("moolib_tpu.ops.returns", "Ops: returns / losses"),
+    ("moolib_tpu.utils", "Utilities"),
+    ("moolib_tpu.utils.nest", "Utilities: nest"),
+    ("moolib_tpu.utils.config", "Utilities: config"),
+    ("moolib_tpu.utils.batchsize", "Utilities: batch-size finder"),
+    ("moolib_tpu.utils.profiling", "Utilities: profiling"),
+    ("moolib_tpu.utils.stats", "Utilities: running stats"),
+    ("moolib_tpu.envs.atari", "Envs: Atari preprocessing"),
+]
+
+
+def _scrub(text: str) -> str:
+    import re
+
+    # Reprs can embed memory addresses (e.g. flax's module _Sentinel default
+    # in dataclass-generated signatures AND docstrings); scrub them or every
+    # render differs from the committed one.
+    return re.sub(r" at 0x[0-9a-fA-F]+", " at 0x...", text)
+
+
+def _sig(obj) -> str:
+    try:
+        return _scrub(str(inspect.signature(obj)))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _doc(obj) -> str:
+    d = inspect.getdoc(obj)
+    return _scrub(d.strip()) if d else ""
+
+
+def _public_names(mod):
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n in vars(mod) if not n.startswith("_")]
+    out = []
+    for n in names:
+        obj = getattr(mod, n, None)
+        if inspect.ismodule(obj):
+            continue
+        # Only document what this module defines (re-exports are documented
+        # at their home, except in the package root where the export list
+        # IS the documented surface).
+        home = getattr(obj, "__module__", mod.__name__)
+        if mod.__name__ != "moolib_tpu" and home != mod.__name__:
+            continue
+        if inspect.isclass(obj) or callable(obj):
+            out.append((n, obj))
+    return out
+
+
+def _render_callable(name, obj, level="###") -> list:
+    lines = [f"{level} `{name}{_sig(obj)}`", ""]
+    doc = _doc(obj)
+    if doc:
+        lines += [doc, ""]
+    return lines
+
+
+def _render_class(name, cls) -> list:
+    lines = [f"### class `{name}`", ""]
+    doc = _doc(cls)
+    if doc:
+        lines += [doc, ""]
+    for mname, m in sorted(vars(cls).items()):
+        if mname.startswith("_") and mname != "__call__":
+            continue
+        if not callable(m):
+            continue
+        mdoc = _doc(m)
+        lines += [f"#### `{name}.{mname}{_sig(m)}`", ""]
+        if mdoc:
+            lines += [mdoc, ""]
+    return lines
+
+
+def render_module(modpath: str, title: str) -> str:
+    __import__(modpath)
+    mod = sys.modules[modpath]
+    lines = [f"# {title}", "", f"``{modpath}``", ""]
+    mdoc = _doc(mod)
+    if mdoc:
+        lines += [mdoc, ""]
+    for name, obj in _public_names(mod):
+        if inspect.isclass(obj):
+            lines += _render_class(name, obj)
+        else:
+            lines += _render_callable(name, obj)
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def render_all() -> dict:
+    pages = {}
+    for modpath, title in MODULES:
+        fname = modpath.replace("moolib_tpu", "mt").replace(".", "_") + ".md"
+        try:
+            pages[fname] = render_module(modpath, title)
+        except Exception as e:  # noqa: BLE001 — a missing optional dep must
+            # not take down the whole reference build
+            pages[fname] = f"# {title}\n\n``{modpath}``\n\nimport failed: {e}\n"
+    index = ["# API reference", "",
+             "Generated from live docstrings by `docs/gen_api.py`;",
+             "`--check` in CI fails when these pages drift from the code.", ""]
+    for (modpath, title), fname in zip(MODULES, pages):
+        index.append(f"- [{title}]({fname}) — ``{modpath}``")
+    pages["README.md"] = "\n".join(index) + "\n"
+    return pages
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="fail if the committed pages are stale")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # The axon sitecustomize pins the platform; docs generation must never
+    # touch (or hang on) an accelerator backend.
+    jax.config.update("jax_platforms", "cpu")
+
+    pages = render_all()
+    stale = []
+    os.makedirs(OUT, exist_ok=True)
+    for fname, content in pages.items():
+        path = os.path.join(OUT, fname)
+        try:
+            old = open(path).read()
+        except OSError:
+            old = None
+        if old != content:
+            stale.append(fname)
+            if not args.check:
+                with open(path, "w") as f:
+                    f.write(content)
+    if args.check and stale:
+        print("stale API pages (run python docs/gen_api.py):", ", ".join(stale))
+        return 1
+    print(f"{len(pages)} pages {'checked' if args.check else 'written'} -> {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

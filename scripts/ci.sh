@@ -1,0 +1,45 @@
+#!/bin/bash
+# One-command local CI: the same gates .github/workflows/ci.yml runs, with
+# graceful degradation for tools this box doesn't have (black/flake8 are
+# GitHub-runner-only; the syntax floor is compileall).
+#
+#   bash scripts/ci.sh            # everything
+#   bash scripts/ci.sh quick      # skip the full pytest suite (docs+lint+sanitizers)
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+fail=0
+
+step() { echo; echo "=== $1"; }
+
+step "syntax floor (compileall)"
+python -m compileall -q moolib_tpu tests benchmarks docs/gen_api.py || fail=1
+
+step "lint (black/flake8 if available)"
+if python -m black --version >/dev/null 2>&1; then
+  python -m black --check --line-length 100 moolib_tpu tests benchmarks || fail=1
+else
+  echo "black not installed here - runs in .github/workflows/ci.yml"
+fi
+if python -m flake8 --version >/dev/null 2>&1; then
+  python -m flake8 --select=E9,F63,F7,F82 moolib_tpu tests benchmarks || fail=1
+else
+  echo "flake8 not installed here - runs in .github/workflows/ci.yml"
+fi
+
+step "API reference freshness (docs/gen_api.py --check)"
+python docs/gen_api.py --check || fail=1
+
+step "sanitizer matrix (skips where the runtime is missing)"
+python -m pytest tests/test_native_sanitizers.py -q || fail=1
+
+if [ "${1:-}" != "quick" ]; then
+  step "full suite (~25 min on a 1-core box)"
+  python -m pytest tests/ -x -q || fail=1
+fi
+
+echo
+[ "$fail" = 0 ] && echo "CI OK" || echo "CI FAILED"
+exit $fail

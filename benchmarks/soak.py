@@ -75,6 +75,10 @@ def _spawn_worker(i: int, addr: str, outdir: str, args) -> subprocess.Popen:
             "--virtual_batch_size", str(args.virtual_batch_size),
             "--num_env_processes", str(args.num_env_processes),
             "--stats_interval", "2",
+        ]
+        + (["--wire_dtype", args.wire_dtype] if args.wire_dtype else [])
+        + (["--chunked"] if args.chunked else [])
+        + [
             "--log_interval", "2",
             "--quiet",
         ],
@@ -126,6 +130,9 @@ def main(argv=None):
                    "gradient round (N cold jax starts share one core)")
     p.add_argument("--num_env_processes", type=int, default=2)
     p.add_argument("--unroll_length", type=int, default=20)
+    p.add_argument("--wire_dtype", default=None, choices=[None, "bf16", "int8"])
+    p.add_argument("--chunked", action="store_true",
+                   help="force gradient rounds over the chunked ring")
     p.add_argument("--version_window", type=int, default=20,
                    help="allowed final model-version spread (stragglers mid-resync)")
     p.add_argument("--actor_batch_size", type=int, default=8)
@@ -165,7 +172,9 @@ def main(argv=None):
     ok, failure = True, None
 
     try:
-        while time.time() < t_end:
+        # Until the stall clock arms, the bound is the startup budget — a
+        # cold start longer than --seconds must not exit as a silent pass.
+        while time.time() < (t_end if armed else t_start + args.startup_bound + 1):
             broker.update()
             time.sleep(0.25)
             now = time.time()
@@ -258,6 +267,8 @@ def main(argv=None):
                     f"recoveries={len(recoveries)})",
                     flush=True,
                 )
+        if ok and not armed:
+            ok, failure = False, "cohort never armed (no completed gradient round)"
         # Final consistency: give the cohort a settle window (a just-restarted
         # peer needs jax import + compile before its first row), then compare
         # model versions across rows written AFTER the soak window — stale
@@ -308,6 +319,8 @@ def main(argv=None):
         "pending_recoveries_at_end": len(pending_recovery),
         "final_model_versions": versions,
         "env": args.env,
+        "wire_dtype": args.wire_dtype,
+        "chunked": args.chunked,
     }
     print(json.dumps(summary), flush=True)
     if args.out:

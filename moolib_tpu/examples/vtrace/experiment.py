@@ -102,6 +102,12 @@ def make_flags(argv=None):
         help="compress gradient allreduce payloads (bf16: 2x, int8+EF: 4x)",
     )
     p.add_argument(
+        "--chunked",
+        action="store_true",
+        help="force gradient rounds over the chunked ring allreduce "
+        "(Group.ring_auto would keep a same-host cohort on the tree)",
+    )
+    p.add_argument(
         "--trace_dir",
         default=None,
         help="capture a jax profiler trace of the first learner steps here",
@@ -421,6 +427,8 @@ def train(flags, on_stats=None) -> dict:
         accumulator.set_wire_dtype(jnp.bfloat16)
     elif flags.wire_dtype == "int8":
         accumulator.set_wire_dtype("int8")
+    if flags.chunked:
+        accumulator.set_chunked_allreduce(True)
     if flags.trace_dir:
         # Trace the first seconds of training (compile + early steps).
         jax.profiler.start_trace(flags.trace_dir)

@@ -19,7 +19,9 @@ python -m compileall -q moolib_tpu tests benchmarks docs/gen_api.py || fail=1
 
 step "lint (black/flake8 if available)"
 if python -m black --version >/dev/null 2>&1; then
-  python -m black --check --line-length 100 moolib_tpu tests benchmarks || fail=1
+  # Advisory, matching ci.yml's continue-on-error until a repo-wide format lands.
+  python -m black --check --line-length 100 moolib_tpu tests benchmarks \
+    || echo "black: formatting differences (advisory)"
 else
   echo "black not installed here - runs in .github/workflows/ci.yml"
 fi

@@ -142,12 +142,15 @@ def test_impala_learns_from_pixels_at_atari_scale(free_port):
     geometry — (84, 84, 4) stacked frames (examples/atari/environment.py)
     through the complete 16/32/32 ImpalaNet.  Catch at 84×84 with a 4-frame
     stack; random policy is ~-0.6, require clearly-positive return."""
+    # 15k steps: at 10k this bar was marginal (learns -0.6 -> ~-0.06 but
+    # flakes around zero under a loaded box); the extra window makes the
+    # positive-return assertion robust without weakening it.
     flags = make_flags(
         [
             "--env",
             "pixel_catch84",
             "--total_steps",
-            "10000",
+            "15000",
             "--actor_batch_size",
             "16",
             "--batch_size",
@@ -164,7 +167,7 @@ def test_impala_learns_from_pixels_at_atari_scale(free_port):
         ]
     )
     out = train(flags)
-    assert out["steps"] >= 10000
+    assert out["steps"] >= 15000
     assert out["sgd_steps"] > 50
     assert out["mean_episode_return"] is not None
     assert out["mean_episode_return"] > 0.0, f"no 84x84x4 pixel learning: {out}"

@@ -99,8 +99,11 @@ def main():
         ),
         # Serving under load: p50/p99 + tokens/s, dynamic batching on/off,
         # GQA sweep (VERDICT r3 ask #8).
+        # --batch_sizes sweeps the cap: the crossover vs batch-1 is visible
+        # in avg_batch_fill + req/s (cap 4 beats batching-off on this box).
         "serve": _run(
-            [py, "benchmarks/serve_bench.py", "--seconds", "6", "--clients", "8"],
+            [py, "benchmarks/serve_bench.py", "--seconds", "6", "--clients", "8",
+             "--batch_sizes", "16", "4"],
             timeout=900,
         ),
     }

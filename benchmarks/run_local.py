@@ -58,6 +58,67 @@ def _run(cmd, timeout=600, extra_env=None):
         }
 
 
+def _run_multiproc_allreduce(py, world=3, timeout=420):
+    """The reference's env-var multi-node pattern
+    (``test/test_multinode_allreduce.cc:155-181``) on loopback: one OS
+    process per rank, rank 0 hosts the broker and its table is the record.
+    Proves the WORLD_SIZE/RANK/BROKER_ADDR mode works end to end and that
+    the cross-process wire-load numbers match the in-process invariant test
+    (ring busiest peer ~2(n-1)/n payloads vs the tree's ~2)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(
+        os.environ,
+        PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        WORLD_SIZE=str(world),
+        BROKER_ADDR=f"127.0.0.1:{port}",
+    )
+    cmd = [py, "benchmarks/allreduce_bench.py", "rpc", "--iters", "3",
+           "--sizes", "100000", "1000000", "2621440"]
+    cmd_note = " ".join(cmd[1:]) + f"  (WORLD_SIZE={world}, one process per rank)"
+    t0 = time.time()
+    import tempfile
+
+    files = [tempfile.TemporaryFile("w+") for _ in range(world)]
+    procs = [
+        subprocess.Popen(cmd, cwd=ROOT, stdout=files[r], stderr=subprocess.STDOUT,
+                         text=True, env=dict(env, RANK=str(r)))
+        for r in range(world)
+    ]
+    deadline = t0 + timeout  # ONE shared budget, not per-rank
+    rcs = []
+    try:
+        for p in procs:
+            rcs.append(p.wait(timeout=max(0.0, deadline - time.time())))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        return {"cmd": cmd_note, "rc": -1,
+                "seconds": round(time.time() - t0, 1),
+                "error": f"timeout {timeout}s"}
+    files[0].seek(0)
+    out = {
+        "cmd": cmd_note,
+        "rc": max(rcs),
+        "seconds": round(time.time() - t0, 1),
+        "stdout": files[0].read().strip().splitlines(),
+    }
+    if out["rc"] != 0:
+        # The failure cause usually lives in a non-zero rank's output.
+        tails = []
+        for r, f in enumerate(files[1:], start=1):
+            f.seek(0)
+            tails += [f"rank{r}: {line}" for line in f.read().strip().splitlines()[-5:]]
+        out["stderr"] = tails
+    return out
+
+
 def main():
     env_note = {
         "host": platform.node(),
@@ -107,6 +168,7 @@ def main():
             timeout=900,
         ),
     }
+    results["allreduce_rpc_multiproc"] = _run_multiproc_allreduce(py)
     out = os.path.join(ROOT, "BENCH_LOCAL.json")
     with open(out, "w") as f:
         json.dump(results, f, indent=1)

@@ -90,6 +90,13 @@ def _run_multiproc_allreduce(py, world=3, timeout=420):
                          text=True, env=dict(env, RANK=str(r)))
         for r in range(world)
     ]
+    def rank_tails():
+        tails = []
+        for r, f in enumerate(files):
+            f.seek(0)
+            tails += [f"rank{r}: {line}" for line in f.read().strip().splitlines()[-5:]]
+        return tails
+
     deadline = t0 + timeout  # ONE shared budget, not per-rank
     rcs = []
     try:
@@ -99,23 +106,21 @@ def _run_multiproc_allreduce(py, world=3, timeout=420):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        # The most expensive failure must stay debuggable: keep the tails.
         return {"cmd": cmd_note, "rc": -1,
                 "seconds": round(time.time() - t0, 1),
-                "error": f"timeout {timeout}s"}
+                "error": f"timeout {timeout}s", "stderr": rank_tails()}
     files[0].seek(0)
     out = {
         "cmd": cmd_note,
-        "rc": max(rcs),
+        # Signal deaths are NEGATIVE returncodes; max() would mask them.
+        "rc": next((r for r in rcs if r != 0), 0),
         "seconds": round(time.time() - t0, 1),
         "stdout": files[0].read().strip().splitlines(),
     }
     if out["rc"] != 0:
         # The failure cause usually lives in a non-zero rank's output.
-        tails = []
-        for r, f in enumerate(files[1:], start=1):
-            f.seek(0)
-            tails += [f"rank{r}: {line}" for line in f.read().strip().splitlines()[-5:]]
-        out["stderr"] = tails
+        out["stderr"] = rank_tails()
     return out
 
 

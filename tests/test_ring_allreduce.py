@@ -15,14 +15,14 @@ from moolib_tpu import Broker, Group, Rpc
 from moolib_tpu.rpc import RpcError
 
 
-@pytest.fixture
-def cohort(free_port):
+def _make_cohort(free_port, n=4):
+    """broker + n loopback peers, converged; returns (broker, peers, groups, pump)."""
     addr = f"127.0.0.1:{free_port}"
     broker = Broker()
     broker.set_name("broker")
     broker.listen(addr)
     peers = []
-    for i in range(4):
+    for i in range(n):
         rpc = Rpc()
         rpc.set_name(f"rank{i}")
         rpc.listen("127.0.0.1:0")
@@ -42,6 +42,12 @@ def cohort(free_port):
         pump()
         time.sleep(0.01)
     assert all(g.active() for g in groups), "cohort never converged"
+    return broker, peers, groups, pump
+
+
+@pytest.fixture
+def cohort(free_port):
+    broker, peers, groups, pump = _make_cohort(free_port)
     try:
         yield groups, pump
     finally:
@@ -327,4 +333,42 @@ def test_accumulator_rides_ring(free_port, monkeypatch):
     finally:
         for a in accs:
             a.close()
+        broker.close()
+
+
+def test_ring_survives_dropped_and_duplicated_chunk_frames(free_port, monkeypatch):
+    """Ring chunk messages ride the RPC reliability layer, so frame-level
+    faults (drop + duplicate, the test_rpc_sim scenarios) must not change
+    results: the dropped chunk is resent via poke/nack and the duplicate is
+    deduped at-most-once.  Asyncio backend pinned for deterministic frame
+    order, like test_rpc_sim."""
+    monkeypatch.setenv("MOOLIB_TPU_NATIVE_TRANSPORT", "0")
+    from moolib_tpu.rpc import core as rpc_core
+
+    from test_rpc_sim import FrameSim  # pytest puts tests/ on sys.path
+
+    broker, peers, groups, pump = _make_cohort(free_port)
+    try:
+        data = [np.random.randn(2048).astype(np.float32) + i for i in range(4)]
+        # Clean round first: establishes rank0's connection to its ring
+        # neighbor (and the expected sum).
+        futs = [g.all_reduce("warm", d, chunked=True) for g, d in zip(groups, data)]
+        _wait(futs, pump)
+        expect = futs[0].result(0)
+        members = groups[0].members()
+        me = peers[0][0].get_name()
+        nxt = members[(members.index(me) + 1) % len(members)]
+        conn = peers[0][0]._peers[nxt].best_connection(peers[0][0]._transport_order)
+        # Drop rank0's first two chunk sends to its neighbor, duplicate the
+        # next: reliability must resend the former and dedup the latter.
+        policy = {rpc_core.KIND_REQUEST: ["drop", "drop", "dup"]}
+        with FrameSim(conn, policy) as sim:
+            futs = [g.all_reduce("faulty", d, chunked=True) for g, d in zip(groups, data)]
+            _wait(futs, pump, timeout=60)
+        assert any(a != "pass" for _, _, a in sim.log), sim.log
+        for f in futs:
+            np.testing.assert_allclose(f.result(0), expect, rtol=1e-6, atol=1e-6)
+    finally:
+        for rpc, _ in peers:
+            rpc.close()
         broker.close()

@@ -91,7 +91,7 @@ def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate
     # Service-quality introspection for load benches: queue wait/fill/depth
     # counters plus the server's own iteration count (serve_bench diffs two
     # snapshots around its measurement window).
-    counters = {"served": 0, "iterations": 0}
+    counters = {"served": 0, "iterations": 0, "bucket_pad_rows": 0}
     rpc.define(f"{name}_stats", lambda: {**queue.stats(), **counters,
                                          "batch_size": batch_size if dynamic_batching else 1})
     if mesh is not None:
@@ -129,7 +129,7 @@ def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate
                     batch = np.concatenate([prompts, pad], axis=0)
                 else:
                     batch = prompts
-                counters["bucket_pad_rows"] = counters.get("bucket_pad_rows", 0) + bucket - n
+                counters["bucket_pad_rows"] += bucket - n
             else:
                 batch = prompts
             try:

@@ -509,6 +509,12 @@ class Accumulator:
         that must never run under the accumulator lock."""
         return self._ici_membership_intact()
 
+    def cohort_size(self) -> int:
+        """Number of members in the current cohort epoch (0 before the
+        broker's first push).  Beyond-reference convenience: examples log
+        it without reaching into the internal Group."""
+        return len(self._group.members())
+
     def parameters(self):
         """Current synced parameter pytree (jax adaptation of the reference's
         in-place tensor updates)."""

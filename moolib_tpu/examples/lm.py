@@ -101,6 +101,18 @@ def make_flags(argv=None):
     p.add_argument("--log_interval", type=int, default=50)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
+    # Elastic data parallelism over the Accumulator cohort (the same
+    # machinery the RL agents ride — the plane is model-agnostic).
+    p.add_argument("--address", default=None,
+                   help="host an in-process broker here and join it")
+    p.add_argument("--connect", default=None,
+                   help="join an existing broker (elastic DP cohort)")
+    p.add_argument("--local_name", default=None,
+                   help="peer name in the cohort (default: lm_<pid>)")
+    p.add_argument("--virtual_batch_size", type=int, default=0,
+                   help="global batch per optimizer step (0: one reduction "
+                   "per contribution)")
+    p.add_argument("--wire_dtype", default=None, choices=[None, "bf16", "int8"])
     return common.finalize_flags(p, argv)
 
 
@@ -118,6 +130,20 @@ def train(flags, on_stats=None) -> dict:
     apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
     if flags.seq_len % 2:
         raise ValueError("--seq_len must be even")
+    if flags.address or flags.connect:
+        # Elastic DP rides the plain single-device step: drop the PARSER
+        # DEFAULTS that only make sense in-mesh so `--connect HOST` works
+        # as documented; an explicitly-requested mesh is a real conflict.
+        if flags.mesh == "dp=2,sp=4":
+            flags["mesh"] = ""
+        if flags.attention == "ring" and not flags.mesh:
+            flags["attention"] = "dense"
+        if flags.mesh:
+            raise ValueError(
+                "elastic DP (--address/--connect) composes with the plain "
+                "single-device step; in-mesh parallelism belongs inside a "
+                "static cohort (use the vtrace agent's --mesh for that shape)"
+            )
     mesh = parallel.parse_mesh_spec(flags.mesh)
     axes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh is not None else {}
     if mesh is not None:
@@ -215,6 +241,10 @@ def train(flags, on_stats=None) -> dict:
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss, acc
 
+    if flags.address or flags.connect:
+        return _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
+                              on_stats=on_stats)
+
     if mesh is None:
         jstep = jax.jit(step)
         put = lambda x: x
@@ -260,6 +290,104 @@ def train(flags, on_stats=None) -> dict:
         "loss": loss_v,
         "acc": acc_v,
         "tokens_per_s": flags.steps * flags.batch_size * flags.seq_len / elapsed,
+    }
+
+
+def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
+                   on_stats=None) -> dict:
+    """Elastic data-parallel LM training over the Accumulator cohort: the
+    wants/has gradient protocol the RL agents ride (leader election, model
+    sync, virtual batches, wire compression), applied unchanged to
+    TransformerLM — the elastic plane is model-agnostic by construction.
+    Peers join/leave freely; a joiner adopts the leader's model + opt state.
+    """
+    import os as _os
+
+    from .. import Accumulator, Broker
+
+    broker = None
+    if flags.address:
+        broker = Broker()
+        broker.set_name("broker")
+        broker.listen(flags.address)
+    addr = flags.connect or flags.address
+
+    acc = Accumulator("lm", params)
+    acc.set_name(flags.local_name or f"lm_{_os.getpid()}")
+    acc.listen()
+    if flags.virtual_batch_size:
+        acc.set_virtual_batch_size(flags.virtual_batch_size)
+    if flags.wire_dtype == "bf16":
+        acc.set_wire_dtype(jnp.bfloat16)
+    elif flags.wire_dtype == "int8":
+        acc.set_wire_dtype("int8")
+    acc.connect(addr)
+
+    jgrad = jax.jit(lambda p, t: jax.value_and_grad(loss_fn, has_aux=True)(p, t))
+
+    def apply_fn(p, s, g):
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s
+
+    japply = jax.jit(apply_fn)
+
+    steps_done = 0
+    loss_v = acc_v = None
+    start = time.time()
+    try:
+        while steps_done < flags.steps:
+            if broker is not None:
+                broker.update()
+            acc.update()
+            if acc.wants_state():
+                acc.set_state({
+                    "opt_state": jax.device_get(opt_state),
+                    "steps": steps_done,
+                })
+            if acc.has_new_state():
+                st = acc.state()
+                if st is not None:
+                    opt_state = st["opt_state"]
+                    steps_done = max(steps_done, int(st["steps"]))
+                    params = acc.parameters()
+            if not acc.connected():
+                time.sleep(0.02)
+                continue
+            if acc.has_gradients():
+                grads = acc.gradients()
+                params, opt_state = japply(acc.parameters(), opt_state, grads)
+                acc.set_parameters(params)
+                acc.zero_gradients()
+                steps_done += 1
+                if steps_done % flags.log_interval == 0:
+                    if not flags.quiet:
+                        print(
+                            f"step={steps_done} loss={loss_v} acc={acc_v} "
+                            f"cohort={acc.cohort_size()}",
+                            flush=True,
+                        )
+                    if on_stats is not None:
+                        on_stats({"step": steps_done, "loss": loss_v, "acc": acc_v})
+            elif acc.wants_gradients():
+                tokens = jnp.asarray(make_batch(rng, flags))
+                (loss, a), grads = jgrad(params, tokens)
+                loss_v, acc_v = float(loss), float(a)
+                acc.reduce_gradients(flags.batch_size, grads)
+            else:
+                time.sleep(0.002)
+    finally:
+        info = acc.debug_info()
+        acc.close()
+        if broker is not None:
+            broker.close()
+    elapsed = time.time() - start
+    return {
+        "steps": steps_done,
+        "loss": loss_v,
+        "acc": acc_v,
+        "tokens_per_s": steps_done * flags.batch_size * flags.seq_len / max(elapsed, 1e-6),
+        "reduces": info["rpc_reduces"] + info["ici_reduces"],
+        "wire_dtype": info["wire_dtype"],
     }
 
 

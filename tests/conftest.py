@@ -50,3 +50,27 @@ def free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def grab_port() -> int:
+    """Module-level port helper for subprocess tests (the free_port fixture
+    covers in-process uses); one definition so a strategy change (e.g.
+    SO_REUSEADDR) lands everywhere."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def subprocess_env(root: str) -> dict:
+    """Env for spawning repo entry points: repo on PYTHONPATH, CPU pinned."""
+    import os
+
+    return dict(
+        os.environ,
+        PYTHONPATH=root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )

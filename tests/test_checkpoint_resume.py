@@ -10,28 +10,17 @@ saved step count instead of starting over.
 import csv
 import os
 import signal
-import socket
 import subprocess
 import sys
 import time
 
+from conftest import grab_port, subprocess_env
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
-
-
 def _spawn(args, log_path):
-    env = dict(
-        os.environ,
-        PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
-        JAX_PLATFORMS="cpu",
-    )
+    env = subprocess_env(ROOT)
     with open(log_path, "w") as log:  # child keeps its own dup of the fd
         return subprocess.Popen(
             [sys.executable, "-m", "moolib_tpu.examples.vtrace.experiment"] + args,
@@ -68,7 +57,7 @@ def test_sigterm_checkpoint_then_resume(tmp_path):
     dir1.mkdir()
     p1 = _spawn(
         args_common + [
-            "--address", f"127.0.0.1:{_free_port()}",
+            "--address", f"127.0.0.1:{grab_port()}",
             "--total_steps", "1000000000",
             "--localdir", str(dir1),
         ],
@@ -106,7 +95,7 @@ def test_sigterm_checkpoint_then_resume(tmp_path):
     target = int(saved + 3000)
     p2 = _spawn(
         args_common + [
-            "--address", f"127.0.0.1:{_free_port()}",
+            "--address", f"127.0.0.1:{grab_port()}",
             "--total_steps", str(target),
             "--localdir", str(dir2),
         ],

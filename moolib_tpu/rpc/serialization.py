@@ -77,12 +77,16 @@ class _Pickler(pickle.Pickler):
         self._arrays = arrays
 
     def persistent_id(self, obj):
+        # np.asarray(order="C") forces contiguity like ascontiguousarray but
+        # WITHOUT its documented at-least-1d promotion: a 0-d loss scalar
+        # must come back 0-d, not shape (1,) (caught by the hypothesis
+        # round-trip sweep in tests/test_serialization.py).
         if isinstance(obj, np.ndarray) and obj.dtype != object:
-            arr = np.ascontiguousarray(obj)
+            arr = np.asarray(obj, order="C")
             self._arrays.append(ArrayRef(arr.dtype.name, arr.shape, "np", _raw_data(arr)))
             return ("__array__", len(self._arrays) - 1)
         if _is_jax_array(obj):
-            host = np.ascontiguousarray(np.asarray(obj))
+            host = np.asarray(obj, order="C")
             self._arrays.append(ArrayRef(host.dtype.name, host.shape, "jax", _raw_data(host)))
             return ("__array__", len(self._arrays) - 1)
         if isinstance(obj, (np.generic,)):
@@ -107,11 +111,13 @@ class _Unpickler(pickle.Unpickler):
 
 def _raw_data(arr: np.ndarray):
     """Contiguous raw bytes of an array; extension dtypes (bfloat16, fp8 from
-    ml_dtypes) don't implement the buffer protocol, so view through uint8."""
+    ml_dtypes) don't implement the buffer protocol, so view through uint8 —
+    via a 1-d reshape, because numpy refuses itemsize-changing views of 0-d
+    arrays (the shape travels separately in the ArrayRef)."""
     try:
         return arr.data
     except (ValueError, BufferError):
-        return arr.view(np.uint8).data
+        return arr.reshape(-1).view(np.uint8).data
 
 
 def _np_dtype(name: str):

@@ -1,0 +1,88 @@
+"""Hypothesis sweep: arbitrary nested payloads through BOTH codec paths.
+
+tests/test_serialization.py pins known shapes; this hunts the unknown ones
+(deep nesting, extension dtypes, 0-d/empty arrays, mixed containers) that a
+wire format regresses on silently — it caught the portable codec promoting
+0-d arrays to shape (1,) within seconds of being written.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from moolib_tpu.rpc import serialization as ser  # noqa: E402
+
+try:
+    import ml_dtypes
+
+    _EXT_DTYPES = [np.dtype(ml_dtypes.bfloat16)]
+except ImportError:  # pragma: no cover
+    _EXT_DTYPES = []
+
+_scalars = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(2**63), max_value=2**63 - 1),
+    st.integers(min_value=-(2**100), max_value=2**100),  # bigint tag path
+    st.floats(allow_nan=False),
+    st.text(max_size=20),
+    st.binary(max_size=20),
+)
+
+
+def _np_arrays():
+    dtypes = st.sampled_from(
+        [np.dtype(d) for d in ("f4", "f8", "i4", "i8", "u1", "i2", "?")]
+        + _EXT_DTYPES
+    )
+    shapes = st.lists(st.integers(0, 4), min_size=0, max_size=3).map(tuple)
+    return st.builds(
+        lambda dt, sh, seed: np.random.default_rng(seed)
+        .integers(0, 2, size=sh)
+        .astype(dt),
+        dtypes, shapes, st.integers(0, 2**31),
+    )
+
+
+_payloads = st.recursive(
+    st.one_of(_scalars, _np_arrays()),
+    lambda children: st.one_of(
+        st.lists(children, max_size=4),
+        st.tuples(children, children),
+        st.dictionaries(st.text(max_size=8), children, max_size=4),
+    ),
+    max_leaves=12,
+)
+
+
+def _assert_same(a, b):
+    if isinstance(a, np.ndarray):
+        assert isinstance(b, np.ndarray) and a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float64) if a.dtype in _EXT_DTYPES else a,
+            np.asarray(b, np.float64) if b.dtype in _EXT_DTYPES else b,
+        )
+    elif isinstance(a, (list, tuple)):
+        assert type(a) is type(b) and len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_same(x, y)
+    elif isinstance(a, dict):
+        assert a.keys() == b.keys()
+        for k in a:
+            _assert_same(a[k], b[k])
+    else:
+        assert type(a) is type(b) and a == b
+
+
+@settings(max_examples=150, deadline=None)
+@given(_payloads)
+def test_property_roundtrip_negotiated_codec(obj):
+    _assert_same(ser.loads(ser.dumps(obj)), obj)
+
+
+@settings(max_examples=150, deadline=None)
+@given(_payloads)
+def test_property_roundtrip_portable_codec(obj):
+    _assert_same(ser.deserialize(ser.unpack(ser.dumps_portable(obj))), obj)

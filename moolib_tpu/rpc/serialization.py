@@ -80,9 +80,21 @@ class _Pickler(pickle.Pickler):
         # np.asarray(order="C") forces contiguity like ascontiguousarray but
         # WITHOUT its documented at-least-1d promotion: a 0-d loss scalar
         # must come back 0-d, not shape (1,) (caught by the hypothesis
-        # round-trip sweep in tests/test_serialization.py).
-        if isinstance(obj, np.ndarray) and obj.dtype != object:
+        # round-trip sweep in tests/test_serialization_props.py).
+        # Structured dtypes (dtype.names) pickle inline: the ArrayRef wire
+        # format encodes dtype by NAME, which cannot express field layouts.
+        if (
+            isinstance(obj, np.ndarray)
+            and obj.dtype != object
+            and obj.dtype.names is None
+        ):
             arr = np.asarray(obj, order="C")
+            # dtype.name is lossy for byte order ('>i4' -> 'int32'): decode
+            # would silently reinterpret foreign-endian bytes as native.
+            # Canonicalize whenever the name round-trip changes the dtype.
+            canonical = _np_dtype(arr.dtype.name)
+            if canonical != arr.dtype:
+                arr = arr.astype(canonical)
             self._arrays.append(ArrayRef(arr.dtype.name, arr.shape, "np", _raw_data(arr)))
             return ("__array__", len(self._arrays) - 1)
         if _is_jax_array(obj):

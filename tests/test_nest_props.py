@@ -1,0 +1,85 @@
+"""Hypothesis sweep over utils.nest: the pytree machinery every RPC batch
+rides (stack/unstack for dynamic batching, pack_as/flatten for templates).
+Pinned properties: flatten/pack_as and stack/unstack are exact inverses for
+arbitrary nest structures, and stacking matches numpy semantics leaf-wise.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from moolib_tpu.utils import nest  # noqa: E402
+
+_leaves = st.one_of(
+    st.integers(-100, 100),
+    st.floats(allow_nan=False, allow_infinity=False, width=32),
+    st.text(max_size=6),
+    st.builds(
+        lambda sh, seed: np.random.default_rng(seed).normal(size=sh).astype(np.float32),
+        st.lists(st.integers(1, 3), min_size=0, max_size=2).map(tuple),
+        st.integers(0, 2**31),
+    ),
+)
+
+_nests = st.recursive(
+    _leaves,
+    lambda children: st.one_of(
+        st.lists(children, min_size=1, max_size=3),
+        st.tuples(children, children),
+        st.dictionaries(st.text(max_size=4), children, min_size=1, max_size=3),
+    ),
+    max_leaves=8,
+)
+
+
+def _same(a, b):
+    # nest.stack/unstack land leaves as jax arrays by design (device
+    # batching); compare any array-ish pair by value+shape.
+    if isinstance(a, (np.ndarray, jax.Array)) or isinstance(b, (np.ndarray, jax.Array)):
+        assert np.shape(a) == np.shape(b)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    elif isinstance(a, (list, tuple)):
+        assert type(a) is type(b) and len(a) == len(b)
+        for x, y in zip(a, b):
+            _same(x, y)
+    elif isinstance(a, dict):
+        assert a.keys() == b.keys()
+        for k in a:
+            _same(a[k], b[k])
+    else:
+        assert a == b
+
+
+@settings(max_examples=120, deadline=None)
+@given(_nests)
+def test_flatten_pack_as_inverse(n):
+    flat = list(nest.flatten(n))
+    _same(nest.pack_as(n, flat), n)
+
+
+@settings(max_examples=120, deadline=None)
+@given(_nests, st.integers(1, 3))
+def test_stack_unstack_inverse(n, k):
+    stacked = nest.stack([n] * k, dim=0)
+    out = nest.unstack(stacked, dim=0)
+    assert len(out) == k
+    for o in out:
+        _same(o, n)
+
+
+@settings(max_examples=80, deadline=None)
+@given(
+    st.builds(
+        lambda sh, seed: np.random.default_rng(seed).normal(size=sh).astype(np.float32),
+        st.lists(st.integers(1, 3), min_size=1, max_size=2).map(tuple),
+        st.integers(0, 2**31),
+    ),
+    st.integers(2, 4),
+)
+def test_stack_matches_numpy(arr, k):
+    arrs = [arr + i for i in range(k)]
+    out = nest.stack([{"x": a} for a in arrs], dim=0)["x"]
+    np.testing.assert_array_equal(np.asarray(out), np.stack(arrs, axis=0))

@@ -34,7 +34,7 @@ _scalars = st.one_of(
 
 def _np_arrays():
     dtypes = st.sampled_from(
-        [np.dtype(d) for d in ("f4", "f8", "i4", "i8", "u1", "i2", "?")]
+        [np.dtype(d) for d in ("f4", "f8", "i4", "i8", "u1", "i2", "?", ">i4", ">f8")]
         + _EXT_DTYPES
     )
     shapes = st.lists(st.integers(0, 4), min_size=0, max_size=3).map(tuple)
@@ -59,7 +59,10 @@ _payloads = st.recursive(
 
 def _assert_same(a, b):
     if isinstance(a, np.ndarray):
-        assert isinstance(b, np.ndarray) and a.dtype == b.dtype and a.shape == b.shape
+        # dtype modulo byte order: the portable wire normalizes foreign
+        # endianness to native (values exact, representation canonical).
+        assert isinstance(b, np.ndarray) and a.shape == b.shape
+        assert a.dtype.newbyteorder("=") == b.dtype.newbyteorder("=")
         np.testing.assert_array_equal(
             np.asarray(a, np.float64) if a.dtype in _EXT_DTYPES else a,
             np.asarray(b, np.float64) if b.dtype in _EXT_DTYPES else b,

@@ -89,17 +89,11 @@ class _Pickler(pickle.Pickler):
             and obj.dtype.names is None
         ):
             arr = np.asarray(obj, order="C")
-            # dtype.name is lossy for byte order ('>i4' -> 'int32'): decode
-            # would silently reinterpret foreign-endian bytes as native.
-            # Canonicalize whenever the name round-trip changes the dtype.
-            canonical = _np_dtype(arr.dtype.name)
-            if canonical != arr.dtype:
-                arr = arr.astype(canonical)
-            self._arrays.append(ArrayRef(arr.dtype.name, arr.shape, "np", _raw_data(arr)))
+            self._arrays.append(ArrayRef(_dtype_tag(arr.dtype), arr.shape, "np", _raw_data(arr)))
             return ("__array__", len(self._arrays) - 1)
         if _is_jax_array(obj):
             host = np.asarray(obj, order="C")
-            self._arrays.append(ArrayRef(host.dtype.name, host.shape, "jax", _raw_data(host)))
+            self._arrays.append(ArrayRef(_dtype_tag(host.dtype), host.shape, "jax", _raw_data(host)))
             return ("__array__", len(self._arrays) - 1)
         if isinstance(obj, (np.generic,)):
             # 0-dim numpy scalars pickle fine inline; keep them in-band.
@@ -130,6 +124,20 @@ def _raw_data(arr: np.ndarray):
         return arr.data
     except (ValueError, BufferError):
         return arr.reshape(-1).view(np.uint8).data
+
+
+def _dtype_tag(dt: np.dtype) -> str:
+    """Wire tag for a dtype: the typestr when it round-trips (lossless for
+    byte order and str/bytes/void widths — dtype.NAME is not: '>i4' names
+    as 'int32' and '<U3' as 'str96'), else the name, which resolves
+    extension dtypes (bfloat16, fp8) via ml_dtypes on decode."""
+    s = dt.str
+    try:
+        if np.dtype(s) == dt:
+            return s
+    except TypeError:
+        pass
+    return dt.name
 
 
 def _np_dtype(name: str):

@@ -207,8 +207,10 @@ int encode(PyObject* obj, Writer& w, PyObject* arrays, int depth) {
   }
   if (PyArray_Check(obj)) {
     PyArrayObject* arr = (PyArrayObject*)obj;
-    // Object arrays can't go raw; fall through to pickle.
-    if (PyArray_TYPE(arr) != NPY_OBJECT) {
+    // Object arrays can't go raw, and structured dtypes have no parseable
+    // one-token typestr on the wire; both fall through to pickle.
+    if (PyArray_TYPE(arr) != NPY_OBJECT &&
+        !PyDataType_HASFIELDS(PyArray_DESCR(arr))) {
       PyArrayObject* contig =
           (PyArrayObject*)PyArray_GETCONTIGUOUS(arr);  // new ref (maybe copy)
       if (!contig) {

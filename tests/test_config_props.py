@@ -1,0 +1,55 @@
+"""Hypothesis sweep over utils.config: YAML round-trip and hydra-style
+overrides on arbitrary (interpolation-free) nested configs.  Values
+containing ${...} have interpolation semantics by design and are pinned in
+tests/test_config.py; this sweep guards everything else a user can feed
+the config system.
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis")
+yaml = pytest.importorskip("yaml")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from moolib_tpu.utils.config import Config  # noqa: E402
+
+_keys = st.text(
+    alphabet=st.characters(whitelist_categories=("Ll",), max_codepoint=0x7F),
+    min_size=1, max_size=6,
+)
+
+_plain_text = st.text(max_size=12).filter(lambda s: "${" not in s)
+
+_values = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(-(10**9), 10**9),
+    st.floats(allow_nan=False, allow_infinity=False),
+    _plain_text,
+    st.lists(st.integers(-5, 5), max_size=3),
+)
+
+_configs = st.recursive(
+    st.dictionaries(_keys, _values, min_size=1, max_size=3),
+    lambda children: st.dictionaries(_keys, st.one_of(_values, children),
+                                     min_size=1, max_size=3),
+    max_leaves=10,
+)
+
+
+@settings(max_examples=120, deadline=None)
+@given(_configs)
+def test_yaml_roundtrip(data):
+    cfg = Config.from_dict(data)
+    again = yaml.safe_load(cfg.to_yaml()) or {}
+    assert again == cfg.to_dict()
+
+
+@settings(max_examples=120, deadline=None)
+@given(_configs, _keys, _keys, st.integers(-100, 100))
+def test_override_sets_typed_nested_value(data, k1, k2, v):
+    cfg = Config.from_dict(data)
+    cfg.apply_override(f"{k1}.{k2}={v}")
+    assert cfg.to_dict()[k1][k2] == v
+    cfg.apply_override(f"{k1}.{k2}=true")
+    assert cfg.to_dict()[k1][k2] is True

@@ -371,3 +371,21 @@ def test_ici_plane_switches_across_eligibility_churn(free_port):
         assert not a0._inflight, "stranded round after churn"
     finally:
         close_all(broker, accs)
+
+
+def test_ici_progress_bound_adapts_to_round_duration():
+    """The wedged-peer heartbeat's effective bound stretches with observed
+    round cost (4x last + 5s, floored at the configured bound) so a
+    legitimately slow collective is never proposed for abort — the formula
+    the wedge tests rely on, pinned directly."""
+    acc = Accumulator("t", {"w": np.zeros((2,), np.float32)})
+    try:
+        assert acc._ici_progress_bound_now() == acc._ici_progress_bound == 20.0
+        acc.set_ici_progress_bound(6.0)
+        assert acc._ici_progress_bound_now() == 6.0
+        acc._ici_last_round_s = 10.0  # slow but healthy rounds observed
+        assert acc._ici_progress_bound_now() == 4 * 10.0 + 5.0
+        acc._ici_last_round_s = 0.1
+        assert acc._ici_progress_bound_now() == 6.0  # configured floor wins
+    finally:
+        acc.close()

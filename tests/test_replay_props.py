@@ -1,0 +1,59 @@
+"""Hypothesis sweep over the prioritized-replay SumTree: prefix-sum
+invariants under arbitrary interleaved set/sample sequences — the
+structure importance sampling correctness rests on.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from moolib_tpu.replay import SumTree  # noqa: E402
+
+_ops = st.lists(
+    st.tuples(
+        st.integers(0, 31),                      # leaf index
+        st.floats(0.0, 1e6, allow_nan=False),    # priority
+    ),
+    min_size=1, max_size=40,
+)
+
+
+@settings(max_examples=150, deadline=None)
+@given(st.integers(1, 32), _ops)
+def test_total_is_sum_of_leaves(capacity, ops):
+    t = SumTree(capacity)
+    leaves = np.zeros(t.capacity)
+    for idx, v in ops:
+        idx %= t.capacity
+        t.set(idx, v)
+        leaves[idx] = v
+        assert np.isclose(t.total(), leaves.sum(), rtol=1e-12, atol=1e-9)
+        np.testing.assert_allclose(t.get(np.arange(t.capacity)), leaves)
+
+
+@settings(max_examples=150, deadline=None)
+@given(st.integers(2, 32), _ops, st.integers(0, 2**31))
+def test_sample_lands_in_prefix_interval(capacity, ops, seed):
+    t = SumTree(capacity)
+    leaves = np.zeros(t.capacity)
+    for idx, v in ops:
+        idx %= t.capacity
+        t.set(idx, v)
+        leaves[idx] = v
+    if leaves.sum() <= 0:
+        return
+    rng = np.random.default_rng(seed)
+    targets = rng.uniform(0, leaves.sum(), size=16)
+    got = t.sample(targets)
+    # Every sampled leaf's prefix interval [cum[i], cum[i]+leaf) must
+    # contain its target (ties at boundaries may go either way; zero-mass
+    # leaves must never be returned for strictly interior targets).
+    cum = np.concatenate([[0.0], np.cumsum(leaves)])
+    for target, leaf in zip(targets, got):
+        assert 0 <= leaf < t.capacity
+        assert leaves[leaf] > 0 or np.isclose(target, cum[leaf], atol=1e-9), (
+            target, leaf, leaves[leaf])
+        assert cum[leaf] <= target + 1e-9
+        assert target <= cum[leaf + 1] + 1e-9

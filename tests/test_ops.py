@@ -13,11 +13,15 @@ from moolib_tpu.ops import (
 )
 
 
-def naive_vtrace(log_rhos, discounts, rewards, values, bootstrap, rho_bar, c_bar):
+def naive_vtrace(log_rhos, discounts, rewards, values, bootstrap, rho_bar,
+                 pg_rho_bar=None, lam=1.0):
+    """Independent python-loop oracle for the v-trace recursion (the shared
+    reference for the example test here and the hypothesis sweep in
+    tests/test_vtrace_props.py)."""
     T, B = rewards.shape
     rhos = np.exp(log_rhos)
     cr = np.minimum(rho_bar, rhos)
-    cs = np.minimum(1.0, rhos)
+    cs = lam * np.minimum(1.0, rhos)
     vs = np.zeros((T + 1, B))
     vs[T] = bootstrap
     values_ext = np.concatenate([values, bootstrap[None]], 0)
@@ -27,7 +31,8 @@ def naive_vtrace(log_rhos, discounts, rewards, values, bootstrap, rho_bar, c_bar
         acc = delta + discounts[t] * cs[t] * acc
         vs[t] = values[t] + acc
     vs_t1 = vs[1:]
-    pg_adv = np.minimum(rho_bar, rhos) * (rewards + discounts * vs_t1 - values)
+    pg_bar = rho_bar if pg_rho_bar is None else pg_rho_bar
+    pg_adv = np.minimum(pg_bar, rhos) * (rewards + discounts * vs_t1 - values)
     return vs[:-1], pg_adv
 
 
@@ -61,7 +66,7 @@ def test_vtrace_matches_naive():
         np.take_along_axis(logp(behavior), actions[..., None], -1).squeeze(-1)
     )
     np.testing.assert_allclose(np.asarray(out.log_rhos), lr, rtol=1e-3, atol=1e-4)
-    vs, pg = naive_vtrace(lr, discounts, rewards, values, bootstrap, 1.0, 1.0)
+    vs, pg = naive_vtrace(lr, discounts, rewards, values, bootstrap, 1.0)
     np.testing.assert_allclose(np.asarray(out.vs), vs, rtol=1e-3, atol=1e-3)
     np.testing.assert_allclose(np.asarray(out.pg_advantages), pg, rtol=1e-3, atol=1e-3)
 

@@ -1,0 +1,48 @@
+"""Hypothesis sweep of the v-trace scan against an independent python-loop
+oracle: arbitrary shapes, separate rho/pg-rho clip thresholds, lambda, hard
+episode boundaries (zero discounts), and extreme importance ratios.  The
+example-based tests pin one geometry; this guards the whole parameter box
+the IMPALA loss can reach.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from moolib_tpu.ops import vtrace  # noqa: E402
+from test_ops import naive_vtrace  # noqa: E402 — ONE oracle for both test files
+
+# Hoisted: one jit wrapper so repeated (T, B, statics) hit the compile cache
+# across hypothesis examples instead of recompiling per example.
+_jit_vtrace = jax.jit(vtrace.from_importance_weights, static_argnums=(5, 6, 7))
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.integers(1, 8),                       # T
+    st.integers(1, 4),                       # B
+    st.integers(0, 2**31),                   # seed
+    st.sampled_from([0.5, 1.0, 2.0]),        # clip_rho_threshold
+    st.sampled_from([0.5, 1.0, 2.0]),        # clip_pg_rho_threshold
+    st.sampled_from([0.0, 0.5, 1.0]),        # lambda
+    st.floats(0.0, 1.0),                     # episode-boundary density
+)
+def test_vtrace_matches_oracle(T, B, seed, rho_bar, pg_rho_bar, lam, p_done):
+    rng = np.random.default_rng(seed)
+    log_rhos = rng.uniform(-5, 5, size=(T, B))
+    discounts = (rng.random((T, B)) > p_done).astype(np.float64) * 0.99
+    rewards = rng.normal(size=(T, B))
+    values = rng.normal(size=(T, B))
+    bootstrap = rng.normal(size=(B,))
+    out = _jit_vtrace(
+        jnp.asarray(log_rhos), jnp.asarray(discounts), jnp.asarray(rewards),
+        jnp.asarray(values), jnp.asarray(bootstrap), rho_bar, pg_rho_bar, lam,
+    )
+    vs, pg = naive_vtrace(log_rhos, discounts, rewards, values, bootstrap,
+                          rho_bar, pg_rho_bar, lam)
+    np.testing.assert_allclose(np.asarray(out.vs), vs, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages), pg, rtol=1e-5, atol=1e-5)

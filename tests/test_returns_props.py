@@ -1,0 +1,63 @@
+"""Hypothesis sweep of the returns ops (discounted returns, GAE) against
+python-loop oracles: arbitrary shapes, lambda, and hard episode boundaries.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from moolib_tpu.ops.returns import (  # noqa: E402
+    discounted_returns,
+    generalized_advantage_estimation,
+)
+
+_jit_returns = jax.jit(discounted_returns)
+_jit_gae = jax.jit(generalized_advantage_estimation, static_argnums=(4,))
+
+
+def _case(T, B, seed, p_done):
+    rng = np.random.default_rng(seed)
+    rewards = rng.normal(size=(T, B))
+    discounts = (rng.random((T, B)) > p_done).astype(np.float64) * 0.97
+    values = rng.normal(size=(T, B))
+    bootstrap = rng.normal(size=(B,))
+    return rewards, discounts, values, bootstrap
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.integers(1, 8), st.integers(1, 4), st.integers(0, 2**31),
+       st.floats(0.0, 1.0))
+def test_discounted_returns_matches_loop(T, B, seed, p_done):
+    rewards, discounts, _, bootstrap = _case(T, B, seed, p_done)
+    out = np.asarray(_jit_returns(
+        jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(bootstrap)))
+    exp = np.zeros((T, B))
+    acc = bootstrap.copy()
+    for t in reversed(range(T)):
+        acc = rewards[t] + discounts[t] * acc
+        exp[t] = acc
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-5)
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.integers(1, 8), st.integers(1, 4), st.integers(0, 2**31),
+       st.floats(0.0, 1.0), st.sampled_from([0.0, 0.5, 0.95, 1.0]))
+def test_gae_matches_loop(T, B, seed, p_done, lam):
+    rewards, discounts, values, bootstrap = _case(T, B, seed, p_done)
+    adv, targets = _jit_gae(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(discounts),
+        jnp.asarray(bootstrap), lam,
+    )
+    values_t1 = np.concatenate([values[1:], bootstrap[None]], 0)
+    deltas = rewards + discounts * values_t1 - values
+    exp = np.zeros((T, B))
+    acc = np.zeros(B)
+    for t in reversed(range(T)):
+        acc = deltas[t] + discounts[t] * lam * acc
+        exp[t] = acc
+    np.testing.assert_allclose(np.asarray(adv), exp, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(targets), exp + values, rtol=1e-5, atol=1e-5)

@@ -7,6 +7,9 @@ set -u
 OUT=${1:-/root/repo/BENCH_CAPTURE_r05}
 mkdir -p "$OUT"
 cd /root/repo
+# `python benchmarks/foo.py` puts benchmarks/ (not the repo root) on
+# sys.path; the package must still be importable.
+export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 run() {
   local name=$1 tmo=$2; shift 2

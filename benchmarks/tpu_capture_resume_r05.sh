@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 battery resume: the first pass captured impala_bench (84,692 SPS
+# on-chip) and the forward flash tests, but a sys.path regression (the
+# package was importable from the repo root, not from `python benchmarks/x`)
+# failed every `benchmarks/*.py` step, and the backward flash tests exposed
+# a real TPU-lowering bug in the bwd kernels' row-table BlockSpecs (fixed in
+# ops/flash_attention.py).  This script waits for any in-flight step, then
+# runs the remaining battery in artifact-value order.
+set -u
+OUT=${1:-/root/repo/BENCH_CAPTURE_r05}
+mkdir -p "$OUT"
+cd /root/repo
+export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
+
+# Wait for a prior chip job (e.g. the still-running roofline) to drain.
+while pgrep -f "benchmarks/impala_roofline.py" > /dev/null; do sleep 15; done
+
+run() {
+  local name=$1 tmo=$2; shift 2
+  echo "[$(date +%H:%M:%S)] start $name" >> "$OUT/capture.log"
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "[$(date +%H:%M:%S)] done  $name rc=$rc" >> "$OUT/capture.log"
+}
+
+run lm_bench 1800 python benchmarks/lm_bench.py
+run flash_bench 1500 python benchmarks/flash_bench.py
+run flash_tests 1200 env MOOLIB_RUN_TPU_TESTS=1 \
+  python -m pytest tests/test_flash_attention_tpu.py -v
+run agent_bench 1200 python benchmarks/agent_bench.py --scale reference
+run envpool_atari 600 python benchmarks/envpool_bench.py --env synthetic \
+  --batch_size 128 --num_processes 8 --steps 100
+run serve_bench 1500 python benchmarks/serve_bench.py --seconds 20 \
+  --clients 16 --d_model 512 --layers 8 --heads 8 --kv_heads 8 2 \
+  --batch_sizes 16 4 32 --seq_len 128 --max_new_tokens 64 --vocab 32000
+run fold_capture 120 python benchmarks/fold_capture.py "$OUT" /root/repo/BENCH_TPU.json
+echo "[$(date +%H:%M:%S)] resume battery complete" >> "$OUT/capture.log"

@@ -253,7 +253,7 @@ def _flash_bwd_dq_kernel(
     """dq pass: one q block per (batch*head, qi), kv blocks stream innermost.
 
     Works in scores-transposed layout — st = k @ qᵀ is [block_k, block_q] —
-    so the per-row lse/delta tables enter as natural (1, block_q) row
+    so the per-row lse/delta tables enter as natural (1, 1, block_q) row
     vectors (no sublane→lane transpose anywhere on the TPU).
     """
     qi = pl.program_id(1)
@@ -272,12 +272,12 @@ def _flash_bwd_dq_kernel(
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
             st = jnp.where(q_pos >= k_pos, st, _NEG_INF)
-        pt = jnp.exp(st - lse_ref[:])  # masked entries underflow to 0
+        pt = jnp.exp(st - lse_ref[0])  # masked entries underflow to 0
         dpt = jax.lax.dot_general(
             v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bk, bq]
-        dst = pt * (dpt - delta_ref[:]) * scale
+        dst = pt * (dpt - delta_ref[0]) * scale
         dq_scr[:] += jax.lax.dot_general(
             dst.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -319,7 +319,7 @@ def _flash_bwd_dkv_kernel(
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
             st = jnp.where(q_pos >= k_pos, st, _NEG_INF)
-        pt = jnp.exp(st - lse_ref[:])
+        pt = jnp.exp(st - lse_ref[0])
         dv_scr[:] += jax.lax.dot_general(
             pt.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -328,7 +328,7 @@ def _flash_bwd_dkv_kernel(
             v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dst = pt * (dpt - delta_ref[:]) * scale
+        dst = pt * (dpt - delta_ref[0]) * scale
         dk_scr[:] += jax.lax.dot_general(
             dst.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -388,7 +388,13 @@ def _flash_backward(
         )
 
     kwargs = dict(scale=scale, causal=causal, block_q=bq, block_k=bk)
-    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    # The row tables ride as [B*H, 1, T]: TPU lowering constrains the last
+    # two block dims (divisible by (8, 128) or equal to the array dims), so
+    # a 2-D (1, bq) block over [B*H, T] is illegal when B*H > 1 — the unit
+    # dim must sit in the constrained sublane slot, where 1 == 1 passes.
+    lse = lse.reshape(B * H, 1, Tq)
+    delta = delta.reshape(B * H, 1, Tq)
+    row_spec = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **kwargs),
@@ -407,7 +413,7 @@ def _flash_backward(
         interpret=interpret,
     )(kb, qb, vb, dob, lse, delta)
 
-    qrow_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, j))
+    qrow_spec = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **kwargs),
         grid=(B * H, Tk // bk, Tq // bq),

@@ -141,7 +141,17 @@ def main():
     else:
         data = {}
 
-    today = datetime.date.today().isoformat()
+    def stamp(name):
+        """Capture time = the log's mtime date.  The watcher re-folds the
+        whole dir on every revival pass, so stamping fold time would
+        falsify the staleness label bench.py attaches to last_good_tpu."""
+        try:
+            return datetime.date.fromtimestamp(
+                os.path.getmtime(os.path.join(cap, name))
+            ).isoformat()
+        except OSError:
+            return datetime.date.today().isoformat()
+
     updated = []
     impala = parse_impala(os.path.join(cap, "impala_bench.log"))
     if impala:
@@ -149,37 +159,54 @@ def main():
         # repro notes, config) survive unless the fresh run overwrote them.
         merged = dict(data.get("impala_learner", {}))
         merged.update(impala)
-        merged["captured_when"] = today
+        merged["captured_when"] = stamp("impala_bench.log")
         data["impala_learner"] = merged
         # Only the headline capture refreshes the top-level date bench.py's
         # last_good_tpu labels stale data with.
-        data["when"] = today
+        data["when"] = merged["captured_when"]
         updated.append("impala_learner")
-    lm = parse_lm(os.path.join(cap, "lm_bench.log"))
-    if lm:
-        data["lm_train"] = dict(lm, captured_when=today)
+    # The short-window battery splits the LM sweep into lm_quick/lm_full
+    # logs; merge their rows (keyed by config) with the single-log name.
+    lm_parts = {n: parse_lm(os.path.join(cap, n))
+                for n in ("lm_bench.log", "lm_quick.log", "lm_full.log")}
+    lm_logs = [n for n, part in lm_parts.items() if part]
+    if lm_logs:
+        rows, meta = {}, None
+        for n in lm_logs:
+            part = lm_parts[n]
+            meta = {k: v for k, v in part.items() if k != "rows"}
+            for r in part.get("rows", []):
+                rows[(r.get("T"), r.get("B"), r.get("remat"))] = r
+        data["lm_train"] = dict(
+            meta, rows=sorted(rows.values(), key=lambda r: (r.get("T", 0), r.get("remat", False), r.get("B", 0))),
+            captured_when=stamp(lm_logs[-1]),
+        )
         updated.append("lm_train")
     flash = parse_flash(os.path.join(cap, "flash_bench.log"))
     if flash:
         fa = data.setdefault("flash_attention", {})
         fa["bench_tables"] = flash
-        fa["bench_tables_captured_when"] = today
+        fa["bench_tables_captured_when"] = stamp("flash_bench.log")
         updated.append("flash_attention.bench_tables")
-    roof = parse_roofline(os.path.join(cap, "impala_roofline.log"))
-    if roof:
-        data["impala_roofline"] = dict(roof, captured_when=today)
-        updated.append("impala_roofline")
+    # roofline_chip.log is the short-window battery's name for the same
+    # run; the fresher of the two wins and the section folds once.
+    for roof_log in ("roofline_chip.log", "impala_roofline.log"):
+        roof = parse_roofline(os.path.join(cap, roof_log))
+        if roof:
+            data["impala_roofline"] = dict(roof, captured_when=stamp(roof_log))
+            updated.append("impala_roofline")
+            break
     agent = parse_agent(os.path.join(cap, "agent_bench.log"))
     if agent:
-        data["impala_agent"] = dict(agent, captured_when=today)
+        data["impala_agent"] = dict(agent, captured_when=stamp("agent_bench.log"))
         updated.append("impala_agent")
     pool = parse_envpool(os.path.join(cap, "envpool_atari.log"))
     if pool:
-        data["envpool_atari"] = dict(pool, captured_when=today)
+        data["envpool_atari"] = dict(pool, captured_when=stamp("envpool_atari.log"))
         updated.append("envpool_atari")
     serve = parse_serve(os.path.join(cap, "serve_bench.log"))
     if serve:
-        data["lm_serve"] = {"rows": serve, "captured_when": today}
+        data["lm_serve"] = {"rows": serve, "captured_when": stamp("serve_bench.log")}
         updated.append("lm_serve")
 
     if not updated:

@@ -1,37 +1,95 @@
 #!/bin/bash
-# Round-5 battery resume: the first pass captured impala_bench (84,692 SPS
-# on-chip) and the forward flash tests, but a sys.path regression (the
-# package was importable from the repo root, not from `python benchmarks/x`)
-# failed every `benchmarks/*.py` step, and the backward flash tests exposed
-# a real TPU-lowering bug in the bwd kernels' row-table BlockSpecs (fixed in
-# ops/flash_attention.py).  This script waits for any in-flight step, then
-# runs the remaining battery in artifact-value order.
+# Round-5 battery, short-window edition.  The tunnel's one revival this
+# round lasted ~3 minutes (03:44:37-03:47:44: long enough for the headline
+# impala row and the flash-attention on-chip tests, which caught a real
+# backward BlockSpec bug) — so the battery now assumes it gets minutes, not
+# hours: steps run in value order, each `python -u` (partial rows survive a
+# mid-step tunnel death), a sentinel under $OUT marks steps done so the
+# watcher can re-fire this script idempotently on every revival, and a
+# 90-second probe between steps aborts the pass early instead of burning
+# every remaining timeout against a dead tunnel.
 set -u
 OUT=${1:-/root/repo/BENCH_CAPTURE_r05}
 mkdir -p "$OUT"
 cd /root/repo
 export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
-# Wait for a prior chip job (e.g. the still-running roofline) to drain.
-while pgrep -f "benchmarks/impala_roofline.py" > /dev/null; do sleep 15; done
+probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
+
+STEPS="flash_tests lm_quick flash_bench lm_full agent_bench serve_bench envpool_atari roofline_chip"
+
+# Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
+# backend init can hold the single chip's connection into the next revival.
+pkill -f "MOOLIB_BENCH_CHILD=tpu" 2>/dev/null
+pkill -f "benchmarks/(lm_bench|flash_bench|agent_bench|serve_bench|envpool_bench|impala_roofline)" 2>/dev/null
+pkill -f "pytest tests/test_flash_attention_tpu" 2>/dev/null
+sleep 2
 
 run() {
   local name=$1 tmo=$2; shift 2
-  echo "[$(date +%H:%M:%S)] start $name" >> "$OUT/capture.log"
+  [ -e "$OUT/.done.$name" ] && return 0
+  # 3-attempt cap: a step that fails while the tunnel is ALIVE is likely a
+  # real regression or a too-small timeout; re-burning its full timeout on
+  # every future revival would starve the steps after it.
+  local tries=$(cat "$OUT/.try.$name" 2>/dev/null || echo 0)
+  if [ "$tries" -ge 3 ]; then
+    echo "[$(date +%H:%M:%S)] skip  $name (3 failed attempts)" >> "$OUT/capture.log"
+    return 0
+  fi
+  # Keep the previous attempt's partial rows (fold reads only $name.log,
+  # but a killed attempt's output stays salvageable as .log.prev).
+  [ -s "$OUT/$name.log" ] && mv "$OUT/$name.log" "$OUT/$name.log.prev"
+  echo "[$(date +%H:%M:%S)] start $name (attempt $((tries + 1)))" >> "$OUT/capture.log"
   timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
   local rc=$?
   echo "[$(date +%H:%M:%S)] done  $name rc=$rc" >> "$OUT/capture.log"
+  if [ "$rc" = 0 ]; then
+    touch "$OUT/.done.$name"
+  elif probe; then
+    echo $((tries + 1)) > "$OUT/.try.$name"  # failed with tunnel alive
+  else
+    echo "[$(date +%H:%M:%S)] tunnel dead after $name — pass aborted" >> "$OUT/capture.log"
+    fold
+    exit 2
+  fi
 }
 
-run lm_bench 1800 python benchmarks/lm_bench.py
-run flash_bench 1500 python benchmarks/flash_bench.py
-run flash_tests 1200 env MOOLIB_RUN_TPU_TESTS=1 \
-  python -m pytest tests/test_flash_attention_tpu.py -v
-run agent_bench 1200 python benchmarks/agent_bench.py --scale reference
-run envpool_atari 600 python benchmarks/envpool_bench.py --env synthetic \
-  --batch_size 128 --num_processes 8 --steps 100
-run serve_bench 1500 python benchmarks/serve_bench.py --seconds 20 \
+fold() {
+  timeout 120 python -u benchmarks/fold_capture.py "$OUT" /root/repo/BENCH_TPU.json \
+    > "$OUT/fold_capture.log" 2>&1
+}
+
+# 1. Prove the backward BlockSpec fix on chip (recorded on-chip FAIL -> PASS).
+run flash_tests 900 env MOOLIB_RUN_TPU_TESTS=1 \
+  python -u -m pytest tests/test_flash_attention_tpu.py -v
+# 2. LM training rows, shortest configs first so any window yields rows.
+run lm_quick 900 env MOOLIB_LM_CONFIGS="1024,16,0;2048,8,0" \
+  python -u benchmarks/lm_bench.py
+# 3. Flash kernel timing fwd+bwd vs dense & oracle.
+run flash_bench 1200 python -u benchmarks/flash_bench.py
+# 4. Long-T LM rows (4k/8k, remat).
+run lm_full 1800 env MOOLIB_LM_CONFIGS="4096,4,0;4096,8,1;8192,2,0;8192,4,1" \
+  python -u benchmarks/lm_bench.py
+# 5. Whole-agent SPS at the reference flagship scale.
+run agent_bench 1200 python -u benchmarks/agent_bench.py --scale reference
+# 6. Serving under load at d=512/L=8 with the batch-cap sweep.
+run serve_bench 1500 python -u benchmarks/serve_bench.py --seconds 20 \
   --clients 16 --d_model 512 --layers 8 --heads 8 --kv_heads 8 2 \
   --batch_sizes 16 4 32 --seq_len 128 --max_new_tokens 64 --vocab 32000
-run fold_capture 120 python benchmarks/fold_capture.py "$OUT" /root/repo/BENCH_TPU.json
-echo "[$(date +%H:%M:%S)] resume battery complete" >> "$OUT/capture.log"
+# 7. EnvPool ingestion at Atari geometry (mostly host-side; cheap).
+run envpool_atari 600 python -u benchmarks/envpool_bench.py --env synthetic \
+  --batch_size 128 --num_processes 8 --steps 100
+# 8. Roofline on-chip pass (analytic part already captured; needs compile).
+run roofline_chip 1200 python -u benchmarks/impala_roofline.py \
+  --trace_dir "$OUT/impala_trace"
+fold
+# Complete when every step is resolved: succeeded (.done) or given up
+# after 3 alive-tunnel failures (.try >= 3).  A step that failed fewer
+# times must be retried next revival — the watcher keys off this status.
+for s in $STEPS; do
+  if [ ! -e "$OUT/.done.$s" ] && [ "$(cat "$OUT/.try.$s" 2>/dev/null || echo 0)" -lt 3 ]; then
+    echo "[$(date +%H:%M:%S)] pass ended; missing: $s (watcher will re-fire)" >> "$OUT/capture.log"
+    exit 3
+  fi
+done
+echo "[$(date +%H:%M:%S)] resume battery complete (all steps done)" >> "$OUT/capture.log"

@@ -1,0 +1,5 @@
+#!/bin/bash
+# Shared tunnel-liveness probe: bench.py's child probe mode, one copy of
+# the logic for the watcher and the battery.  Usage: tpu_probe.sh [timeout].
+timeout "${1:-90}" env MOOLIB_BENCH_CHILD=probe \
+  python -u /root/repo/bench.py 2>/dev/null | grep -q MOOLIB_BENCH_RESULT

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 watcher: probe the tunnel every ~3 min; on every ALIVE probe,
+# (re-)fire the idempotent resume battery until it reports all steps done.
+# Unlike tpu_watch.sh's once-per-lifetime capture, this re-fires on every
+# revival because the tunnel's observed life windows are ~minutes.
+LOG=${1:-/tmp/tpu_watch_r05.log}
+PROBELOG=/root/repo/BENCH_CAPTURE_r05/probe_log.txt
+DONE=0
+while [ "$DONE" = 0 ]; do
+  ts=$(date +%H:%M:%S)
+  if bash /root/repo/benchmarks/tpu_probe.sh 120; then
+    echo "$ts ALIVE" >> "$LOG"; echo "$ts ALIVE" >> "$PROBELOG"
+    bash /root/repo/benchmarks/tpu_capture_resume_r05.sh >> "$LOG" 2>&1 \
+      && DONE=1
+  else
+    echo "$ts dead" >> "$LOG"; echo "$ts dead" >> "$PROBELOG"
+  fi
+  sleep 180
+done
+echo "$(date +%H:%M:%S) battery complete; watcher exiting" >> "$LOG"

@@ -36,6 +36,26 @@ NUM_ACTIONS = 6
 OBS = (84, 84, 4)
 DISCOUNTING = 0.99
 REALTIME_FLOOR_SPS = 2 * 128 * 60.0  # reference actor fleet at emulator speed
+# Encoder widths.  The default is the reference geometry whose narrow
+# channels cap the MXU lane-occupancy ceiling at 0.148 (docs/PERF.md); a
+# wide run (MOOLIB_BENCH_CHANNELS=64,128,128, analytic ceiling 0.789) makes
+# that explanation falsifiable on hardware: if the ceiling story is right,
+# measured MFU must rise with width, at a similar mfu_vs_ceiling fraction.
+REF_CHANNELS = (16, 32, 32)  # single source for the reference geometry
+CHANNELS = tuple(
+    int(c)
+    for c in os.environ.get(
+        "MOOLIB_BENCH_CHANNELS", ",".join(map(str, REF_CHANNELS))
+    ).split(",")
+)
+# Unroll/batch overrides exist for CPU plumbing smoke only (the wide model
+# is 15x the FLOPs — a full reference-shape step is minutes on a CI core).
+# Overridden shapes are labeled: the metric gains a _smoke suffix and the
+# row records T/B, so a tiny-shape run can never fold into the headline
+# chip record (fold_capture requires the exact headline metric name).
+REF_T, REF_B = T, B
+T = int(os.environ.get("MOOLIB_BENCH_T", T))
+B = int(os.environ.get("MOOLIB_BENCH_B", B))
 
 # Approximate peak dense bf16 FLOP/s per jax device, keyed by substrings of
 # ``device.device_kind``.  v2/v3 expose one device per core; v4+ one per chip.
@@ -99,7 +119,10 @@ def build_step():
         ent = entropy_loss(target_logits)
         return pg + 0.5 * bl + 0.01 * ent
 
-    model = ImpalaNet(num_actions=NUM_ACTIONS, use_lstm=False, dtype=jnp.bfloat16)
+    model = ImpalaNet(
+        num_actions=NUM_ACTIONS, use_lstm=False, dtype=jnp.bfloat16,
+        channels=CHANNELS,
+    )
     rng = np.random.default_rng(0)
     batch = {
         "state": jnp.asarray(rng.integers(0, 256, size=(T + 1, B, *OBS), dtype=np.uint8)),
@@ -185,8 +208,12 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
         timed = done
 
     sps = T * B * timed / dt
+    wide = CHANNELS != REF_CHANNELS
+    metric = "impala_learner_sps_wide" if wide else "impala_learner_sps"
+    if (T, B) != (REF_T, REF_B):
+        metric += "_smoke"
     out = {
-        "metric": "impala_learner_sps",
+        "metric": metric,
         "value": round(sps, 1),
         "unit": "env_frames/s",
         "vs_baseline": round(sps / REALTIME_FLOOR_SPS, 3),
@@ -194,6 +221,10 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
         "device_kind": device.device_kind,
         "step_ms": round(dt / timed * 1000, 2),
     }
+    if wide:
+        out["channels"] = list(CHANNELS)
+    if (T, B) != (REF_T, REF_B):
+        out["T"], out["B"] = T, B
     if flops_per_step:
         out["model_tflops_per_step"] = round(flops_per_step / 1e12, 4)
         peak = _peak_for(device.device_kind)
@@ -203,7 +234,7 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
                 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
                 from impala_roofline import analytic_mxu_ceiling
 
-                ceiling = analytic_mxu_ceiling()["weighted_mxu_ceiling"]
+                ceiling = analytic_mxu_ceiling(channels=CHANNELS)["weighted_mxu_ceiling"]
                 # The 16/32-channel convs cap MXU lane occupancy; MFU is only
                 # meaningful against this geometry ceiling (docs/PERF.md).
                 out["mfu_geometry_ceiling"] = ceiling

@@ -154,6 +154,8 @@ def main():
 
     updated = []
     impala = parse_impala(os.path.join(cap, "impala_bench.log"))
+    if impala and impala.get("metric") != "impala_learner_sps":
+        impala = None  # smoke/wide-labeled rows never fold into the headline
     if impala:
         # Merge over the existing section: curated fields (baseline prose,
         # repro notes, config) survive unless the fresh run overwrote them.
@@ -196,6 +198,12 @@ def main():
             data["impala_roofline"] = dict(roof, captured_when=stamp(roof_log))
             updated.append("impala_roofline")
             break
+    wide = parse_impala(os.path.join(cap, "impala_wide.log"))
+    if wide and wide.get("metric") != "impala_learner_sps_wide":
+        wide = None  # a narrow/smoke row must not pose as the falsification datapoint
+    if wide:
+        data["impala_wide"] = dict(wide, captured_when=stamp("impala_wide.log"))
+        updated.append("impala_wide")
     agent = parse_agent(os.path.join(cap, "agent_bench.log"))
     if agent:
         data["impala_agent"] = dict(agent, captured_when=stamp("agent_bench.log"))

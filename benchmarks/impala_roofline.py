@@ -32,7 +32,7 @@ def _bw_for(kind: str):
     return next((p for s, p in _PEAK_BW if s in k), None)
 
 
-def analytic_mxu_ceiling(channels=(16, 32, 32), obs=None,
+def analytic_mxu_ceiling(channels=None, obs=None,
                          t1=None, b=None, hidden=256, num_actions=None):
     """MXU-utilization ceiling implied by the model's *geometry alone*.
 
@@ -57,6 +57,11 @@ def analytic_mxu_ceiling(channels=(16, 32, 32), obs=None,
 
     import bench
 
+    if channels is None:
+        # Track bench.py's (env-overridable) geometry so the ceiling printed
+        # beside a measured step can never desync from the model measured —
+        # including a MOOLIB_BENCH_CHANNELS wide run.
+        channels = bench.CHANNELS
     if obs is None:
         obs = bench.OBS
     if t1 is None:

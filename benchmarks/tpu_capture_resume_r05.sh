@@ -16,7 +16,7 @@ export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
 
-STEPS="flash_tests lm_quick flash_bench lm_full agent_bench serve_bench envpool_atari roofline_chip"
+STEPS="flash_tests lm_quick flash_bench lm_full agent_bench serve_bench impala_wide envpool_atari roofline_chip"
 
 # Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
 # backend init can hold the single chip's connection into the next revival.
@@ -76,6 +76,11 @@ run agent_bench 1200 python -u benchmarks/agent_bench.py --scale reference
 run serve_bench 1500 python -u benchmarks/serve_bench.py --seconds 20 \
   --clients 16 --d_model 512 --layers 8 --heads 8 --kv_heads 8 2 \
   --batch_sizes 16 4 32 --seq_len 128 --max_new_tokens 64 --vocab 32000
+# 6b. Wide-encoder IMPALA row (64/128/128): analytic ceiling 0.789, so if
+#     the lane-occupancy explanation of the 14% MFU is right, this row's
+#     measured MFU must rise roughly with the ceiling (5.3x the default's).
+run impala_wide 600 env MOOLIB_BENCH_CHILD=tpu MOOLIB_BENCH_CHANNELS=64,128,128 \
+  python -u bench.py
 # 7. EnvPool ingestion at Atari geometry (mostly host-side; cheap).
 run envpool_atari 600 python -u benchmarks/envpool_bench.py --env synthetic \
   --batch_size 128 --num_processes 8 --steps 100

@@ -42,11 +42,25 @@ REALTIME_FLOOR_SPS = 2 * 128 * 60.0  # reference actor fleet at emulator speed
 # that explanation falsifiable on hardware: if the ceiling story is right,
 # measured MFU must rise with width, at a similar mfu_vs_ceiling fraction.
 REF_CHANNELS = (16, 32, 32)  # single source for the reference geometry
-CHANNELS = tuple(
-    int(c)
-    for c in os.environ.get(
-        "MOOLIB_BENCH_CHANNELS", ",".join(map(str, REF_CHANNELS))
-    ).split(",")
+
+
+def _env_override(name, default, parse):
+    """Lenient env parse: bench.py's contract is 'always exit 0 with one
+    JSON line', so a malformed override degrades to the default with a
+    stderr warning instead of a pre-main traceback."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return parse(raw)
+    except ValueError:
+        print(f"bench.py: ignoring malformed {name}={raw!r}", file=sys.stderr)
+        return default
+
+
+CHANNELS = _env_override(
+    "MOOLIB_BENCH_CHANNELS", REF_CHANNELS,
+    lambda raw: tuple(int(c) for c in raw.split(",")),
 )
 # Unroll/batch overrides exist for CPU plumbing smoke only (the wide model
 # is 15x the FLOPs — a full reference-shape step is minutes on a CI core).
@@ -54,8 +68,8 @@ CHANNELS = tuple(
 # row records T/B, so a tiny-shape run can never fold into the headline
 # chip record (fold_capture requires the exact headline metric name).
 REF_T, REF_B = T, B
-T = int(os.environ.get("MOOLIB_BENCH_T", T))
-B = int(os.environ.get("MOOLIB_BENCH_B", B))
+T = _env_override("MOOLIB_BENCH_T", T, int)
+B = _env_override("MOOLIB_BENCH_B", B, int)
 
 # Approximate peak dense bf16 FLOP/s per jax device, keyed by substrings of
 # ``device.device_kind``.  v2/v3 expose one device per core; v4+ one per chip.
@@ -77,6 +91,16 @@ def _peak_for(kind: str):
         if sub in k:
             return peak
     return None
+
+
+def _metric_name():
+    """Row label carrying the geometry/shape overrides: every emitter (real
+    run and hard-fail synthetic row alike) must use this so a non-reference
+    configuration can never publish under the headline metric name."""
+    metric = "impala_learner_sps_wide" if CHANNELS != REF_CHANNELS else "impala_learner_sps"
+    if (T, B) != (REF_T, REF_B):
+        metric += "_smoke"
+    return metric
 
 
 def build_step():
@@ -209,11 +233,8 @@ def _run_bench(warmup: int, iters: int, max_seconds=None) -> dict:
 
     sps = T * B * timed / dt
     wide = CHANNELS != REF_CHANNELS
-    metric = "impala_learner_sps_wide" if wide else "impala_learner_sps"
-    if (T, B) != (REF_T, REF_B):
-        metric += "_smoke"
     out = {
-        "metric": metric,
+        "metric": _metric_name(),
         "value": round(sps, 1),
         "unit": "env_frames/s",
         "vs_baseline": round(sps / REALTIME_FLOOR_SPS, 3),
@@ -367,7 +388,7 @@ def main():
             errors.append(err)
             # Even the CPU fallback died: report the failure as data, rc 0.
             result = {
-                "metric": "impala_learner_sps",
+                "metric": _metric_name(),
                 "value": 0.0,
                 "unit": "env_frames/s",
                 "vs_baseline": 0.0,

@@ -96,13 +96,16 @@ def analytic_mxu_ceiling(channels=None, obs=None,
         l["gflops"] = round(l["gflops"], 3)
         l["mxu_util_ceiling"] = round(l["mxu_util_ceiling"], 3)
         l["flop_share"] = round(l["gflops"] / total, 3)
+    max_ch = max(channels)
     return {
+        "channels": list(channels),  # label the geometry the ceiling is FOR
         "forward_gflops": round(total, 2),
         "weighted_mxu_ceiling": round(ceiling, 4),
         "note": (
-            "geometry-implied MFU ceiling: convs with C_out<=32 use <=25% of "
-            "the MXU's 128 output lanes; no schedule or batch size can exceed "
-            "this at the reference model shape"
+            f"geometry-implied MFU ceiling at channels={list(channels)}: convs "
+            f"with C_out<={max_ch} use <={min(100, round(100 * max_ch / 128))}% "
+            "of the MXU's 128 output lanes; no schedule or batch size can "
+            "exceed this at this model shape"
         ),
         "layers": layers,
     }
@@ -149,6 +152,7 @@ def main():
     out = {
         "device": device.device_kind,
         "platform": device.platform,
+        "channels": analytic["channels"],
         "model_tflops_per_step": round(flops / 1e12, 4),
         "bytes_accessed_per_step_mb": round(byts / 1e6, 1),
         "arithmetic_intensity_flop_per_byte": round(flops / byts, 1) if byts else None,

@@ -1,0 +1,143 @@
+"""fold_capture.py is the unattended bridge from battery logs to the
+committed chip record (BENCH_TPU.json) — a wrong fold silently corrupts
+the judge-facing evidence, so its guards are pinned here.
+
+Runs the real CLI via subprocess (the battery's interface), one tmp
+capture dir per test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "benchmarks", "fold_capture.py")
+
+
+def run_fold(cap, out):
+    return subprocess.run(
+        [sys.executable, SCRIPT, str(cap), str(out)],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def impala_line(metric="impala_learner_sps", platform="tpu", **kw):
+    row = {"metric": metric, "value": 12345.6, "unit": "env_frames/s",
+           "vs_baseline": 0.8, "platform": platform, "device_kind": "TPU v5 lite",
+           "step_ms": 7.5, **kw}
+    return "MOOLIB_BENCH_RESULT " + json.dumps(row)
+
+
+def lm_line(rows, platform="tpu"):
+    return json.dumps({"lm_train": {
+        "platform": platform, "device_kind": "TPU v5 lite",
+        "d_model": 1024, "layers": 12, "kv_heads": 8, "rows": rows}})
+
+
+def test_headline_rejects_smoke_and_cpu_rows(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    (cap / "impala_bench.log").write_text(
+        impala_line(metric="impala_learner_sps_smoke", T=2, B=2) + "\n")
+    r = run_fold(cap, out)
+    assert "nothing to fold" in r.stdout
+    (cap / "impala_bench.log").write_text(impala_line(platform="cpu") + "\n")
+    r = run_fold(cap, out)
+    assert "nothing to fold" in r.stdout
+    (cap / "impala_bench.log").write_text(impala_line() + "\n")
+    r = run_fold(cap, out)
+    assert "impala_learner" in r.stdout
+    assert json.loads(out.read_text())["impala_learner"]["value"] == 12345.6
+
+
+def test_wide_section_requires_wide_metric(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    # A narrow row in impala_wide.log must NOT pose as the wide datapoint.
+    (cap / "impala_wide.log").write_text(impala_line() + "\n")
+    r = run_fold(cap, out)
+    assert "nothing to fold" in r.stdout
+    (cap / "impala_wide.log").write_text(
+        impala_line(metric="impala_learner_sps_wide", channels=[64, 128, 128]) + "\n")
+    run_fold(cap, out)
+    data = json.loads(out.read_text())
+    assert data["impala_wide"]["channels"] == [64, 128, 128]
+    assert "impala_learner" not in data  # wide never touches the headline
+
+
+def test_lm_rows_merge_across_split_logs(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    (cap / "lm_quick.log").write_text(lm_line([
+        {"T": 1024, "B": 16, "remat": False, "tokens_per_s": 100.0},
+        {"T": 2048, "B": 8, "remat": False, "tokens_per_s": 90.0}]) + "\n")
+    (cap / "lm_full.log").write_text(lm_line([
+        {"T": 2048, "B": 8, "remat": False, "tokens_per_s": 95.0},  # overrides quick
+        {"T": 8192, "B": 2, "remat": False, "tokens_per_s": 40.0}]) + "\n")
+    run_fold(cap, out)
+    rows = json.loads(out.read_text())["lm_train"]["rows"]
+    by_key = {(r["T"], r["B"]): r["tokens_per_s"] for r in rows}
+    assert by_key == {(1024, 16): 100.0, (2048, 8): 95.0, (8192, 2): 40.0}
+    assert [r["T"] for r in rows] == [1024, 2048, 8192]  # sorted by config
+
+
+def test_captured_when_is_log_mtime_not_fold_time(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    log = cap / "impala_bench.log"
+    log.write_text(impala_line() + "\n")
+    old = time.time() - 3 * 86400
+    os.utime(log, (old, old))
+    run_fold(cap, out)
+    data = json.loads(out.read_text())
+    import datetime
+    expect = datetime.date.fromtimestamp(old).isoformat()
+    assert data["impala_learner"]["captured_when"] == expect
+    assert data["when"] == expect  # re-folds must not restamp staleness
+
+
+def test_roofline_prefers_fresh_name_and_folds_once(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    stale = {"platform": "tpu", "arithmetic_intensity_flop_per_byte": 50.0,
+             "bound": "stale"}
+    fresh = dict(stale, bound="fresh")
+    (cap / "impala_roofline.log").write_text(json.dumps(stale) + "\n")
+    (cap / "roofline_chip.log").write_text(json.dumps(fresh) + "\n")
+    run_fold(cap, out)
+    data = json.loads(out.read_text())
+    assert data["impala_roofline"]["bound"] == "fresh"
+    assert data["provenance"].count("impala_roofline") == 1
+
+
+def test_garbled_and_partial_logs_are_skipped(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    (cap / "impala_bench.log").write_text("MOOLIB_BENCH_RESULT {\"metric\": \"impal")
+    (cap / "lm_bench.log").write_text("{\"lm_train\": truncated")
+    r = run_fold(cap, out)
+    assert "nothing to fold" in r.stdout
+    assert not out.exists()
+
+
+def test_existing_sections_survive_partial_fold(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    out.write_text(json.dumps({
+        "when": "2026-07-29", "flash_attention": {"tests": "11/11"},
+        "impala_learner": {"value": 1.0, "curated_note": "keep me"}}))
+    (cap / "impala_bench.log").write_text(impala_line() + "\n")
+    run_fold(cap, out)
+    data = json.loads(out.read_text())
+    assert data["flash_attention"] == {"tests": "11/11"}  # untouched
+    assert data["impala_learner"]["value"] == 12345.6  # refreshed
+    assert data["impala_learner"]["curated_note"] == "keep me"  # merged over

@@ -136,7 +136,7 @@ def main():
         # would make the JSON line unparseable for strict consumers.
         mfu = flops / sec / peak if peak else None
         print(f"{T:>6} {B:>3} {str(remat):>5} {sec * 1e3:>9.2f} "
-              f"{tokens_s:>10.0f} {mfu if mfu is None else round(mfu, 3):>6}")
+              f"{tokens_s:>10.0f} {'n/a' if mfu is None else round(mfu, 3):>6}")
         rows.append(
             {"T": T, "B": B, "remat": remat, "step_ms": round(sec * 1e3, 2),
              "tokens_per_s": round(tokens_s, 1),

@@ -16,7 +16,7 @@ export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
 
-STEPS="flash_tests lm_quick flash_bench lm_full agent_bench serve_bench impala_wide envpool_atari roofline_chip"
+STEPS="flash_bwd_tests lm_quick flash_tests flash_bench lm_full agent_bench serve_bench impala_wide envpool_atari roofline_chip"
 
 # Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
 # backend init can hold the single chip's connection into the next revival.
@@ -59,13 +59,19 @@ fold() {
     > "$OUT/fold_capture.log" 2>&1
 }
 
-# 1. Prove the backward BlockSpec fix on chip (recorded on-chip FAIL -> PASS).
-run flash_tests 900 env MOOLIB_RUN_TPU_TESTS=1 \
-  python -u -m pytest tests/test_flash_attention_tpu.py -v
+# 1. Prove the backward BlockSpec fix on chip (recorded on-chip FAIL ->
+#    PASS).  Backward tests ONLY first: the forward half already passed
+#    on chip this round, and the observed revival window is ~3 minutes —
+#    the minimum decisive artifact goes first.
+run flash_bwd_tests 600 env MOOLIB_RUN_TPU_TESTS=1 \
+  python -u -m pytest tests/test_flash_attention_tpu.py -v -k "backward"
 # 2. LM training rows, shortest configs first so any window yields rows.
 run lm_quick 900 env MOOLIB_LM_CONFIGS="1024,16,0;2048,8,0" \
   python -u benchmarks/lm_bench.py
-# 3. Flash kernel timing fwd+bwd vs dense & oracle.
+# 3. The full flash test file (fwd re-run + bf16 + backward again).
+run flash_tests 900 env MOOLIB_RUN_TPU_TESTS=1 \
+  python -u -m pytest tests/test_flash_attention_tpu.py -v
+# 3b. Flash kernel timing fwd+bwd vs dense & oracle.
 run flash_bench 1200 python -u benchmarks/flash_bench.py
 # 4. Long-T LM rows (4k/8k, remat).
 run lm_full 1800 env MOOLIB_LM_CONFIGS="4096,4,0;4096,8,1;8192,2,0;8192,4,1" \

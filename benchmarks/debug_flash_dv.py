@@ -1,0 +1,156 @@
+"""Pin down the causal-backward dv mismatch seen on chip (round 5).
+
+The on-chip run of ``tests/test_flash_attention_tpu.py -k backward`` showed
+dq/dk passing and **dv** failing for causal=True only — 50-80 elements out
+of 10^5-10^6 exceeding the 2e-3 tolerance by ~3x, while CPU interpret mode
+matches to 1e-6.  Two candidate explanations:
+
+1. a real TPU-lowering defect in the pallas dv accumulation on the causal
+   path (the only causal-specific machinery is the block-skip predicate and
+   the in-block iota mask);
+2. the *dense reference* being the less accurate side on chip — XLA fuses
+   softmax+matmul and the TPU exp approximation differs between the fused
+   dense VJP and the kernels' exp(st - lse).
+
+A float64 host ground truth settles it: whichever side sits farther from
+f64 at the disputed elements is the wrong one.  Run on a live chip:
+
+    python benchmarks/debug_flash_dv.py [--t 512]
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+
+def f64_attention_grads(q, k, v, g, causal):
+    """Exact softmax-attention VJP in float64 numpy. [B,T,H,D] layout."""
+    q, k, v, g = (np.asarray(x, dtype=np.float64) for x in (q, k, v, g))
+    B, T, H, D = q.shape
+    scale = D ** -0.5
+    dq = np.zeros_like(q)
+    dk = np.zeros_like(k)
+    dv = np.zeros_like(v)
+    for b in range(B):
+        for h in range(H):
+            s = (q[b, :, h] @ k[b, :, h].T) * scale  # [Tq, Tk]
+            if causal:
+                mask = np.tril(np.ones((T, T), dtype=bool))
+                s = np.where(mask, s, -np.inf)
+            m = s.max(axis=1, keepdims=True)
+            p = np.exp(s - m)
+            p /= p.sum(axis=1, keepdims=True)
+            go = g[b, :, h]  # [Tq, D]
+            dv[b, :, h] = p.T @ go
+            dp = go @ v[b, :, h].T  # [Tq, Tk]
+            delta = (go * (p @ v[b, :, h])).sum(axis=1, keepdims=True)
+            ds = p * (dp - delta) * scale
+            if causal:
+                ds = np.where(mask, ds, 0.0)
+            dq[b, :, h] = ds @ k[b, :, h]
+            dk[b, :, h] = ds.T @ q[b, :, h]
+    return dq, dk, dv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--t", type=int, default=512)
+    ap.add_argument("--causal", type=int, default=1)
+    args = ap.parse_args()
+
+    import jax
+
+    from moolib_tpu.ops import flash_attention as fa
+    from moolib_tpu.parallel.ring_attention import full_attention
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        raise SystemExit("needs an accelerator device")
+    dev = devs[0]
+    causal = bool(args.causal)
+
+    B, H, D, T = 2, 4, 64, args.t
+    rng = np.random.default_rng(T)  # same seed recipe as the failing test
+    mk = lambda: rng.normal(size=(B, T, H, D)).astype(np.float32) * 0.5
+    qh, kh, vh, gh = mk(), mk(), mk(), mk()
+    q, k, v, g = (jax.device_put(x, dev) for x in (qh, kh, vh, gh))
+
+    print(f"# T={T} causal={causal} device={dev.device_kind}", flush=True)
+    ref64 = f64_attention_grads(qh, kh, vh, gh, causal)
+
+    def grads(fn):
+        _, vjp = jax.vjp(fn, q, k, v)
+        return tuple(np.asarray(x) for x in vjp(g))
+
+    results = {}
+    results["pallas"] = grads(lambda q, k, v: fa.flash_attention(q, k, v, causal=causal))
+    results["dense"] = grads(lambda q, k, v: full_attention(q, k, v, causal=causal))
+    # The dense path again, with f32 matmuls forced: on TPU the default
+    # einsum precision is bf16 inputs — if THIS row hugs f64 while plain
+    # "dense" doesn't, the disputed elements are the reference's noise, not
+    # a kernel defect.
+    with jax.default_matmul_precision("highest"):
+        results["dense_hp"] = grads(
+            lambda q, k, v: full_attention(q, k, v, causal=causal)
+        )
+    os.environ["MOOLIB_TPU_FLASH_BWD"] = "jax"
+    try:
+        results["oracle"] = grads(
+            lambda q, k, v: fa.flash_attention(q, k, v, causal=causal)
+        )
+    finally:
+        os.environ.pop("MOOLIB_TPU_FLASH_BWD", None)
+    # Block-size variant: if the defect is block-geometry-dependent this row
+    # moves, if it's an exp/precision floor it stays put.
+    os.environ["MOOLIB_TPU_FLASH_BWD_BLOCK_Q"] = "128"
+    os.environ["MOOLIB_TPU_FLASH_BWD_BLOCK_K"] = "128"
+    try:
+        with jax.disable_jit(False):
+            f = jax.jit(
+                lambda q, k, v, g: jax.vjp(
+                    lambda q, k, v: fa.flash_attention(q, k, v, causal=causal),
+                    q, k, v,
+                )[1](g)
+            )
+            results["pallas_b128"] = tuple(np.asarray(x) for x in f(q, k, v, g))
+    finally:
+        os.environ.pop("MOOLIB_TPU_FLASH_BWD_BLOCK_Q", None)
+        os.environ.pop("MOOLIB_TPU_FLASH_BWD_BLOCK_K", None)
+
+    names = ("dq", "dk", "dv")
+    print(f"{'method':>12} {'grad':>4} {'max_abs_vs_f64':>15} {'p99.99_abs':>12}")
+    for meth, tup in results.items():
+        for i, name in enumerate(names):
+            err = np.abs(tup[i] - ref64[i])
+            print(
+                f"{meth:>12} {name:>4} {err.max():15.3e} "
+                f"{np.quantile(err, 0.9999):12.3e}",
+                flush=True,
+            )
+
+    # Where do pallas and dense disagree on dv, and which is right there?
+    i = 2
+    dis = np.abs(results["pallas"][i] - results["dense"][i])
+    idxs = np.argsort(dis.ravel())[::-1][:12]
+    print("\n# top pallas-vs-dense dv disagreements (b, t, h, d):")
+    print(f"{'index':>22} {'disagree':>10} {'pallas_err':>11} {'dense_err':>10}")
+    for flat in idxs:
+        loc = np.unravel_index(flat, dis.shape)
+        pe = abs(results["pallas"][i][loc] - ref64[i][loc])
+        de = abs(results["dense"][i][loc] - ref64[i][loc])
+        print(f"{str(loc):>22} {dis[loc]:10.3e} {pe:11.3e} {de:10.3e}", flush=True)
+
+    # Distribution of disputed t-positions: block-boundary clustering would
+    # implicate the skip predicate / iota mask.
+    bad = np.argwhere(dis > 2e-3)
+    if len(bad):
+        ts = bad[:, 1]
+        print(f"\n# {len(bad)} elements above 2e-3; t quantiles: "
+              f"min={ts.min()} p25={int(np.quantile(ts, .25))} "
+              f"med={int(np.median(ts))} p75={int(np.quantile(ts, .75))} "
+              f"max={ts.max()}  (t%128==0 count: {(ts % 128 == 0).sum()})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Hand-off supervisor: a battery pass fired directly (tunnel was alive at
+# session start) must not overlap the watcher — the battery begins by
+# pkilling stale chip jobs, so a second concurrent instance would kill the
+# first's in-flight step.  Wait for the running pass to exit, then either
+# stop (all steps resolved) or hand off to the re-firing watcher.
+OUT=/root/repo/BENCH_CAPTURE_r05
+while pgrep -f tpu_capture_resume_r05.sh >/dev/null 2>&1; do sleep 30; done
+for s in flash_bwd_tests lm_quick flash_tests flash_bench lm_full \
+         agent_bench serve_bench impala_wide envpool_atari roofline_chip; do
+  if [ ! -e "$OUT/.done.$s" ] && \
+     [ "$(cat "$OUT/.try.$s" 2>/dev/null || echo 0)" -lt 3 ]; then
+    exec bash /root/repo/benchmarks/tpu_watch_r05.sh
+  fi
+done
+echo "$(date +%H:%M:%S) all steps resolved at supervisor start" \
+  >> "$OUT/capture.log"

@@ -40,8 +40,8 @@ def main(argv=None):
     # budget to ~12 learner batches.  Wall-clock bounding is the caller's
     # job (the battery time-boxes the whole invocation).
     frames_per_batch = cfg["batch_size"] * cfg["unroll_length"]
-    total = args.total_steps or max(12 * frames_per_batch,
-                                    cfg["actor_batch_size"] * cfg["unroll_length"] * 4)
+    total = args.total_steps or max(24 * frames_per_batch,
+                                    cfg["actor_batch_size"] * cfg["unroll_length"] * 6)
 
     # The experiment constructs EnvPools before heavy jax init (fork safety);
     # importing it is cheap, train() owns the ordering.
@@ -64,11 +64,41 @@ def main(argv=None):
     dt = time.time() - t0
 
     import jax
+    import jax.numpy as jnp
 
     dev = jax.devices()[0]
+    # Per-dispatch device round-trip floor: every act() pays one dispatch +
+    # scalar fetch.  Through the axon tunnel this is ~65 ms — the dominant
+    # bound on overlapped SPS here; on a colocated TPU host it is sub-ms.
+    # Probed in a daemon thread with a deadline: the tunnel dying right
+    # after a successful train() must not hang the process and discard the
+    # measured SPS row (the probe is garnish, the row is the result).
+    def _probe_rtt(out_list):
+        try:
+            f = jax.jit(lambda x: x + 1)
+            x = jnp.zeros((), jnp.int32)
+            float(f(x))  # compile
+            rtts = []
+            for _ in range(10):
+                t = time.perf_counter()
+                float(f(x))
+                rtts.append(time.perf_counter() - t)
+            out_list.append(sorted(rtts)[len(rtts) // 2] * 1e3)
+        except Exception:  # noqa: BLE001 — dead device -> no RTT row
+            pass
+
+    import threading
+
+    _rtt_out: list = []
+    _t = threading.Thread(target=_probe_rtt, args=(_rtt_out,), daemon=True)
+    _t.start()
+    _t.join(timeout=60)
+    rtt_ms = _rtt_out[0] if _rtt_out else None
     print(json.dumps({
         "metric": "impala_agent_sps",
         "value": round(out["sps"], 1),
+        "steady_sps": out.get("steady_sps"),
+        "act_rtt_floor_ms": None if rtt_ms is None else round(rtt_ms, 2),
         "unit": "env_frames/s",
         "scale": args.scale,
         "steps": out["steps"],

@@ -16,12 +16,12 @@ export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
 
-STEPS="flash_bwd_tests lm_quick flash_tests flash_bench lm_full agent_bench serve_bench impala_wide envpool_atari roofline_chip"
+STEPS="dv_triage flash_bwd_tests lm_quick flash_tests flash_bench lm_full agent_bench serve_bench impala_wide envpool_atari roofline_chip"
 
 # Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
 # backend init can hold the single chip's connection into the next revival.
 pkill -f "MOOLIB_BENCH_CHILD=tpu" 2>/dev/null
-pkill -f "benchmarks/(lm_bench|flash_bench|agent_bench|serve_bench|envpool_bench|impala_roofline)" 2>/dev/null
+pkill -f "benchmarks/(lm_bench|flash_bench|agent_bench|serve_bench|envpool_bench|impala_roofline|debug_flash_dv)" 2>/dev/null
 pkill -f "pytest tests/test_flash_attention_tpu" 2>/dev/null
 sleep 2
 
@@ -40,7 +40,10 @@ run() {
   # but a killed attempt's output stays salvageable as .log.prev).
   [ -s "$OUT/$name.log" ] && mv "$OUT/$name.log" "$OUT/$name.log.prev"
   echo "[$(date +%H:%M:%S)] start $name (attempt $((tries + 1)))" >> "$OUT/capture.log"
-  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  # -k 30: a step wedged inside the TPU client can sit out SIGTERM; the
+  # surviving orphan then holds the chip and the next probe reads "dead"
+  # (observed with impala_wide's rc=124 in the 07:10 window).
+  timeout -k 30 "$tmo" "$@" > "$OUT/$name.log" 2>&1
   local rc=$?
   echo "[$(date +%H:%M:%S)] done  $name rc=$rc" >> "$OUT/capture.log"
   if [ "$rc" = 0 ]; then
@@ -59,10 +62,16 @@ fold() {
     > "$OUT/fold_capture.log" 2>&1
 }
 
-# 1. Prove the backward BlockSpec fix on chip (recorded on-chip FAIL ->
-#    PASS).  Backward tests ONLY first: the forward half already passed
-#    on chip this round, and the observed revival window is ~3 minutes —
-#    the minimum decisive artifact goes first.
+# 0. Settle the causal-dv dispute against a float64 host oracle: is the
+#    pallas backward or the default-precision dense VJP the noisy side?
+#    (Round-5 second window: causal dv failed at 2e-3 while dq/dk and all
+#    non-causal cases passed; hypothesis is bf16 MXU input rounding on the
+#    *reference* at concentrated-p rows.)  Fast and decisive — first.
+run dv_triage 600 python -u benchmarks/debug_flash_dv.py --t 512
+# 1. Prove the backward fixes on chip (recorded on-chip FAIL -> PASS).
+#    Backward tests ONLY first: the forward half already passed on chip
+#    this round, and revival windows are short — minimum decisive artifact
+#    early.
 run flash_bwd_tests 600 env MOOLIB_RUN_TPU_TESTS=1 \
   python -u -m pytest tests/test_flash_attention_tpu.py -v -k "backward"
 # 2. LM training rows, shortest configs first so any window yields rows.
@@ -79,13 +88,16 @@ run lm_full 1800 env MOOLIB_LM_CONFIGS="4096,4,0;4096,8,1;8192,2,0;8192,4,1" \
 # 5. Whole-agent SPS at the reference flagship scale.
 run agent_bench 1200 python -u benchmarks/agent_bench.py --scale reference
 # 6. Serving under load at d=512/L=8 with the batch-cap sweep.
-run serve_bench 1500 python -u benchmarks/serve_bench.py --seconds 20 \
+run serve_bench 3000 python -u benchmarks/serve_bench.py --seconds 20 \
   --clients 16 --d_model 512 --layers 8 --heads 8 --kv_heads 8 2 \
-  --batch_sizes 16 4 32 --seq_len 128 --max_new_tokens 64 --vocab 32000
+  --batch_sizes 16 4 32 --seq_len 128 --max_new_tokens 64 --vocab 32000 \
+  --ready_timeout 420
 # 6b. Wide-encoder IMPALA row (64/128/128): analytic ceiling 0.789, so if
 #     the lane-occupancy explanation of the 14% MFU is right, this row's
 #     measured MFU must rise roughly with the ceiling (5.3x the default's).
-run impala_wide 600 env MOOLIB_BENCH_CHILD=tpu MOOLIB_BENCH_CHANNELS=64,128,128 \
+#     (1200 s: the first wide attempt hit the 600 s cap mid-compile — the
+#     64/128/128 encoder compiles much slower than the reference shape.)
+run impala_wide 1200 env MOOLIB_BENCH_CHILD=tpu MOOLIB_BENCH_CHANNELS=64,128,128 \
   python -u bench.py
 # 7. EnvPool ingestion at Atari geometry (mostly host-side; cheap).
 run envpool_atari 600 python -u benchmarks/envpool_bench.py --env synthetic \

@@ -490,6 +490,10 @@ def train(flags, on_stats=None) -> dict:
     last_checkpoint = time.monotonic()
     final_return = None
     start = time.time()
+    # (wall time, steps) samples at each log tick: lets callers separate
+    # steady-state throughput from the compile/startup transient (the
+    # whole-run mean buries ~90 s of jit warmup in short benchmark runs).
+    sps_samples = [(start, 0.0)]
     cur = 0
     # Graceful shutdown: SIGTERM (scheduler preemption) stops the loop so
     # the finally block runs — leader checkpoints on the way out, exactly
@@ -615,6 +619,7 @@ def train(flags, on_stats=None) -> dict:
             if now - last_log > flags.log_interval:
                 last_log = now
                 sps = stats["steps_done"].value / max(time.time() - start, 1e-6)
+                sps_samples.append((time.time(), stats["steps_done"].value))
                 ret = stats["mean_episode_return"].result()
                 if not flags.quiet:
                     print(
@@ -656,6 +661,11 @@ def train(flags, on_stats=None) -> dict:
                     "loss", "pg_loss", "entropy_loss",
                     "mean_episode_return", "mean_episode_step",
                 )
+        # Loop exit: stamp the end sample here, not after teardown — the
+        # finally block below (checkpoint save, env/rpc close) can take
+        # tens of seconds with zero step progress and would deflate the
+        # steady-state window it exists to measure.
+        sps_samples.append((time.time(), stats["steps_done"].value))
     finally:
         if trace_stop_at is not None:
             try:
@@ -681,12 +691,27 @@ def train(flags, on_stats=None) -> dict:
                 pass
 
     recent = stats["mean_episode_return"].result()
+    final_steps = stats["steps_done"].value
+    if sps_samples[-1][1] < final_steps:  # loop left via an exception path
+        sps_samples.append((time.time(), final_steps))
+    # Steady-state window: from the first sample at or past half the final
+    # step count (compile transients live in the first half of short runs).
+    mid = next(
+        (s for s in sps_samples if s[1] >= final_steps / 2), sps_samples[0]
+    )
+    end = sps_samples[-1]
+    steady = (
+        (end[1] - mid[1]) / (end[0] - mid[0])
+        if end[0] > mid[0] and end[1] > mid[1]
+        else None
+    )
     return {
-        "steps": stats["steps_done"].value,
+        "steps": final_steps,
         "episodes": stats["episodes_done"].value,
         "sgd_steps": stats["sgd_steps"].value,
         "mean_episode_return": recent if recent is not None else final_return,
-        "sps": stats["steps_done"].value / max(time.time() - start, 1e-6),
+        "sps": final_steps / max(time.time() - start, 1e-6),
+        "steady_sps": None if steady is None else round(steady, 1),
     }
 
 

@@ -178,7 +178,11 @@ def main():
             part = lm_parts[n]
             meta = {k: v for k, v in part.items() if k != "rows"}
             for r in part.get("rows", []):
-                rows[(r.get("T"), r.get("B"), r.get("remat"))] = r
+                # xent joined the key in round 5 (fused vs naive loss rows
+                # coexist); older logs' rows are all the naive path.
+                r = dict(r)
+                r.setdefault("xent", "naive")
+                rows[(r["T"], r["B"], r["remat"], r["xent"])] = r
         data["lm_train"] = dict(
             meta, rows=sorted(rows.values(), key=lambda r: (r.get("T", 0), r.get("remat", False), r.get("B", 0))),
             captured_when=stamp(lm_logs[-1]),

@@ -52,8 +52,16 @@ def main():
     L = int(os.environ.get("MOOLIB_LM_LAYERS", 12))
     H = max(4, D // 128)
     KV = int(os.environ.get("MOOLIB_LM_KV_HEADS", 0)) or None  # GQA sweeps
+    # fused = chunked-vocab cross-entropy (ops/xent.py): the [B,T,32768] f32
+    # logits tensor never materializes.  naive = materialized log_softmax,
+    # kept as the comparison row (MOOLIB_LM_XENT=naive).
+    xent_mode = os.environ.get("MOOLIB_LM_XENT", "fused")
+    if xent_mode not in ("fused", "naive"):
+        # Rows are keyed by this string downstream (fold_capture): a typo'd
+        # mode must fail loudly, not fold a mislabeled chip row.
+        raise SystemExit(f"MOOLIB_LM_XENT must be fused|naive, got {xent_mode!r}")
     print(f"# backend={jax.default_backend()} device={dev.device_kind} "
-          f"d_model={D} layers={L} kv_heads={KV or H}")
+          f"d_model={D} layers={L} kv_heads={KV or H} xent={xent_mode}")
     print(f"{'T':>6} {'B':>3} {'remat':>5} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
 
     rows = []
@@ -95,10 +103,20 @@ def main():
             opt = optax.adamw(1e-4)
             opt_state = opt.init(params)
 
-            def loss_fn(p, t):
-                logits = model.apply(p, t)
-                logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
-                return -jnp.take_along_axis(logp, t[:, 1:, None], axis=-1).mean()
+            if xent_mode == "fused":
+                from moolib_tpu.ops.xent import lm_head_xent
+
+                def loss_fn(p, t):
+                    return lm_head_xent(model, p, t)
+            else:
+                def loss_fn(p, t):
+                    logits = model.apply(p, t)
+                    logp = jax.nn.log_softmax(
+                        logits[:, :-1].astype(jnp.float32), -1
+                    )
+                    return -jnp.take_along_axis(
+                        logp, t[:, 1:, None], axis=-1
+                    ).mean()
 
             from functools import partial
 
@@ -126,7 +144,9 @@ def main():
             if "RESOURCE_EXHAUSTED" not in msg and "out of memory" not in msg.lower():
                 raise  # only real OOMs become rows; compile errors must fail
             print(f"{T:>6} {B:>3} {str(remat):>5} {'OOM':>9}")
-            rows.append({"T": T, "B": B, "remat": remat, "oom": True})
+            rows.append(
+                {"T": T, "B": B, "remat": remat, "xent": xent_mode, "oom": True}
+            )
             continue
         tokens_s = B * T / sec
         # Standard 6*N*D transformer FLOPs (fwd+bwd) + attention term
@@ -138,7 +158,8 @@ def main():
         print(f"{T:>6} {B:>3} {str(remat):>5} {sec * 1e3:>9.2f} "
               f"{tokens_s:>10.0f} {'n/a' if mfu is None else round(mfu, 3):>6}")
         rows.append(
-            {"T": T, "B": B, "remat": remat, "step_ms": round(sec * 1e3, 2),
+            {"T": T, "B": B, "remat": remat, "xent": xent_mode,
+             "step_ms": round(sec * 1e3, 2),
              "tokens_per_s": round(tokens_s, 1),
              "mfu_6nd": None if mfu is None else round(mfu, 4)}
         )

@@ -198,7 +198,14 @@ class TransformerLM(nn.Module):
     remat: bool = False  # checkpoint each block: O(L) -> O(1) activations
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, mesh=None) -> jax.Array:
+    def __call__(
+        self, tokens: jax.Array, mesh=None, return_features: bool = False
+    ) -> jax.Array:
+        """Logits [B, T, V] — or pre-head features [B, T, D] with
+        ``return_features=True``, for ``ops.xent.lm_head_xent``'s chunked
+        loss (the lm_head params still come from the same init: flax only
+        materializes params on the default path, and ``apply`` ignores the
+        unused head when features are requested)."""
         B, T = tokens.shape
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(
             tokens
@@ -245,9 +252,15 @@ class TransformerLM(nn.Module):
                 name=f"block{i}",
             )(x, mesh)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(
-            x.astype(jnp.float32)
-        )
+        head = nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")
+        if return_features:
+            if self.is_initializing():
+                # Materialize the head's params even on the features path so
+                # init(..., return_features=True) yields the same tree as the
+                # default path (lm_head_xent reads params["params"]["lm_head"]).
+                head(x.astype(jnp.float32)[:, :1])
+            return x.astype(jnp.float32)
+        return head(x.astype(jnp.float32))
 
 
 def generate(

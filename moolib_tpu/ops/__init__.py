@@ -1,9 +1,10 @@
 """Numerical ops: v-trace, returns/advantages, losses — all jit-safe."""
 
-from . import returns, vtrace  # noqa: F401
+from . import returns, vtrace, xent  # noqa: F401
 from .returns import (  # noqa: F401
     discounted_returns,
     entropy_loss,
     generalized_advantage_estimation,
     softmax_cross_entropy,
 )
+from .xent import chunked_softmax_xent, lm_head_xent  # noqa: F401

@@ -1,0 +1,133 @@
+"""Chunked softmax cross-entropy: the LM head without materialized logits.
+
+``TransformerLM``'s head projects to vocab-size logits; at the bench scale
+(B=16, T=1024, V=32768, f32) the logits tensor alone is ~2 GB, and the
+naive ``log_softmax`` loss makes XLA stream it to HBM at least twice more
+(backward residuals) — pure bandwidth, zero MXU work.  This op runs the
+projection blockwise over the vocab axis inside a ``lax.scan`` whose body
+is ``jax.checkpoint``ed: the forward keeps only three [N] row statistics
+(running max, rescaled sum-of-exp, label logit) per chunk step, and the
+backward recomputes each chunk's logits on the fly.  Per-token head FLOPs
+go from 6·D·V to 8·D·V (one recompute pass) while the [N, V] tensor never
+exists — the classic memory-for-FLOPs trade that wins on TPU, same family
+as ``remat=True`` on the blocks and the flash-attention kernels.
+
+The reference framework has no LM/loss machinery at all (its models stop
+at policy/value heads, SURVEY.md §2.2); this extends the long-context side
+the same way flash attention does — TPU-idiomatic from the start, via
+scan + checkpoint rather than a hand-scheduled kernel, because the blocked
+matmul is already MXU-shaped and XLA fuses the elementwise tail.
+
+Numerics: logits are computed in f32 (``preferred_element_type``) from
+inputs in their stored dtype, the online logsumexp carries are f32, and
+the result equals the naive ``log_softmax`` loss to f32 roundoff (pinned
+by tests/test_xent.py, including through ``jax.grad``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def chunked_softmax_xent(
+    h: jax.Array,
+    w: jax.Array,
+    b: Optional[jax.Array],
+    labels: jax.Array,
+    chunk_size: int = 4096,
+) -> jax.Array:
+    """Mean negative log-likelihood of ``labels`` under ``softmax(h @ w + b)``.
+
+    h: [N, D] (any float dtype; promoted to f32 in the matmul accumulate),
+    w: [D, V], b: [V] or None, labels: [N] int.  Returns a f32 scalar.
+    ``chunk_size`` bounds the live logits block to [N, chunk_size]; the
+    vocab axis is zero-padded up to a multiple (padded columns get a -1e30
+    bias so they vanish under exp, and labels can never point at them).
+    """
+    n, d = h.shape
+    v = w.shape[1]
+    chunk = int(min(chunk_size, v))
+    pad = (-v) % chunk
+    if b is None:
+        b = jnp.zeros((v,), jnp.float32)
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+        b = jnp.pad(b.astype(jnp.float32), (0, pad), constant_values=_NEG)
+    n_chunks = (v + pad) // chunk
+    labels = labels.astype(jnp.int32)
+
+    def body(carry, i):
+        m, s, lab = carry
+        wc = lax.dynamic_slice_in_dim(w, i * chunk, chunk, axis=1)
+        bc = lax.dynamic_slice_in_dim(b.astype(jnp.float32), i * chunk, chunk)
+        logits = (
+            jnp.dot(h, wc, preferred_element_type=jnp.float32) + bc[None, :]
+        )  # [N, chunk] — the only live logits block
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]
+        ).sum(axis=-1)
+        off = labels - i * chunk
+        hit = (off >= 0) & (off < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(off, 0, chunk - 1)[:, None], axis=1
+        )[:, 0]
+        lab = lab + jnp.where(hit, picked, 0.0)
+        return (m_new, s, lab), None
+
+    init = (
+        jnp.full((n,), _NEG, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+    )
+    # checkpoint: scan would otherwise stash every chunk's [N, chunk] logits
+    # as backward residuals — re-materializing exactly the tensor this op
+    # exists to avoid.  With it, only the [N] carries survive the forward.
+    # prevent_cse=False: safe (and documented as the right setting) inside
+    # scan, and it drops the optimization barriers that would block XLA
+    # from fusing the logsumexp tail into the blocked matmul.
+    (m, s, lab), _ = lax.scan(
+        jax.checkpoint(body, prevent_cse=False), init, jnp.arange(n_chunks)
+    )
+    return ((m + jnp.log(s)) - lab).mean()
+
+
+def lm_head_xent(
+    model,
+    params,
+    tokens: jax.Array,
+    chunk_size: int = 4096,
+    mesh=None,
+) -> jax.Array:
+    """Next-token NLL for a ``TransformerLM`` without materialized logits.
+
+    Runs the backbone (``return_features=True``), then the chunked head on
+    the flattened [B*(T-1), D] features against the shifted tokens, reading
+    the same ``lm_head`` parameters ``model.apply`` would use — one init,
+    either loss path.
+    """
+    feats = model.apply(params, tokens, mesh, return_features=True)
+    head = params["params"]["lm_head"]
+    b, t, dm = feats.shape
+    return chunked_softmax_xent(
+        feats[:, :-1].reshape(b * (t - 1), dm).astype(jnp.float32),
+        head["kernel"].astype(jnp.float32),
+        head["bias"].astype(jnp.float32),
+        tokens[:, 1:].reshape(-1),
+        chunk_size=chunk_size,
+    )
+
+
+def naive_softmax_xent(h, w, b, labels):
+    """The materialized-logits loss the chunked op replaces (test oracle)."""
+    logits = jnp.dot(h, w, preferred_element_type=jnp.float32)
+    if b is not None:
+        logits = logits + b.astype(jnp.float32)[None, :]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()

@@ -1,0 +1,140 @@
+"""Chunked-vocab cross-entropy vs the materialized-logits oracle.
+
+The op's whole value is byte-level equivalence of loss *and gradients*
+with the naive path while the [N, V] logits tensor never exists — so every
+test here checks both, across the edge cases that bite blockwise scans:
+vocab not divisible by the chunk, labels on chunk boundaries, a chunk
+bigger than the vocab, and bf16 features.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moolib_tpu.ops.xent import (
+    chunked_softmax_xent,
+    lm_head_xent,
+    naive_softmax_xent,
+)
+
+
+def _data(n=24, d=16, v=50, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    h = jnp.asarray(rng.normal(size=(n, d)).astype(dtype))
+    w = jnp.asarray(rng.normal(size=(d, v)).astype(np.float32) * 0.3)
+    b = jnp.asarray(rng.normal(size=(v,)).astype(np.float32) * 0.1)
+    labels = jnp.asarray(rng.integers(0, v, size=(n,)).astype(np.int32))
+    return h, w, b, labels
+
+
+@pytest.mark.parametrize("chunk", [7, 16, 50, 64, 128])
+def test_loss_matches_naive(chunk):
+    h, w, b, labels = _data()
+    got = chunked_softmax_xent(h, w, b, labels, chunk_size=chunk)
+    want = naive_softmax_xent(h, w, b, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+@pytest.mark.parametrize("chunk", [16, 50, 128])
+def test_grads_match_naive(chunk):
+    h, w, b, labels = _data()
+    g1 = jax.grad(
+        lambda h, w, b: chunked_softmax_xent(h, w, b, labels, chunk_size=chunk),
+        argnums=(0, 1, 2),
+    )(h, w, b)
+    g2 = jax.grad(
+        lambda h, w, b: naive_softmax_xent(h, w, b, labels), argnums=(0, 1, 2)
+    )(h, w, b)
+    for got, want, name in zip(g1, g2, ("dh", "dw", "db")):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6, err_msg=name
+        )
+
+
+def test_labels_on_chunk_boundaries():
+    # Labels exactly at 0, chunk-1, chunk, v-1: off-by-one in the hit mask
+    # or the clipped take would show here.
+    h, w, b, _ = _data(n=8, v=64)
+    labels = jnp.asarray([0, 15, 16, 17, 31, 32, 33, 63], jnp.int32)
+    got = chunked_softmax_xent(h, w, b, labels, chunk_size=16)
+    want = naive_softmax_xent(h, w, b, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_no_bias_and_bf16_features():
+    h, w, _, labels = _data(dtype=np.float32)
+    h16 = h.astype(jnp.bfloat16)
+    got = chunked_softmax_xent(h16, w, None, labels, chunk_size=16)
+    # The oracle sees the same bf16-rounded features promoted the same way.
+    want = naive_softmax_xent(h16.astype(jnp.float32), w, None, labels)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_lm_head_xent_matches_model_loss():
+    from moolib_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=97, d_model=32, num_heads=4, num_layers=2, max_len=64,
+        attention="dense", dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(0, 97, size=(2, 12)).astype(np.int32))
+    params = model.init(jax.random.key(0), toks)
+
+    def naive_loss(p):
+        logits = model.apply(p, toks)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        return -jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1).mean()
+
+    got, ggot = jax.value_and_grad(
+        lambda p: lm_head_xent(model, p, toks, chunk_size=32)
+    )(params)
+    want, gwant = jax.value_and_grad(naive_loss)(params)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+    flat1 = jax.tree_util.tree_leaves_with_path(ggot)
+    flat2 = dict(jax.tree_util.tree_leaves_with_path(gwant))
+    for path, leaf in flat1:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat2[path]), rtol=5e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_init_on_features_path_still_creates_head():
+    # A fused-loss-only user inits with return_features=True; the head's
+    # params must exist anyway (lm_head_xent reads them directly).
+    from moolib_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=53, d_model=16, num_heads=2, num_layers=1, max_len=32,
+        attention="dense", dtype=jnp.float32,
+    )
+    toks = jnp.zeros((1, 8), jnp.int32)
+    p1 = model.init(jax.random.key(0), toks, return_features=True)
+    p2 = model.init(jax.random.key(0), toks)
+    assert jax.tree_util.tree_structure(p1) == jax.tree_util.tree_structure(p2)
+    loss = lm_head_xent(model, p1, toks, chunk_size=16)
+    assert np.isfinite(float(loss))
+
+
+def test_logits_never_materialize():
+    # The point of the op: compile at a size where [N, V] f32 would be
+    # ~4 GB and assert peak temp memory stays far below it.  (CPU cost
+    # analysis reports temp allocation; guard loosely to stay portable.)
+    n, d, v = 2048, 64, 1 << 19  # logits would be 2048 * 524288 * 4 = 4 GiB
+    h = jnp.zeros((n, d), jnp.float32)
+    w = jnp.zeros((d, v), jnp.float32)
+    labels = jnp.zeros((n,), jnp.int32)
+    fn = jax.jit(
+        lambda h, w, l: chunked_softmax_xent(h, w, None, l, chunk_size=4096)
+    )
+    mem = fn.lower(h, w, labels).compile().memory_analysis()
+    if mem is None:
+        pytest.skip("backend reports no memory analysis")
+    peak = getattr(mem, "temp_size_in_bytes", None)
+    if peak is None:
+        pytest.skip("backend reports no temp size")
+    assert peak < 1 << 30, f"temp {peak} bytes — logits materialized?"

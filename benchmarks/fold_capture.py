@@ -60,31 +60,37 @@ def parse_flash(path):
     return lines if any(re.match(r"\s*\d+\s", l) for l in lines) else None
 
 
-def parse_agent(path):
-    """agent_bench prints one {'metric': 'impala_agent_sps', ...} JSON line."""
+def _parse_json_line(path, marker, cpu_gate=True):
+    """Last JSON line in ``path`` containing ``marker``; chip-gated unless
+    ``cpu_gate=False`` (host-side rows are valid wherever the battery ran)."""
     try:
         with open(path) as f:
             for line in reversed(f.read().splitlines()):
-                if line.startswith("{") and "impala_agent_sps" in line:
+                if line.startswith("{") and marker in line:
                     row = json.loads(line)
-                    return row if row.get("platform") != "cpu" else None
+                    if cpu_gate and row.get("platform") == "cpu":
+                        return None
+                    return row
     except (OSError, json.JSONDecodeError):
         return None
     return None
+
+
+def parse_agent(path):
+    """agent_bench prints one {'metric': 'impala_agent_sps', ...} JSON line."""
+    return _parse_json_line(path, "impala_agent_sps")
+
+
+def parse_r2d2(path):
+    """r2d2_bench prints one {'metric': 'r2d2_learner_sps', ...} JSON line."""
+    return _parse_json_line(path, "r2d2_learner_sps")
 
 
 def parse_envpool(path):
     """envpool_bench prints one {'env': ..., 'env_steps_per_s': ...} line.
     EnvPool runs host-side, so there is no platform gate — the row is valid
     wherever the battery ran (it matters next to the chip's learner rows)."""
-    try:
-        with open(path) as f:
-            for line in reversed(f.read().splitlines()):
-                if line.startswith("{") and "env_steps_per_s" in line:
-                    return json.loads(line)
-    except (OSError, json.JSONDecodeError):
-        return None
-    return None
+    return _parse_json_line(path, "env_steps_per_s", cpu_gate=False)
 
 
 def parse_serve(path):
@@ -212,6 +218,10 @@ def main():
     if agent:
         data["impala_agent"] = dict(agent, captured_when=stamp("agent_bench.log"))
         updated.append("impala_agent")
+    r2d2 = parse_r2d2(os.path.join(cap, "r2d2_bench.log"))
+    if r2d2:
+        data["r2d2_learner"] = dict(r2d2, captured_when=stamp("r2d2_bench.log"))
+        updated.append("r2d2_learner")
     pool = parse_envpool(os.path.join(cap, "envpool_atari.log"))
     if pool:
         data["envpool_atari"] = dict(pool, captured_when=stamp("envpool_atari.log"))

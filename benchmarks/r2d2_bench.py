@@ -1,0 +1,128 @@
+"""R2D2 learner-update throughput at the classic Atari scale, on chip.
+
+Times the full jitted R2D2 update — pixel ResNet encoder + LSTM unroll,
+sequence double-Q TD loss (``examples/r2d2.td_loss``: the exact product
+code path), per-sequence priorities, global-norm clip + adam, target-net
+refresh excluded (it is a once-per-100-updates copy) — at the R2D2 paper
+geometry: 64 sequences of T=80, 84x84x4 uint8 frames, dueling heads.
+
+Third model family on hardware beside the IMPALA step (bench.py) and the
+TransformerLM sweep (lm_bench.py); the reference has no replay/recurrent-
+value-learning family at all (its examples stop at a2c/vtrace —
+SURVEY.md §2.2), so this documents capability the framework adds.
+
+    JAX_PLATFORMS='' python benchmarks/r2d2_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import marginal_time  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from moolib_tpu.examples.r2d2 import td_loss
+    from moolib_tpu.models.qnet import RecurrentQNet
+    from moolib_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+    if jax.default_backend() == "cpu" and os.environ.get("MOOLIB_ALLOW_CPU") != "1":
+        raise SystemExit(
+            "r2d2_bench needs an accelerator backend "
+            "(MOOLIB_ALLOW_CPU=1 for a labeled plumbing-proof run)"
+        )
+    dev = jax.devices()[0]
+
+    # R2D2 paper geometry (smoke-shrinkable for CPU plumbing runs).
+    T = int(os.environ.get("MOOLIB_R2D2_T", 80))
+    B = int(os.environ.get("MOOLIB_R2D2_B", 64))
+    A = 18  # full Atari action set
+    model = RecurrentQNet(
+        num_actions=A, encoder="impala", hidden_size=512, core_size=512,
+        dtype=jnp.bfloat16,
+    )
+
+    rng = np.random.default_rng(0)
+    batch = {
+        # T+1 timesteps: the loss consumes q[:-1] against targets built
+        # from step t+1, same slicing as the example's training path.
+        "state": jnp.asarray(
+            rng.integers(0, 256, size=(T + 1, B, 84, 84, 4), dtype=np.uint8)
+        ),
+        "done": jnp.asarray(rng.random((T + 1, B)) < 0.005),
+        "action": jnp.asarray(
+            rng.integers(0, A, size=(T + 1, B), dtype=np.int32)
+        ),
+        "reward": jnp.asarray(rng.normal(size=(T + 1, B)).astype(np.float32)),
+        "is_weight": jnp.asarray(rng.random(B).astype(np.float32) + 0.5),
+    }
+    params = model.init(
+        jax.random.key(0),
+        jax.tree_util.tree_map(lambda x: x[:1], batch),
+        model.initial_state(B),
+    )
+    # Replay sequences carry their stored initial LSTM state (the example's
+    # learn batches do the same); td_loss unrolls from it.
+    batch["core"] = tuple(model.initial_state(B))
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    target_params = jax.tree_util.tree_map(jnp.copy, params)
+    opt = optax.chain(optax.clip_by_global_norm(40.0), optax.adam(1e-4))
+    opt_state = opt.init(params)
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def update(p, s, tp, b):
+        (loss, prio), g = jax.value_and_grad(
+            lambda p_: td_loss(p_, tp, model, b, 0.997), has_aux=True
+        )(p)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, loss, prio
+
+    state = {"p": params, "s": opt_state}
+
+    def run(iters):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state["p"], state["s"], loss, prio = update(
+                state["p"], state["s"], target_params, batch
+            )
+        float(loss)  # force the chain with a scalar fetch
+        return time.perf_counter() - t0
+
+    sec = marginal_time(run, 2, 6)
+    frames = B * T
+    print(json.dumps({
+        "metric": "r2d2_learner_sps",
+        "value": round(frames / sec, 1),
+        "unit": "env_frames/s",
+        "step_ms": round(sec * 1e3, 2),
+        "updates_per_s": round(1.0 / sec, 2),
+        "params": n_params,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "config": (
+            f"R2D2 Atari geometry: {B} sequences x T={T}, 84x84x4 uint8, "
+            f"impala-encoder RecurrentQNet (dueling, double-Q, PER weights), "
+            f"bf16, clip+adam"
+        ),
+        "baseline": (
+            "reference framework has no replay/recurrent-Q family "
+            "(SURVEY.md §2.2); row documents added capability"
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -16,12 +16,12 @@ export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
 
-STEPS="dv_triage flash_bwd_tests lm_quick flash_tests flash_bench lm_full agent_bench serve_bench impala_wide envpool_atari roofline_chip"
+STEPS="dv_triage flash_bwd_tests lm_quick flash_tests flash_bench lm_full agent_bench r2d2_bench serve_bench impala_wide envpool_atari roofline_chip"
 
 # Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
 # backend init can hold the single chip's connection into the next revival.
 pkill -f "MOOLIB_BENCH_CHILD=tpu" 2>/dev/null
-pkill -f "benchmarks/(lm_bench|flash_bench|agent_bench|serve_bench|envpool_bench|impala_roofline|debug_flash_dv)" 2>/dev/null
+pkill -f "benchmarks/(lm_bench|flash_bench|agent_bench|serve_bench|envpool_bench|impala_roofline|debug_flash_dv|r2d2_bench)" 2>/dev/null
 pkill -f "pytest tests/test_flash_attention_tpu" 2>/dev/null
 sleep 2
 
@@ -87,6 +87,9 @@ run lm_full 1800 env MOOLIB_LM_CONFIGS="4096,4,0;4096,8,1;8192,2,0;8192,4,1" \
   python -u benchmarks/lm_bench.py
 # 5. Whole-agent SPS at the reference flagship scale.
 run agent_bench 1200 python -u benchmarks/agent_bench.py --scale reference
+# 5b. R2D2 learner update at the paper's Atari geometry — third model
+#     family on hardware (replay/recurrent-Q; absent from the reference).
+run r2d2_bench 900 python -u benchmarks/r2d2_bench.py
 # 6. Serving under load at d=512/L=8 with the batch-cap sweep.
 run serve_bench 3000 python -u benchmarks/serve_bench.py --seconds 20 \
   --clients 16 --d_model 512 --layers 8 --heads 8 --kv_heads 8 2 \

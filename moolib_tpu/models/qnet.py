@@ -1,16 +1,22 @@
-"""Recurrent Q-network for the R2D2-family example (FC → LSTM → Q-values).
+"""Recurrent Q-network for the R2D2-family example (encoder → LSTM → Q).
 
 Same call contract as the other models: time-major input dict →
-({"q": [T,B,A]}, core_state).
+({"q": [T,B,A]}, core_state).  ``encoder="mlp"`` (default) consumes flat
+vector states; ``encoder="impala"`` consumes [T,B,H,W,C] uint8 frames
+through the shared IMPALA ResNet — the classic R2D2-on-Atari shape
+(B=64 sequences of T=80 at 84×84×4), which is what
+``benchmarks/r2d2_bench.py`` times on chip.
 """
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+from .impala import ImpalaEncoder
 
 
 class RecurrentQNet(nn.Module):
@@ -19,6 +25,8 @@ class RecurrentQNet(nn.Module):
     core_size: int = 64
     use_lstm: bool = True
     dtype: Any = jnp.float32
+    encoder: str = "mlp"  # mlp (vector states) | impala (pixel frames)
+    channels: Sequence[int] = (16, 32, 32)  # impala encoder widths
 
     def initial_state(self, batch_size: int) -> Tuple:
         if not self.use_lstm:
@@ -32,7 +40,15 @@ class RecurrentQNet(nn.Module):
     def __call__(self, inputs, core_state=()):
         x = inputs["state"]
         T, B = x.shape[0], x.shape[1]
-        x = x.reshape(T * B, -1).astype(self.dtype)
+        if self.encoder == "impala":
+            x = x.reshape(T * B, *x.shape[2:])
+            x = x.astype(self.dtype) / 255.0
+            x = ImpalaEncoder(self.channels, self.dtype)(x)
+            x = x.reshape(T * B, -1)
+        elif self.encoder == "mlp":
+            x = x.reshape(T * B, -1).astype(self.dtype)
+        else:
+            raise ValueError(f"unknown encoder {self.encoder!r}")
         x = nn.relu(nn.Dense(self.hidden_size, dtype=self.dtype)(x))
         x = nn.relu(nn.Dense(self.core_size, dtype=self.dtype)(x))
 

@@ -53,48 +53,63 @@ def chunked_softmax_xent(
     n, d = h.shape
     v = w.shape[1]
     chunk = int(min(chunk_size, v))
-    pad = (-v) % chunk
     if b is None:
         b = jnp.zeros((v,), jnp.float32)
-    if pad:
-        w = jnp.pad(w, ((0, 0), (0, pad)))
-        b = jnp.pad(b.astype(jnp.float32), (0, pad), constant_values=_NEG)
-    n_chunks = (v + pad) // chunk
     labels = labels.astype(jnp.int32)
 
-    def body(carry, i):
+    def update(carry, wc, bc, base, width):
+        """Fold one [N, width] logits block into the running statistics."""
         m, s, lab = carry
-        wc = lax.dynamic_slice_in_dim(w, i * chunk, chunk, axis=1)
-        bc = lax.dynamic_slice_in_dim(b.astype(jnp.float32), i * chunk, chunk)
         logits = (
-            jnp.dot(h, wc, preferred_element_type=jnp.float32) + bc[None, :]
-        )  # [N, chunk] — the only live logits block
+            jnp.dot(h, wc, preferred_element_type=jnp.float32)
+            + bc.astype(jnp.float32)[None, :]
+        )  # the only live logits block
         m_new = jnp.maximum(m, logits.max(axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.exp(
             logits - m_new[:, None]
         ).sum(axis=-1)
-        off = labels - i * chunk
-        hit = (off >= 0) & (off < chunk)
+        off = labels - base
+        hit = (off >= 0) & (off < width)
         picked = jnp.take_along_axis(
-            logits, jnp.clip(off, 0, chunk - 1)[:, None], axis=1
+            logits, jnp.clip(off, 0, width - 1)[:, None], axis=1
         )[:, 0]
-        lab = lab + jnp.where(hit, picked, 0.0)
-        return (m_new, s, lab), None
+        return m_new, s, lab + jnp.where(hit, picked, 0.0)
 
-    init = (
+    carry = (
         jnp.full((n,), _NEG, jnp.float32),
         jnp.zeros((n,), jnp.float32),
         jnp.zeros((n,), jnp.float32),
     )
+    # Full-width blocks ride a scan; a ragged tail (chunk not dividing V)
+    # is one extra static-width block — no padded copy of the whole [D, V]
+    # weight (which would double head-weight traffic for, say, V=50257).
+    n_full = v // chunk
+
+    def body(carry, i):
+        wc = lax.dynamic_slice_in_dim(w, i * chunk, chunk, axis=1)
+        bc = lax.dynamic_slice_in_dim(b, i * chunk, chunk)
+        return update(carry, wc, bc, i * chunk, chunk), None
+
     # checkpoint: scan would otherwise stash every chunk's [N, chunk] logits
     # as backward residuals — re-materializing exactly the tensor this op
     # exists to avoid.  With it, only the [N] carries survive the forward.
     # prevent_cse=False: safe (and documented as the right setting) inside
     # scan, and it drops the optimization barriers that would block XLA
     # from fusing the logsumexp tail into the blocked matmul.
-    (m, s, lab), _ = lax.scan(
-        jax.checkpoint(body, prevent_cse=False), init, jnp.arange(n_chunks)
-    )
+    if n_full:
+        carry, _ = lax.scan(
+            jax.checkpoint(body, prevent_cse=False), carry, jnp.arange(n_full)
+        )
+    if v % chunk:
+        tail = jax.checkpoint(
+            lambda c: update(
+                c, w[:, n_full * chunk:], b[n_full * chunk:],
+                n_full * chunk, v - n_full * chunk,
+            ),
+            prevent_cse=False,
+        )
+        carry = tail(carry)
+    m, s, lab = carry
     return ((m + jnp.log(s)) - lab).mean()
 
 

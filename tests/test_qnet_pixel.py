@@ -1,0 +1,70 @@
+"""Pixel-encoder RecurrentQNet: shapes, gradients, and td_loss integration.
+
+The vector-state path is exercised end-to-end by the R2D2 integration test
+(CartPole learns); this file pins the ``encoder="impala"`` variant the
+chip bench (benchmarks/r2d2_bench.py) times — small shapes, full product
+code path (model + examples.r2d2.td_loss).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moolib_tpu.examples.r2d2 import td_loss
+from moolib_tpu.models.qnet import RecurrentQNet
+
+
+def _batch(rng, t, b, a, hw=12):
+    return {
+        "state": jnp.asarray(
+            rng.integers(0, 256, size=(t + 1, b, hw, hw, 4), dtype=np.uint8)
+        ),
+        "done": jnp.asarray(rng.random((t + 1, b)) < 0.1),
+        "action": jnp.asarray(rng.integers(0, a, size=(t + 1, b), dtype=np.int32)),
+        "reward": jnp.asarray(rng.normal(size=(t + 1, b)).astype(np.float32)),
+        "is_weight": jnp.asarray(rng.random(b).astype(np.float32) + 0.5),
+    }
+
+
+def test_pixel_qnet_shapes_and_grads():
+    t, b, a = 3, 2, 6
+    model = RecurrentQNet(
+        num_actions=a, encoder="impala", channels=(4, 8), hidden_size=16,
+        core_size=16, dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    batch = _batch(rng, t, b, a)
+    params = model.init(
+        jax.random.key(0),
+        jax.tree_util.tree_map(lambda x: x[:1], batch),
+        model.initial_state(b),
+    )
+    out, core = model.apply(params, batch, model.initial_state(b))
+    assert out["q"].shape == (t + 1, b, a)
+    assert all(c.shape == (b, 16) for c in core)
+
+    batch["core"] = tuple(model.initial_state(b))
+    (loss, prio), grads = jax.value_and_grad(
+        lambda p: td_loss(p, params, model, batch, 0.99), has_aux=True
+    )(params)
+    assert np.isfinite(float(loss))
+    assert prio.shape == (b,)
+    gnorm = sum(
+        float(jnp.sum(g.astype(jnp.float32) ** 2))
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+    assert gnorm > 0.0, "no gradient reached the encoder"
+
+
+def test_pixel_qnet_rejects_unknown_encoder():
+    model = RecurrentQNet(num_actions=2, encoder="resnet50")
+    x = {
+        "state": jnp.zeros((1, 1, 8, 8, 4), jnp.uint8),
+        "done": jnp.zeros((1, 1), bool),
+    }
+    try:
+        model.init(jax.random.key(0), x, model.initial_state(1))
+    except ValueError as e:
+        assert "encoder" in str(e)
+    else:
+        raise AssertionError("unknown encoder accepted")

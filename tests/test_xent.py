@@ -120,6 +120,33 @@ def test_init_on_features_path_still_creates_head():
     assert np.isfinite(float(loss))
 
 
+def test_fused_xent_under_mesh():
+    # The op must compose with the sharded train step: batch rows over dp,
+    # lm_head vocab columns over tp (the dynamic_slice over a tp-sharded
+    # vocab axis is XLA's problem, not the caller's).  Value must match the
+    # unsharded run.
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs the 8-virtual-device conftest mesh")
+    mesh = Mesh(np.array(devs[:4]).reshape(2, 2), ("dp", "tp"))
+    h, w, b, labels = _data(n=16, d=8, v=64)
+    want = float(chunked_softmax_xent(h, w, b, labels, chunk_size=16))
+
+    fn = jax.jit(
+        lambda h, w, b, l: chunked_softmax_xent(h, w, b, l, chunk_size=16),
+        in_shardings=(
+            NamedSharding(mesh, P("dp", None)),
+            NamedSharding(mesh, P(None, "tp")),
+            NamedSharding(mesh, P("tp")),
+            NamedSharding(mesh, P("dp")),
+        ),
+    )
+    got = float(fn(h, w, b, labels))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
 def test_logits_never_materialize():
     # The point of the op: compile at a size where [N, V] f32 would be
     # ~4 GB and assert peak temp memory stays far below it.  (CPU cost

@@ -41,10 +41,12 @@ MODULES = [
     ("moolib_tpu.parallel.moe", "Parallelism: mixture-of-experts"),
     ("moolib_tpu.parallel.train", "Parallelism: train-step assembly"),
     ("moolib_tpu.models.impala", "Models: IMPALA ResNet"),
+    ("moolib_tpu.models.qnet", "Models: recurrent Q-network (R2D2)"),
     ("moolib_tpu.models.transformer", "Models: Transformer LM"),
     ("moolib_tpu.ops.vtrace", "Ops: V-trace"),
     ("moolib_tpu.ops.flash_attention", "Ops: Flash attention (pallas)"),
     ("moolib_tpu.ops.returns", "Ops: returns / losses"),
+    ("moolib_tpu.ops.xent", "Ops: chunked cross-entropy (LM head)"),
     ("moolib_tpu.utils", "Utilities"),
     ("moolib_tpu.utils.nest", "Utilities: nest"),
     ("moolib_tpu.utils.config", "Utilities: config"),

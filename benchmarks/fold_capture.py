@@ -176,10 +176,20 @@ def main():
     # The short-window battery splits the LM sweep into lm_quick/lm_full
     # logs; merge their rows (keyed by config) with the single-log name.
     lm_parts = {n: parse_lm(os.path.join(cap, n))
-                for n in ("lm_bench.log", "lm_quick.log", "lm_full.log")}
+                for n in ("lm_bench.log", "lm_quick.log", "lm_full.log",
+                          "lm_bf16.log")}
     lm_logs = [n for n, part in lm_parts.items() if part]
     if lm_logs:
         rows, meta = {}, None
+        # Seed from the already-folded section: a re-armed step's re-run
+        # shelves its old log (run() moves it to .log.prev, which fold never
+        # reads), so rows that only exist in BENCH_TPU.json — e.g. the naive
+        # baseline at the configs lm_quick re-measures fused — must survive
+        # the rebuild or the fused-vs-naive comparison loses its baseline.
+        for r in data.get("lm_train", {}).get("rows", []):
+            r = dict(r)
+            r.setdefault("xent", "naive")
+            rows[(r["T"], r["B"], r["remat"], r["xent"])] = r
         for n in lm_logs:
             part = lm_parts[n]
             meta = {k: v for k, v in part.items() if k != "rows"}
@@ -190,8 +200,10 @@ def main():
                 r.setdefault("xent", "naive")
                 rows[(r["T"], r["B"], r["remat"], r["xent"])] = r
         data["lm_train"] = dict(
-            meta, rows=sorted(rows.values(), key=lambda r: (r.get("T", 0), r.get("remat", False), r.get("B", 0))),
-            captured_when=stamp(lm_logs[-1]),
+            meta, rows=sorted(rows.values(), key=lambda r: (r.get("T", 0), r.get("remat", False), r.get("B", 0), r.get("xent", ""))),
+            # Freshest log stamps the section: the battery's step order and
+            # this tuple's order differ (lm_bf16 runs before lm_full).
+            captured_when=max(stamp(n) for n in lm_logs),
         )
         updated.append("lm_train")
     flash = parse_flash(os.path.join(cap, "flash_bench.log"))

@@ -56,10 +56,12 @@ def main():
     # logits tensor never materializes.  naive = materialized log_softmax,
     # kept as the comparison row (MOOLIB_LM_XENT=naive).
     xent_mode = os.environ.get("MOOLIB_LM_XENT", "fused")
-    if xent_mode not in ("fused", "naive"):
+    if xent_mode not in ("fused", "fused_bf16", "naive"):
         # Rows are keyed by this string downstream (fold_capture): a typo'd
         # mode must fail loudly, not fold a mislabeled chip row.
-        raise SystemExit(f"MOOLIB_LM_XENT must be fused|naive, got {xent_mode!r}")
+        raise SystemExit(
+            f"MOOLIB_LM_XENT must be fused|fused_bf16|naive, got {xent_mode!r}"
+        )
     print(f"# backend={jax.default_backend()} device={dev.device_kind} "
           f"d_model={D} layers={L} kv_heads={KV or H} xent={xent_mode}")
     print(f"{'T':>6} {'B':>3} {'remat':>5} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
@@ -103,11 +105,13 @@ def main():
             opt = optax.adamw(1e-4)
             opt_state = opt.init(params)
 
-            if xent_mode == "fused":
+            if xent_mode.startswith("fused"):
                 from moolib_tpu.ops.xent import lm_head_xent
 
+                cdt = jnp.bfloat16 if xent_mode == "fused_bf16" else None
+
                 def loss_fn(p, t):
-                    return lm_head_xent(model, p, t)
+                    return lm_head_xent(model, p, t, compute_dtype=cdt)
             else:
                 def loss_fn(p, t):
                     logits = model.apply(p, t)

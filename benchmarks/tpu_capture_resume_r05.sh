@@ -16,7 +16,7 @@ export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
 
-STEPS="dv_triage flash_bwd_tests lm_quick flash_tests flash_bench lm_full agent_bench r2d2_bench serve_bench impala_wide envpool_atari roofline_chip"
+STEPS="dv_triage flash_bwd_tests lm_quick lm_bf16 flash_tests flash_bench lm_full agent_bench r2d2_bench serve_bench impala_wide envpool_atari roofline_chip"
 
 # Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
 # backend init can hold the single chip's connection into the next revival.
@@ -75,7 +75,15 @@ run dv_triage 600 python -u benchmarks/debug_flash_dv.py --t 512
 run flash_bwd_tests 600 env MOOLIB_RUN_TPU_TESTS=1 \
   python -u -m pytest tests/test_flash_attention_tpu.py -v -k "backward"
 # 2. LM training rows, shortest configs first so any window yields rows.
+#    (Re-armed after the fused-xent landing: these rows now run the
+#    chunked loss; today's naive rows at the same configs stay folded for
+#    the direct comparison.)
 run lm_quick 900 env MOOLIB_LM_CONFIGS="1024,16,0;2048,8,0" \
+  python -u benchmarks/lm_bench.py
+# 2b. bf16 head-matmul inputs (f32 accumulation): on TPU the f32 head is
+#     multi-pass at a fraction of bf16 throughput and is ~a third of the
+#     whole step at this scale.
+run lm_bf16 600 env MOOLIB_LM_XENT=fused_bf16 MOOLIB_LM_CONFIGS="1024,16,0" \
   python -u benchmarks/lm_bench.py
 # 3. The full flash test file (fwd re-run + bf16 + backward again).
 run flash_tests 900 env MOOLIB_RUN_TPU_TESTS=1 \

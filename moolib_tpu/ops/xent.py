@@ -41,14 +41,22 @@ def chunked_softmax_xent(
     b: Optional[jax.Array],
     labels: jax.Array,
     chunk_size: int = 4096,
+    compute_dtype=None,
 ) -> jax.Array:
     """Mean negative log-likelihood of ``labels`` under ``softmax(h @ w + b)``.
 
-    h: [N, D] (any float dtype; promoted to f32 in the matmul accumulate),
-    w: [D, V], b: [V] or None, labels: [N] int.  Returns a f32 scalar.
-    ``chunk_size`` bounds the live logits block to [N, chunk_size]; the
-    vocab axis is zero-padded up to a multiple (padded columns get a -1e30
-    bias so they vanish under exp, and labels can never point at them).
+    h: [N, D], w: [D, V], b: [V] or None, labels: [N] int.  Returns a f32
+    scalar.  ``chunk_size`` bounds the live logits block to
+    [N, chunk_size]; a vocab the chunk doesn't divide gets one extra
+    static-width tail block (never a padded copy of w).
+
+    ``compute_dtype`` casts the matmul *inputs* (e.g. ``jnp.bfloat16``;
+    accumulation stays f32 via ``preferred_element_type``).  On TPU an f32
+    matmul runs multi-pass at a fraction of bf16 throughput, and at bench
+    scale the head is a third of the whole train step — bf16 inputs are
+    the standard production trade (logsumexp statistics stay f32).
+    Default ``None`` keeps the inputs' own dtype (f32 parity with the
+    materialized ``log_softmax`` path).
     """
     n, d = h.shape
     v = w.shape[1]
@@ -56,10 +64,14 @@ def chunked_softmax_xent(
     if b is None:
         b = jnp.zeros((v,), jnp.float32)
     labels = labels.astype(jnp.int32)
+    if compute_dtype is not None:
+        h = h.astype(compute_dtype)
 
     def update(carry, wc, bc, base, width):
         """Fold one [N, width] logits block into the running statistics."""
         m, s, lab = carry
+        if compute_dtype is not None:
+            wc = wc.astype(compute_dtype)  # per chunk: no full-w copy
         logits = (
             jnp.dot(h, wc, preferred_element_type=jnp.float32)
             + bc.astype(jnp.float32)[None, :]
@@ -119,6 +131,7 @@ def lm_head_xent(
     tokens: jax.Array,
     chunk_size: int = 4096,
     mesh=None,
+    compute_dtype=None,
 ) -> jax.Array:
     """Next-token NLL for a ``TransformerLM`` without materialized logits.
 
@@ -136,6 +149,7 @@ def lm_head_xent(
         head["bias"].astype(jnp.float32),
         tokens[:, 1:].reshape(-1),
         chunk_size=chunk_size,
+        compute_dtype=compute_dtype,
     )
 
 

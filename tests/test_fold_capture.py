@@ -86,6 +86,31 @@ def test_lm_rows_merge_across_split_logs(tmp_path):
     assert [r["T"] for r in rows] == [1024, 2048, 8192]  # sorted by config
 
 
+def test_lm_refold_keeps_baseline_rows_absent_from_logs(tmp_path):
+    # A re-armed step's re-run shelves its old log (.log.prev, never read):
+    # rows that only exist in the already-folded BENCH_TPU.json — the naive
+    # baseline at configs lm_quick re-measures fused — must survive the
+    # rebuild, keyed apart by xent mode.
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    out.write_text(json.dumps({"lm_train": {
+        "platform": "tpu", "device_kind": "TPU v5 lite", "rows": [
+            {"T": 1024, "B": 16, "remat": False, "tokens_per_s": 100.0},
+            {"T": 8192, "B": 2, "remat": False, "tokens_per_s": 40.0}]}}))
+    (cap / "lm_quick.log").write_text(lm_line([
+        {"T": 1024, "B": 16, "remat": False, "xent": "fused",
+         "tokens_per_s": 130.0}]) + "\n")
+    run_fold(cap, out)
+    rows = json.loads(out.read_text())["lm_train"]["rows"]
+    by_key = {(r["T"], r["xent"]): r["tokens_per_s"] for r in rows}
+    assert by_key == {
+        (1024, "naive"): 100.0,   # baseline survived the refold
+        (1024, "fused"): 130.0,   # fresh fused row beside it
+        (8192, "naive"): 40.0,    # untouched config survived too
+    }
+
+
 def test_captured_when_is_log_mtime_not_fold_time(tmp_path):
     cap = tmp_path / "cap"
     cap.mkdir()

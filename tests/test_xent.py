@@ -62,6 +62,23 @@ def test_labels_on_chunk_boundaries():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
 
 
+def test_compute_dtype_bf16_close_to_f32():
+    # bf16 matmul inputs / f32 accumulation: same loss to bf16 input
+    # precision, and gradients still flow (the chip bench's fused_bf16 row).
+    h, w, b, labels = _data()
+    f32 = chunked_softmax_xent(h, w, b, labels, chunk_size=16)
+    bf16, grads = jax.value_and_grad(
+        lambda h, w, b: chunked_softmax_xent(
+            h, w, b, labels, chunk_size=16, compute_dtype=jnp.bfloat16
+        ),
+        argnums=(0, 1, 2),
+    )(h, w, b)
+    np.testing.assert_allclose(
+        np.asarray(bf16), np.asarray(f32), rtol=2e-2, atol=2e-2
+    )
+    assert all(np.isfinite(np.asarray(g)).all() for g in grads)
+
+
 def test_no_bias_and_bf16_features():
     h, w, _, labels = _data(dtype=np.float32)
     h16 = h.astype(jnp.bfloat16)

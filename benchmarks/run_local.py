@@ -163,6 +163,13 @@ def main():
         "agent_small": _run(
             [py, "benchmarks/agent_bench.py", "--scale", "small"], timeout=900
         ),
+        # R2D2 learner-update plumbing row (tiny shapes; the paper-geometry
+        # chip row is the battery's r2d2_bench step).
+        "r2d2_small": _run(
+            [py, "benchmarks/r2d2_bench.py"], timeout=900,
+            extra_env={"MOOLIB_ALLOW_CPU": "1", "MOOLIB_R2D2_T": "8",
+                       "MOOLIB_R2D2_B": "4"},
+        ),
         # Serving under load: p50/p99 + tokens/s, dynamic batching on/off,
         # GQA sweep (VERDICT r3 ask #8).
         # --batch_sizes sweeps the cap: the crossover vs batch-1 is visible

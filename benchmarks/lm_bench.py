@@ -109,9 +109,11 @@ def main():
                 from moolib_tpu.ops.xent import lm_head_xent
 
                 cdt = jnp.bfloat16 if xent_mode == "fused_bf16" else None
+                ck = int(os.environ.get("MOOLIB_LM_XENT_CHUNK", 4096))
 
                 def loss_fn(p, t):
-                    return lm_head_xent(model, p, t, compute_dtype=cdt)
+                    return lm_head_xent(model, p, t, chunk_size=ck,
+                                        compute_dtype=cdt)
             else:
                 def loss_fn(p, t):
                     logits = model.apply(p, t)

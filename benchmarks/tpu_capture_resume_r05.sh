@@ -90,8 +90,12 @@ run flash_tests 900 env MOOLIB_RUN_TPU_TESTS=1 \
   python -u -m pytest tests/test_flash_attention_tpu.py -v
 # 3b. Flash kernel timing fwd+bwd vs dense & oracle.
 run flash_bench 1200 python -u benchmarks/flash_bench.py
-# 4. Long-T LM rows (4k/8k, remat).
-run lm_full 1800 env MOOLIB_LM_CONFIGS="4096,4,0;4096,8,1;8192,2,0;8192,4,1" \
+# 4. Long-T LM rows (4k/8k, remat) — now fused; the naive baselines stay
+#    folded.  The two doubled-batch rows (4096,16 and 8192,8) fit only if
+#    the chunked loss actually frees the logits memory: naive remat rows
+#    topped out at half these batches, and an OOM is recorded as a row,
+#    so the memory-win claim is falsifiable either way.
+run lm_full 2400 env MOOLIB_LM_CONFIGS="4096,4,0;4096,8,1;4096,16,1;8192,2,0;8192,4,1;8192,8,1" \
   python -u benchmarks/lm_bench.py
 # 5. Whole-agent SPS at the reference flagship scale.
 run agent_bench 1200 python -u benchmarks/agent_bench.py --scale reference

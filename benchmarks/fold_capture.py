@@ -186,19 +186,25 @@ def main():
         # reads), so rows that only exist in BENCH_TPU.json — e.g. the naive
         # baseline at the configs lm_quick re-measures fused — must survive
         # the rebuild or the fused-vs-naive comparison loses its baseline.
+        def key(r):
+            # xent mode and chunk size joined the key in round 5: fused,
+            # fused_bf16, naive, and different-chunk rows are distinct
+            # measurements and must not overwrite each other.
+            return (r["T"], r["B"], r["remat"], r["xent"],
+                    r.get("xent_chunk"))
+
         for r in data.get("lm_train", {}).get("rows", []):
             r = dict(r)
             r.setdefault("xent", "naive")
-            rows[(r["T"], r["B"], r["remat"], r["xent"])] = r
+            rows[key(r)] = r
         for n in lm_logs:
             part = lm_parts[n]
             meta = {k: v for k, v in part.items() if k != "rows"}
             for r in part.get("rows", []):
-                # xent joined the key in round 5 (fused vs naive loss rows
-                # coexist); older logs' rows are all the naive path.
+                # older logs' rows are all the naive path
                 r = dict(r)
                 r.setdefault("xent", "naive")
-                rows[(r["T"], r["B"], r["remat"], r["xent"])] = r
+                rows[key(r)] = r
         data["lm_train"] = dict(
             meta, rows=sorted(rows.values(), key=lambda r: (r.get("T", 0), r.get("remat", False), r.get("B", 0), r.get("xent", ""))),
             # Freshest log stamps the section: the battery's step order and

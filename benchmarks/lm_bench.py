@@ -62,8 +62,13 @@ def main():
         raise SystemExit(
             f"MOOLIB_LM_XENT must be fused|fused_bf16|naive, got {xent_mode!r}"
         )
+    xent_chunk = (
+        int(os.environ.get("MOOLIB_LM_XENT_CHUNK", 4096))
+        if xent_mode.startswith("fused") else None
+    )
     print(f"# backend={jax.default_backend()} device={dev.device_kind} "
-          f"d_model={D} layers={L} kv_heads={KV or H} xent={xent_mode}")
+          f"d_model={D} layers={L} kv_heads={KV or H} xent={xent_mode}"
+          + (f" chunk={xent_chunk}" if xent_chunk else ""))
     print(f"{'T':>6} {'B':>3} {'remat':>5} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
 
     rows = []
@@ -109,10 +114,9 @@ def main():
                 from moolib_tpu.ops.xent import lm_head_xent
 
                 cdt = jnp.bfloat16 if xent_mode == "fused_bf16" else None
-                ck = int(os.environ.get("MOOLIB_LM_XENT_CHUNK", 4096))
 
                 def loss_fn(p, t):
-                    return lm_head_xent(model, p, t, chunk_size=ck,
+                    return lm_head_xent(model, p, t, chunk_size=xent_chunk,
                                         compute_dtype=cdt)
             else:
                 def loss_fn(p, t):
@@ -151,7 +155,8 @@ def main():
                 raise  # only real OOMs become rows; compile errors must fail
             print(f"{T:>6} {B:>3} {str(remat):>5} {'OOM':>9}")
             rows.append(
-                {"T": T, "B": B, "remat": remat, "xent": xent_mode, "oom": True}
+                {"T": T, "B": B, "remat": remat, "xent": xent_mode,
+                 "xent_chunk": xent_chunk, "oom": True}
             )
             continue
         tokens_s = B * T / sec
@@ -165,6 +170,7 @@ def main():
               f"{tokens_s:>10.0f} {'n/a' if mfu is None else round(mfu, 3):>6}")
         rows.append(
             {"T": T, "B": B, "remat": remat, "xent": xent_mode,
+             "xent_chunk": xent_chunk,
              "step_ms": round(sec * 1e3, 2),
              "tokens_per_s": round(tokens_s, 1),
              "mfu_6nd": None if mfu is None else round(mfu, 4)}

@@ -1,0 +1,82 @@
+"""Hypothesis sweep of the chunked-vocab cross-entropy against a numpy
+log-softmax oracle: arbitrary (N, D, V, chunk) including chunks that don't
+divide V, extreme logit scales, and repeated/boundary labels — plus the
+gradient, which is where blockwise recompute bugs would hide.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from moolib_tpu.ops.xent import (  # noqa: E402
+    chunked_softmax_xent,
+    naive_softmax_xent,
+)
+
+
+def _oracle(h, w, b, labels):
+    logits = h.astype(np.float64) @ w.astype(np.float64)
+    if b is not None:
+        logits = logits + b.astype(np.float64)[None, :]
+    m = logits.max(axis=1, keepdims=True)
+    logp = logits - m - np.log(np.exp(logits - m).sum(axis=1, keepdims=True))
+    return -logp[np.arange(len(labels)), labels].mean()
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    st.integers(1, 12),            # N
+    st.integers(1, 9),             # D
+    st.integers(2, 40),            # V
+    st.integers(1, 48),            # chunk (clamped to V inside the op)
+    st.integers(0, 2**31),         # seed
+    st.floats(0.1, 30.0),          # logit scale (softmax shift stress)
+    st.booleans(),                 # bias present
+)
+def test_chunked_xent_matches_oracle(n, d, v, chunk, seed, scale, with_bias):
+    rng = np.random.default_rng(seed)
+    h = (rng.normal(size=(n, d)) * scale).astype(np.float32)
+    w = rng.normal(size=(d, v)).astype(np.float32)
+    b = rng.normal(size=(v,)).astype(np.float32) if with_bias else None
+    labels = rng.integers(0, v, size=(n,)).astype(np.int32)
+
+    got = float(chunked_softmax_xent(
+        jnp.asarray(h), jnp.asarray(w),
+        None if b is None else jnp.asarray(b),
+        jnp.asarray(labels), chunk_size=chunk,
+    ))
+    want = _oracle(h, w, b, labels)
+    assert got == pytest.approx(want, rel=2e-4, abs=2e-4)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    st.integers(1, 6),             # N
+    st.integers(1, 6),             # D
+    st.integers(2, 24),            # V
+    st.integers(1, 30),            # chunk
+    st.integers(0, 2**31),         # seed
+)
+def test_chunked_xent_grad_matches_naive(n, d, v, chunk, seed):
+    rng = np.random.default_rng(seed)
+    h = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(d, v)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(v,)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, v, size=(n,)).astype(np.int32))
+
+    g1 = jax.grad(
+        lambda h, w, b: chunked_softmax_xent(h, w, b, labels, chunk_size=chunk),
+        argnums=(0, 1, 2),
+    )(h, w, b)
+    g2 = jax.grad(
+        lambda h, w, b: naive_softmax_xent(h, w, b, labels), argnums=(0, 1, 2)
+    )(h, w, b)
+    for got, want, name in zip(g1, g2, ("dh", "dw", "db")):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-5,
+            err_msg=name,
+        )

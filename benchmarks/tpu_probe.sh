@@ -1,5 +1,8 @@
 #!/bin/bash
 # Shared tunnel-liveness probe: bench.py's child probe mode, one copy of
 # the logic for the watcher and the battery.  Usage: tpu_probe.sh [timeout].
-timeout "${1:-90}" env MOOLIB_BENCH_CHILD=probe \
+# -k 15: a probe wedged inside TPU backend init can sit out SIGTERM (seen
+# with impala_wide in the 07:10 window); a surviving orphan would hold the
+# single chip's connection and turn every later probe into a false "dead".
+timeout -k 15 "${1:-90}" env MOOLIB_BENCH_CHILD=probe \
   python -u /root/repo/bench.py 2>/dev/null | grep -q MOOLIB_BENCH_RESULT

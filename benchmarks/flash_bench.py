@@ -31,6 +31,40 @@ def _time_or_oom(thunk):
         return None
 
 
+# A dense path that *barely* fits spills to HBM and can take a minute per
+# call (observed: T=8192 fwd+bwd burned a 20-minute battery step in the
+# 14:04 window after fitting where the 06:27 window OOM'd).  Before running
+# the full marginal-timing chain, estimate one call from a 2-link chain;
+# past this budget, report the estimate (printed with a trailing ``~``)
+# instead of iterating on it.
+_DENSE_SINGLE_CALL_BUDGET_MS = 2000.0
+
+
+def _probed_marginal_ms(run, n1, n2):
+    """Budget-guarded ``marginal_time``: ms/iteration, or an early estimate.
+
+    ``run`` is a data-dependent chain runner as ``marginal_time`` expects.
+    The probe is ``run(2)`` (not an unchained single dispatch: per
+    timing.py, the tunnel can elide identical independent dispatches, so
+    only within-chain links are guaranteed real work).  Returns
+    ``(ms_per_iter, estimated?)``; ``(None, False)`` means the dense path
+    OOM'd outright.  A chain that OOMs where the probe fit keeps the probe
+    estimate rather than discarding a measurement already paid for.
+    """
+    if _time_or_oom(lambda: run(1)) is None:  # compile + warm
+        return None, False
+    probe = _time_or_oom(lambda: run(2))
+    if probe is None:
+        return None, False
+    probe_ms = probe / 2 * 1e3
+    if probe_ms > _DENSE_SINGLE_CALL_BUDGET_MS:
+        return probe_ms, True
+    full = _time_or_oom(lambda: marginal_time(run, n1, n2) * 1e3)
+    if full is None:
+        return probe_ms, True
+    return full, False
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -52,22 +86,24 @@ def main():
 
         sumf = jax.jit(lambda o: jnp.sum(o.astype(jnp.float32)))
 
-        def timeit(fn):
+        def make_run(fn):
             # See benchmarks/timing.py for why: data-dependent chain, scalar
             # fetch, marginal cost between two chain lengths.
             def run(iters):
                 return chain_elapsed(
                     lambda out: fn(out, k, v), q, iters, lambda out: float(sumf(out))
                 )
-            n1, n2 = (8, 40) if T <= 2048 else (4, 16)
-            return marginal_time(run, n1, n2) * 1e3
+            return run
 
-        d_ms = _time_or_oom(lambda: timeit(dense))
-        f_ms = timeit(flash)
+        n1, n2 = (8, 40) if T <= 2048 else (4, 16)
+        d_ms, d_est = _probed_marginal_ms(make_run(dense), n1, n2)
+        f_ms = marginal_time(make_run(flash), n1, n2) * 1e3
         if d_ms is None:
             print(f"{T:>6} {'OOM':>9} {f_ms:>9.3f} {'inf':>8}")
         else:
-            print(f"{T:>6} {d_ms:>9.3f} {f_ms:>9.3f} {d_ms / f_ms:>8.2f}x")
+            print(f"{T:>6} {d_ms:>8.3f}{'~' if d_est else ' '} {f_ms:>9.3f} {d_ms / f_ms:>8.2f}x")
+            if d_est:
+                print(f"# dense T={T}: 2-link-chain estimate (full chain skipped past {_DENSE_SINGLE_CALL_BUDGET_MS / 1e3:.0f}s/call budget)")
 
     # Training path: forward + backward.  flash rides the pallas dq and dk/dv
     # kernels (default); "oracle" is the blockwise-jax VJP it replaced
@@ -100,7 +136,7 @@ def main():
         finally:
             os.environ.pop("MOOLIB_TPU_FLASH_BWD", None)
 
-        def timeit_g(fn):
+        def make_run_g(fn):
             # Chain through dq (same shape as q) to keep steps data-dependent.
             def run(iters):
                 return chain_elapsed(
@@ -108,14 +144,19 @@ def main():
                     lambda dq: float(jnp.sum(dq.astype(jnp.float32))),
                 )
 
-            n1, n2 = (8, 40) if T <= 2048 else (2, 8)
-            return marginal_time(run, n1, n2) * 1e3
+            return run
 
-        d_ms = _time_or_oom(lambda: timeit_g(gdense))
-        f_ms = timeit_g(gflash)
-        o_ms = timeit_g(goracle)
-        d_str = f"{d_ms:>9.3f}" if d_ms is not None else f"{'OOM':>9}"
+        n1, n2 = (8, 40) if T <= 2048 else (2, 8)
+        d_ms, d_est = _probed_marginal_ms(make_run_g(gdense), n1, n2)
+        f_ms = marginal_time(make_run_g(gflash), n1, n2) * 1e3
+        o_ms = marginal_time(make_run_g(goracle), n1, n2) * 1e3
+        if d_ms is None:
+            d_str = f"{'OOM':>9}"
+        else:
+            d_str = f"{d_ms:>8.3f}{'~' if d_est else ' '}"
         print(f"{T:>6} {d_str} {f_ms:>9.3f} {o_ms:>10.3f}")
+        if d_ms is not None and d_est:
+            print(f"# dense T={T}: 2-link-chain estimate (full chain skipped past {_DENSE_SINGLE_CALL_BUDGET_MS / 1e3:.0f}s/call budget)")
 
 
 if __name__ == "__main__":

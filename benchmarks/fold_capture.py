@@ -177,7 +177,7 @@ def main():
     # logs; merge their rows (keyed by config) with the single-log name.
     lm_parts = {n: parse_lm(os.path.join(cap, n))
                 for n in ("lm_bench.log", "lm_quick.log", "lm_full.log",
-                          "lm_bf16.log")}
+                          "lm_bf16.log", "lm_dots.log")}
     lm_logs = [n for n, part in lm_parts.items() if part]
     if lm_logs:
         rows, meta = {}, None
@@ -189,9 +189,10 @@ def main():
         def key(r):
             # xent mode and chunk size joined the key in round 5: fused,
             # fused_bf16, naive, and different-chunk rows are distinct
-            # measurements and must not overwrite each other.
+            # measurements and must not overwrite each other; likewise the
+            # remat policy (what the per-block checkpoint saves).
             return (r["T"], r["B"], r["remat"], r["xent"],
-                    r.get("xent_chunk"))
+                    r.get("xent_chunk"), r.get("remat_policy", "full"))
 
         for r in data.get("lm_train", {}).get("rows", []):
             r = dict(r)

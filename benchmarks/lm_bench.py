@@ -66,9 +66,20 @@ def main():
         int(os.environ.get("MOOLIB_LM_XENT_CHUNK", 4096))
         if xent_mode.startswith("fused") else None
     )
+    # What the per-block checkpoint saves on remat rows; "dots" keeps matmul
+    # outputs so the MXU never re-runs in the backward (models/transformer.py).
+    from moolib_tpu.models.transformer import REMAT_POLICIES
+
+    remat_policy = os.environ.get("MOOLIB_LM_REMAT_POLICY", "full")
+    if remat_policy not in REMAT_POLICIES:
+        raise SystemExit(
+            f"MOOLIB_LM_REMAT_POLICY must be one of {'|'.join(REMAT_POLICIES)}, "
+            f"got {remat_policy!r}"
+        )
     print(f"# backend={jax.default_backend()} device={dev.device_kind} "
           f"d_model={D} layers={L} kv_heads={KV or H} xent={xent_mode}"
-          + (f" chunk={xent_chunk}" if xent_chunk else ""))
+          + (f" chunk={xent_chunk}" if xent_chunk else "")
+          + (f" remat_policy={remat_policy}" if remat_policy != "full" else ""))
     print(f"{'T':>6} {'B':>3} {'remat':>5} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
 
     rows = []
@@ -87,13 +98,16 @@ def main():
             (4096, 8, True), (8192, 2, False), (8192, 4, True),
         ]
     for T, B, remat in configs:
+        # On remat=False rows the policy is a no-op: stamp them "full" so a
+        # policy-sweep run can't fold duplicate keys for identical configs.
+        row_policy = remat_policy if remat else "full"
         # MOOLIB_LM_ATTENTION=dense for CPU plumbing runs: pallas interpret
         # mode is orders of magnitude too slow to even smoke-test there.
         model = TransformerLM(
             vocab_size=32768, d_model=D, num_heads=H, num_kv_heads=KV,
             num_layers=L, max_len=8192,
             attention=os.environ.get("MOOLIB_LM_ATTENTION", "flash"),
-            dtype=jnp.bfloat16, remat=remat,
+            dtype=jnp.bfloat16, remat=remat, remat_policy=remat_policy,
         )
         rng = np.random.default_rng(T)
         toks = jnp.asarray(rng.integers(0, 32768, size=(B, T), dtype=np.int32))
@@ -155,8 +169,8 @@ def main():
                 raise  # only real OOMs become rows; compile errors must fail
             print(f"{T:>6} {B:>3} {str(remat):>5} {'OOM':>9}")
             rows.append(
-                {"T": T, "B": B, "remat": remat, "xent": xent_mode,
-                 "xent_chunk": xent_chunk, "oom": True}
+                {"T": T, "B": B, "remat": remat, "remat_policy": row_policy,
+                 "xent": xent_mode, "xent_chunk": xent_chunk, "oom": True}
             )
             continue
         tokens_s = B * T / sec
@@ -169,8 +183,8 @@ def main():
         print(f"{T:>6} {B:>3} {str(remat):>5} {sec * 1e3:>9.2f} "
               f"{tokens_s:>10.0f} {'n/a' if mfu is None else round(mfu, 3):>6}")
         rows.append(
-            {"T": T, "B": B, "remat": remat, "xent": xent_mode,
-             "xent_chunk": xent_chunk,
+            {"T": T, "B": B, "remat": remat, "remat_policy": row_policy,
+             "xent": xent_mode, "xent_chunk": xent_chunk,
              "step_ms": round(sec * 1e3, 2),
              "tokens_per_s": round(tokens_s, 1),
              "mfu_6nd": None if mfu is None else round(mfu, 4)}

@@ -16,7 +16,7 @@ export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
 
-STEPS="dv_triage flash_bwd_tests lm_quick lm_bf16 flash_tests flash_bench lm_full agent_bench r2d2_bench serve_bench impala_wide envpool_atari roofline_chip"
+STEPS="dv_triage flash_bwd_tests lm_quick lm_bf16 flash_tests flash_bench lm_full lm_dots agent_bench r2d2_bench serve_bench impala_wide envpool_atari roofline_chip"
 
 # Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
 # backend init can hold the single chip's connection into the next revival.
@@ -96,6 +96,13 @@ run flash_bench 1200 python -u benchmarks/flash_bench.py
 #    topped out at half these batches, and an OOM is recorded as a row,
 #    so the memory-win claim is falsifiable either way.
 run lm_full 2400 env MOOLIB_LM_CONFIGS="4096,4,0;4096,8,1;4096,16,1;8192,2,0;8192,4,1;8192,8,1" \
+  python -u benchmarks/lm_bench.py
+# 4b. Selective remat: "dots" saves every matmul output so the MXU never
+#     re-runs in the backward — the memory/FLOPs midpoint between
+#     full-remat (MFU 0.251 at 8192,4) and no-remat (OOM at that batch).
+#     Same configs as lm_full's remat rows; rows key on remat_policy.
+run lm_dots 1800 env MOOLIB_LM_REMAT_POLICY=dots \
+  MOOLIB_LM_CONFIGS="4096,8,1;4096,16,1;8192,4,1;8192,8,1" \
   python -u benchmarks/lm_bench.py
 # 5. Whole-agent SPS at the reference flagship scale.
 run agent_bench 1200 python -u benchmarks/agent_bench.py --scale reference

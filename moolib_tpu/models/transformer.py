@@ -53,6 +53,24 @@ def apply_rotary(x: jax.Array, base: float = 10000.0, offset=0) -> jax.Array:
     return out.astype(x.dtype)
 
 
+REMAT_POLICIES = ("full", "dots", "dots_no_batch")
+
+
+def _remat_policy(name: str):
+    """Resolve a TransformerLM.remat_policy name to a jax.checkpoint policy
+    (None = save nothing, jax.checkpoint's default)."""
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"remat_policy must be one of {'|'.join(REMAT_POLICIES)}, got {name!r}"
+        )
+    if name == "full":
+        return None
+    return {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }[name]
+
+
 class Block(nn.Module):
     d_model: int
     num_heads: int
@@ -196,6 +214,17 @@ class TransformerLM(nn.Module):
     decode: bool = False  # single-token KV-cache steps (see generate())
     collect_kv: bool = False  # sow per-block K/V (generate()'s prefill)
     remat: bool = False  # checkpoint each block: O(L) -> O(1) activations
+    # What the per-block checkpoint SAVES (only meaningful with remat=True):
+    #   "full"          — save nothing: every op recomputed in the backward
+    #                     (max memory saving, ~1/3 extra FLOPs)
+    #   "dots"          — save every dot/matmul output, recompute only the
+    #                     cheap elementwise/norm work: the MXU never re-runs,
+    #                     at higher memory than "full" (pallas flash calls
+    #                     are not dots, so attention is still recomputed —
+    #                     its own kernel already keeps residuals O(T))
+    #   "dots_no_batch" — like "dots" but only matmuls with no batch dims
+    #                     (weight@activation, not activation@activation)
+    remat_policy: str = "full"
 
     @nn.compact
     def __call__(
@@ -207,6 +236,9 @@ class TransformerLM(nn.Module):
         materializes params on the default path, and ``apply`` ignores the
         unused head when features are requested)."""
         B, T = tokens.shape
+        # Validate even when remat/decode makes the policy a no-op: bench
+        # rows are keyed by this string, so a typo must never run silently.
+        _remat_policy(self.remat_policy)
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(
             tokens
         )
@@ -230,11 +262,12 @@ class TransformerLM(nn.Module):
         # recomputed during the backward instead of stored.  Bigger batches
         # then fit at long T, which is how lm_bench pushes MFU.  mesh is a
         # static argument (index 2 counting self), not a traced operand.
-        block_cls = (
-            nn.remat(Block, static_argnums=(2,))
-            if self.remat and not self.decode
-            else Block
-        )
+        if self.remat and not self.decode:
+            block_cls = nn.remat(
+                Block, static_argnums=(2,), policy=_remat_policy(self.remat_policy)
+            )
+        else:
+            block_cls = Block
         for i in range(self.num_layers):
             use_moe = self.moe_num_experts and i % self.moe_every == self.moe_every - 1
             x = block_cls(

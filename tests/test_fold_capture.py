@@ -111,6 +111,34 @@ def test_lm_refold_keeps_baseline_rows_absent_from_logs(tmp_path):
     }
 
 
+def test_lm_remat_policy_rows_key_apart(tmp_path):
+    # lm_dots measures the same (T, B, remat) configs as lm_full under a
+    # different checkpoint policy; the rows must coexist, and rows folded
+    # before the field existed must key as the "full" policy.
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    out.write_text(json.dumps({"lm_train": {
+        "platform": "tpu", "device_kind": "TPU v5 lite", "rows": [
+            {"T": 8192, "B": 4, "remat": True, "xent": "fused",
+             "tokens_per_s": 44.0}]}}))  # pre-field row == full policy
+    (cap / "lm_dots.log").write_text(lm_line([
+        {"T": 8192, "B": 4, "remat": True, "xent": "fused",
+         "remat_policy": "dots", "tokens_per_s": 60.0}]) + "\n")
+    run_fold(cap, out)
+    rows = json.loads(out.read_text())["lm_train"]["rows"]
+    by_key = {r.get("remat_policy", "full"): r["tokens_per_s"] for r in rows}
+    assert by_key == {"full": 44.0, "dots": 60.0}
+    # A full-policy re-measurement still overrides the pre-field row.
+    (cap / "lm_full.log").write_text(lm_line([
+        {"T": 8192, "B": 4, "remat": True, "xent": "fused",
+         "remat_policy": "full", "tokens_per_s": 45.0}]) + "\n")
+    run_fold(cap, out)
+    rows = json.loads(out.read_text())["lm_train"]["rows"]
+    by_key = {r.get("remat_policy", "full"): r["tokens_per_s"] for r in rows}
+    assert by_key == {"full": 45.0, "dots": 60.0}
+
+
 def test_captured_when_is_log_mtime_not_fold_time(tmp_path):
     cap = tmp_path / "cap"
     cap.mkdir()

@@ -287,6 +287,48 @@ def test_remat_matches_no_remat_gradients():
         )
 
 
+def test_remat_policies_match_no_remat_gradients():
+    """Selective policies ("dots" saves matmul outputs so the MXU never
+    re-runs; "dots_no_batch" saves only weight@activation dots) change what
+    the backward recomputes, never what it computes: loss and gradients must
+    match the stored-activation path.  An unknown policy must fail loudly —
+    bench rows are keyed by the policy string."""
+    import pytest
+
+    tokens = jax.random.randint(jax.random.key(0), (2, 128), 0, 64)
+    base = _model("flash")
+    params = base.init(jax.random.key(1), tokens)
+
+    def loss(m):
+        def f(p):
+            logits = m.apply(p, tokens)
+            logp = jax.nn.log_softmax(logits[:, :-1], -1)
+            return -jnp.take_along_axis(logp, tokens[:, 1:, None], -1).mean()
+        return f
+
+    l0, g0 = jax.value_and_grad(loss(base))(params)
+    for policy in ("dots", "dots_no_batch"):
+        m = TransformerLM(
+            vocab_size=64, d_model=64, num_heads=2, num_layers=2,
+            attention="flash", dtype=jnp.float32, remat=True,
+            remat_policy=policy,
+        )
+        l1, g1 = jax.value_and_grad(loss(m))(params)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5
+            )
+    bad = TransformerLM(
+        vocab_size=64, d_model=64, num_heads=2, num_layers=2,
+        attention="flash", dtype=jnp.float32, remat=True, remat_policy="nope",
+    )
+    with pytest.raises(ValueError, match="remat_policy"):
+        bad.apply(params, tokens)
+
+
 def test_remat_with_ring_attention_mesh_is_static():
     """remat passes the mesh as a static argument (a Mesh is not a pytree of
     arrays); the ring+remat combination must trace and match dense."""

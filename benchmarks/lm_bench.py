@@ -80,7 +80,7 @@ def main():
           f"d_model={D} layers={L} kv_heads={KV or H} xent={xent_mode}"
           + (f" chunk={xent_chunk}" if xent_chunk else "")
           + (f" remat_policy={remat_policy}" if remat_policy != "full" else ""))
-    print(f"{'T':>6} {'B':>3} {'remat':>5} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6}")
+    print(f"{'T':>6} {'B':>3} {'remat':>5} {'step_ms':>9} {'tokens_s':>10} {'mfu':>6} {'mfu_att':>7}")
 
     rows = []
     # (T, B, remat): constant 16k-token steps, plus remat rows at long T
@@ -177,17 +177,29 @@ def main():
         # Standard 6*N*D transformer FLOPs (fwd+bwd) + attention term
         # 12*L*H*hd*T^2... keep the 6ND convention and report it as such.
         flops = 6.0 * n_params * B * T
+        # The 6ND convention omits attention's O(T²) score matmuls — real
+        # model FLOPs that reach ~46% of 6ND at T=8192/d=1024 here, so the
+        # apparent long-T "MFU drop" is partly accounting.  Causal fwd
+        # QK^T+PV ≈ 2·B·T²·d_model FLOPs per layer (half the full 4·B·T²·d),
+        # backward 2× that: 6·L·B·T²·d_model total.  GQA shrinks K/V
+        # projections (already in 6ND via n_params), not these.  Remat
+        # recompute stays excluded from both fields: hardware work, not
+        # useful model FLOPs.
+        attn_flops = 6.0 * L * B * T * T * D
         # None (json null) when no peak is known (CPU plumbing runs): NaN
         # would make the JSON line unparseable for strict consumers.
         mfu = flops / sec / peak if peak else None
+        mfu_attn = (flops + attn_flops) / sec / peak if peak else None
         print(f"{T:>6} {B:>3} {str(remat):>5} {sec * 1e3:>9.2f} "
-              f"{tokens_s:>10.0f} {'n/a' if mfu is None else round(mfu, 3):>6}")
+              f"{tokens_s:>10.0f} {'n/a' if mfu is None else round(mfu, 3):>6} "
+              f"{'n/a' if mfu_attn is None else round(mfu_attn, 3):>7}")
         rows.append(
             {"T": T, "B": B, "remat": remat, "remat_policy": row_policy,
              "xent": xent_mode, "xent_chunk": xent_chunk,
              "step_ms": round(sec * 1e3, 2),
              "tokens_per_s": round(tokens_s, 1),
-             "mfu_6nd": None if mfu is None else round(mfu, 4)}
+             "mfu_6nd": None if mfu is None else round(mfu, 4),
+             "mfu_attn": None if mfu_attn is None else round(mfu_attn, 4)}
         )
     print(json.dumps({"lm_train": {
         "platform": dev.platform, "device_kind": dev.device_kind,

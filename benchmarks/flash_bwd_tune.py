@@ -1,0 +1,116 @@
+"""Sweep the flash backward kernels' block sizes on real hardware.
+
+The forward blocks were swept on chip in round 3 (512x1024 beat 128x128
+by 4.3x at T=4096); the backward caps (MOOLIB_TPU_FLASH_BWD_BLOCK_Q/K,
+default 512x512) were sized by VMEM arithmetic and have never been swept.
+The env vars are read at TRACE time, so each config runs in a fresh child
+process (this script re-execs itself with --child).
+
+Prints one ms row per config and a final JSON line
+{"flash_bwd_tune": {...}} for fold_capture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+CONFIGS = [(256, 256), (512, 256), (256, 512), (512, 512),
+           (512, 1024), (1024, 512)]
+T = int(os.environ.get("MOOLIB_FLASH_TUNE_T", 4096))
+
+
+def child():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from timing import chain_elapsed, marginal_time
+
+    from moolib_tpu.ops.flash_attention import flash_attention
+
+    B, H, D = 4, 8, 64
+    rng = np.random.default_rng(T)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(B, T, H, D)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    g = jax.jit(
+        jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=True).astype(jnp.float32)
+            ),
+            argnums=(0, 1, 2),
+        )
+    )
+
+    def run(iters):
+        return chain_elapsed(
+            lambda qq: g(qq, k, v)[0], q, iters,
+            lambda dq: float(jnp.sum(dq.astype(jnp.float32))),
+        )
+
+    print(json.dumps({"ms": marginal_time(run, 2, 8) * 1e3}))
+
+
+def main():
+    import jax
+
+    if jax.default_backend() == "cpu":
+        raise SystemExit("flash_bwd_tune needs an accelerator backend")
+    dev = jax.devices()[0]
+    print(f"# backend={jax.default_backend()} device={dev.device_kind} "
+          f"T={T} fwd+bwd flash-only")
+    print(f"{'bq':>6} {'bk':>6} {'ms':>9}")
+    rows = []
+    for bq, bk in CONFIGS:
+        env = dict(os.environ,
+                   MOOLIB_TPU_FLASH_BWD_BLOCK_Q=str(bq),
+                   MOOLIB_TPU_FLASH_BWD_BLOCK_K=str(bk))
+        # A config can legitimately blow VMEM (Mosaic reject) or wedge in a
+        # dying tunnel — record it rather than abort the sweep, so already-
+        # measured configs always reach the final JSON line.  300 s per
+        # child keeps 6 configs inside the battery step's 2400 s budget.
+        try:
+            r = subprocess.run(
+                [sys.executable, "-u", os.path.abspath(__file__), "--child"],
+                env=env, capture_output=True, text=True, timeout=300,
+            )
+            rc, out_txt, err_txt = r.returncode, r.stdout, r.stderr
+        except subprocess.TimeoutExpired as e:
+            rc = -1
+            out_txt = (e.stdout or b"").decode(errors="replace") if isinstance(
+                e.stdout, bytes) else (e.stdout or "")
+            err_txt = "child timed out after 300s"
+        ms = None
+        for line in reversed(out_txt.splitlines()):
+            if line.startswith("{"):
+                ms = json.loads(line).get("ms")
+                break
+        if rc != 0 or ms is None:
+            tail = (err_txt or out_txt).strip().splitlines()[-1:] or ["?"]
+            print(f"{bq:>6} {bk:>6} {'error':>9}  # {tail[0][:100]}")
+            rows.append({"block_q": bq, "block_k": bk, "error": tail[0][:200]})
+            continue
+        print(f"{bq:>6} {bk:>6} {ms:>9.3f}")
+        rows.append({"block_q": bq, "block_k": bk, "ms": round(ms, 3)})
+    ok = [r for r in rows if "ms" in r]
+    best = min(ok, key=lambda r: r["ms"]) if ok else None
+    print(json.dumps({"flash_bwd_tune": {
+        "platform": dev.platform, "device_kind": dev.device_kind, "T": T,
+        "geometry": {"B": 4, "H": 8, "D": 64}, "rows": rows, "best": best,
+    }}))
+    if not ok:
+        # Zero measurements (e.g. the tunnel died after parent init) must
+        # NOT mark the battery step done — exit nonzero so it retries.
+        raise SystemExit(4)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -219,6 +219,23 @@ def main():
         fa["bench_tables"] = flash
         fa["bench_tables_captured_when"] = stamp("flash_bench.log")
         updated.append("flash_attention.bench_tables")
+    # The XL-geometry LM rows fold into their OWN section: lm_train's rows
+    # all share one (d_model, layers) meta and the merge key is only
+    # (T, B, ...), so mixing geometries there would mislabel rows.
+    xl = parse_lm(os.path.join(cap, "lm_xl.log"))
+    if xl:
+        data["lm_train_xl"] = dict(xl, captured_when=stamp("lm_xl.log"))
+        updated.append("lm_train_xl")
+    tune = _parse_json_line(
+        os.path.join(cap, "flash_bwd_tune.log"), "flash_bwd_tune",
+        cpu_gate=False,  # platform field is nested; gated below
+    )
+    tune = (tune or {}).get("flash_bwd_tune")
+    if tune and tune.get("platform") != "cpu":
+        data["flash_bwd_tune"] = dict(
+            tune, captured_when=stamp("flash_bwd_tune.log")
+        )
+        updated.append("flash_bwd_tune")
     # roofline_chip.log is the short-window battery's name for the same
     # run; the fresher of the two wins and the section folds once.
     for roof_log in ("roofline_chip.log", "impala_roofline.log"):

@@ -178,8 +178,9 @@ def main():
         # 12*L*H*hd*T^2... keep the 6ND convention and report it as such.
         flops = 6.0 * n_params * B * T
         # The 6ND convention omits attention's O(T²) score matmuls — real
-        # model FLOPs that reach ~46% of 6ND at T=8192/d=1024 here, so the
-        # apparent long-T "MFU drop" is partly accounting.  Causal fwd
+        # model FLOPs that reach L·T·d/N = 54.5% of 6ND at T=8192/d=1024
+        # (N = the matmul-only ~185M computed above, not the ~220M total),
+        # so the apparent long-T "MFU drop" is partly accounting.  Causal fwd
         # QK^T+PV ≈ 2·B·T²·d_model FLOPs per layer (half the full 4·B·T²·d),
         # backward 2× that: 6·L·B·T²·d_model total.  GQA shrinks K/V
         # projections (already in 6ND via n_params), not these.  Remat

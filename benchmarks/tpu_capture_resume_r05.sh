@@ -16,12 +16,12 @@ export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
 
-STEPS="dv_triage flash_bwd_tests lm_quick lm_bf16 flash_tests flash_bench lm_full lm_dots agent_bench r2d2_bench serve_bench impala_wide envpool_atari roofline_chip"
+STEPS="dv_triage flash_bwd_tests lm_quick lm_bf16 flash_tests flash_bench lm_full lm_dots lm_xl agent_bench r2d2_bench serve_bench impala_wide envpool_atari roofline_chip flash_bwd_tune"
 
 # Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
 # backend init can hold the single chip's connection into the next revival.
 pkill -f "MOOLIB_BENCH_CHILD=tpu" 2>/dev/null
-pkill -f "benchmarks/(lm_bench|flash_bench|agent_bench|serve_bench|envpool_bench|impala_roofline|debug_flash_dv|r2d2_bench)" 2>/dev/null
+pkill -f "benchmarks/(lm_bench|flash_bench|agent_bench|serve_bench|envpool_bench|impala_roofline|debug_flash_dv|r2d2_bench|flash_bwd_tune)" 2>/dev/null
 pkill -f "pytest tests/test_flash_attention_tpu" 2>/dev/null
 sleep 2
 
@@ -104,6 +104,13 @@ run lm_full 2400 env MOOLIB_LM_CONFIGS="4096,4,0;4096,8,1;4096,16,1;8192,2,0;819
 run lm_dots 1800 env MOOLIB_LM_REMAT_POLICY=dots \
   MOOLIB_LM_CONFIGS="4096,8,1;4096,16,1;8192,4,1;8192,8,1" \
   python -u benchmarks/lm_bench.py
+# 4c. XL geometry (d=1536/L=16 GQA kv=4, ~450M matmul params): wider
+#     matmuls should hold MFU >= the d=1024 rows; folds into its own
+#     lm_train_xl section (different geometry must not mix into lm_train).
+run lm_xl 1500 env MOOLIB_LM_DMODEL=1536 MOOLIB_LM_LAYERS=16 \
+  MOOLIB_LM_KV_HEADS=4 MOOLIB_LM_REMAT_POLICY=dots \
+  MOOLIB_LM_CONFIGS="2048,8,0;4096,4,0;4096,8,1" \
+  python -u benchmarks/lm_bench.py
 # 5. Whole-agent SPS at the reference flagship scale.
 run agent_bench 1200 python -u benchmarks/agent_bench.py --scale reference
 # 5b. R2D2 learner update at the paper's Atari geometry — third model
@@ -127,6 +134,10 @@ run envpool_atari 600 python -u benchmarks/envpool_bench.py --env synthetic \
 # 8. Roofline on-chip pass (analytic part already captured; needs compile).
 run roofline_chip 1200 python -u benchmarks/impala_roofline.py \
   --trace_dir "$OUT/impala_trace"
+# 9. Backward kernel block sweep (fresh child process per config — the
+#    caps are read at trace time; 6 configs x 300 s child cap + parent
+#    init fits this budget).  Last: the defaults already win 2.9x.
+run flash_bwd_tune 2400 python -u benchmarks/flash_bwd_tune.py
 fold
 # Complete when every step is resolved: succeeded (.done) or given up
 # after 3 alive-tunnel failures (.try >= 3).  A step that failed fewer

@@ -139,6 +139,34 @@ def test_lm_remat_policy_rows_key_apart(tmp_path):
     assert by_key == {"full": 45.0, "dots": 60.0}
 
 
+def test_lm_xl_folds_to_own_section_and_tune_is_cpu_gated(tmp_path):
+    # XL-geometry rows must not merge into lm_train (different d_model/layers
+    # would mislabel rows under lm_train's single meta header).
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    out = tmp_path / "BENCH_TPU.json"
+    (cap / "lm_quick.log").write_text(lm_line([
+        {"T": 1024, "B": 16, "remat": False, "tokens_per_s": 100.0}]) + "\n")
+    (cap / "lm_xl.log").write_text(json.dumps({"lm_train": {
+        "platform": "tpu", "device_kind": "TPU v5 lite",
+        "d_model": 1536, "layers": 16, "kv_heads": 4, "rows": [
+            {"T": 4096, "B": 4, "remat": False, "tokens_per_s": 55.0}]}}) + "\n")
+    (cap / "flash_bwd_tune.log").write_text(json.dumps({"flash_bwd_tune": {
+        "platform": "cpu", "T": 4096, "rows": []}}) + "\n")
+    run_fold(cap, out)
+    data = json.loads(out.read_text())
+    assert data["lm_train_xl"]["d_model"] == 1536
+    assert [r["T"] for r in data["lm_train"]["rows"]] == [1024]  # no mixing
+    assert "flash_bwd_tune" not in data  # cpu run refused
+    (cap / "flash_bwd_tune.log").write_text(json.dumps({"flash_bwd_tune": {
+        "platform": "tpu", "device_kind": "TPU v5 lite", "T": 4096,
+        "rows": [{"block_q": 512, "block_k": 512, "ms": 5.6}],
+        "best": {"block_q": 512, "block_k": 512, "ms": 5.6}}}) + "\n")
+    run_fold(cap, out)
+    data = json.loads(out.read_text())
+    assert data["flash_bwd_tune"]["best"]["ms"] == 5.6
+
+
 def test_captured_when_is_log_mtime_not_fold_time(tmp_path):
     cap = tmp_path / "cap"
     cap.mkdir()

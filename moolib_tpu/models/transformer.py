@@ -470,6 +470,7 @@ def pipeline_lm_apply(
     data_axis: Optional[str] = None,
     circular_repeats: int = 1,
     remat: bool = False,
+    remat_policy: str = "full",
 ) -> jax.Array:
     """Apply ``model`` with its transformer blocks run through
     :func:`..parallel.pipeline.pipeline_apply` over the mesh's ``pp`` axis.
@@ -531,6 +532,7 @@ def pipeline_lm_apply(
         data_axis=data_axis,
         circular_repeats=circular_repeats,
         remat=remat,
+        remat_policy=_remat_policy(remat_policy),
     )
     x = out.reshape(B, T, model.d_model)
     x = nn.LayerNorm(dtype=jnp.float32).apply({"params": p["ln_f"]}, x)

@@ -43,6 +43,7 @@ def pipeline_apply(
     data_axis: str = None,
     circular_repeats: int = 1,
     remat: bool = False,
+    remat_policy=None,
 ):
     """Run ``y_m = stage_{L-1}(... stage_0(x_m))`` for every microbatch.
 
@@ -63,6 +64,9 @@ def pipeline_apply(
       data_axis: optional mesh axis for the batch dim of ``microbatches``.
       circular_repeats: virtual stages per device (``v``); 1 = GPipe.
       remat: rematerialize stage_fn in the backward pass (jax.checkpoint).
+      remat_policy: optional jax.checkpoint policy callable selecting what
+        the checkpoint saves (e.g. ``jax.checkpoint_policies.checkpoint_dots``);
+        None saves nothing.  Ignored unless ``remat=True``.
 
     Returns: [M, B, ...] outputs from the final virtual stage.
     """
@@ -78,7 +82,7 @@ def pipeline_apply(
         raise ValueError(
             f"stage_params leading axis is {L}, need circular_repeats*pp = {V * S}"
         )
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    fn = jax.checkpoint(stage_fn, policy=remat_policy) if remat else stage_fn
     n_ticks = V * M + S - 1
 
     # [L, ...] execution-order leaves -> [V, S, ...]: lap r of device d is

@@ -103,10 +103,10 @@ def test_pipeline_circular_differentiable_with_remat_and_dp():
     def stage_fn(w, x):
         return jnp.tanh(x @ w)
 
-    def piped_loss(ws, remat):
+    def piped_loss(ws, remat, policy=None):
         out = parallel.pipeline_apply(
             stage_fn, ws, xs, mesh, axis_name="pp", data_axis="dp",
-            circular_repeats=V, remat=remat,
+            circular_repeats=V, remat=remat, remat_policy=policy,
         )
         return jnp.mean((out - tgt) ** 2)
 
@@ -117,8 +117,11 @@ def test_pipeline_circular_differentiable_with_remat_and_dp():
         return jnp.mean((out - tgt) ** 2)
 
     g_seq = jax.grad(seq_loss)(ws)
-    for remat in (False, True):
-        g_pipe = jax.grad(lambda w: piped_loss(w, remat))(ws)
+    # remat_policy selects what the stage checkpoint saves; like remat
+    # itself it must never change gradients.
+    dots = jax.checkpoint_policies.checkpoint_dots
+    for remat, policy in ((False, None), (True, None), (True, dots)):
+        g_pipe = jax.grad(lambda w: piped_loss(w, remat, policy))(ws)
         np.testing.assert_allclose(
             np.asarray(g_pipe), np.asarray(g_seq), rtol=1e-4, atol=1e-5
         )

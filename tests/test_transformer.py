@@ -520,6 +520,16 @@ def test_gqa_through_pipeline_matches_direct_apply():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(direct), rtol=2e-4, atol=2e-4
     )
+    # remat + dots policy through the pipeline: same values.
+    out_r = jax.jit(
+        lambda p, t: pipeline_lm_apply(
+            model, p, t, mesh, num_microbatches=4, data_axis="dp",
+            remat=True, remat_policy="dots",
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_r), np.asarray(direct), rtol=2e-4, atol=2e-4
+    )
 
 
 def test_generate_sharded_composes_with_gqa():

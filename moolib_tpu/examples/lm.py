@@ -96,6 +96,14 @@ def make_flags(argv=None):
         "the backward): O(1)-in-depth activation memory, ~1/3 extra FLOPs — "
         "the lever for bigger batches at long --seq_len",
     )
+    p.add_argument(
+        "--remat_policy",
+        default="full",
+        choices=["full", "dots", "dots_no_batch"],
+        help="what the per-block checkpoint saves (with --remat): 'dots' "
+        "keeps matmul outputs so the MXU never re-runs in the backward — "
+        "less memory saving than 'full', most of the FLOPs back",
+    )
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--learning_rate", type=float, default=3e-3)
     p.add_argument("--log_interval", type=int, default=50)
@@ -190,6 +198,7 @@ def train(flags, on_stats=None) -> dict:
         moe_num_experts=flags.moe_experts,
         pos_embedding=flags.pos,
         remat=flags.remat,
+        remat_policy=flags.remat_policy,
         num_kv_heads=flags.kv_heads or None,
     )
     rng = np.random.default_rng(flags.seed)
@@ -214,6 +223,7 @@ def train(flags, on_stats=None) -> dict:
                 data_axis="dp" if axes.get("dp", 1) > 1 else None,
                 circular_repeats=flags.pp_repeats,
                 remat=flags.remat,  # the pipeline rebuilds blocks itself
+                remat_policy=flags.remat_policy,
             )
             aux = 0.0
         elif flags.moe_experts:

@@ -16,7 +16,7 @@ export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 
 probe() { bash /root/repo/benchmarks/tpu_probe.sh 90; }
 
-STEPS="dv_triage flash_bwd_tests lm_quick lm_bf16 flash_tests flash_bench lm_full lm_dots lm_xl agent_bench r2d2_bench serve_bench impala_wide envpool_atari roofline_chip flash_bwd_tune"
+STEPS="dv_triage flash_bwd_tests lm_quick lm_bf16 flash_tests flash_bench lm_full lm_dots lm_xl agent_bench r2d2_bench impala_wide envpool_atari serve_bench roofline_chip flash_bwd_tune"
 
 # Drain stale chip jobs: a prior battery's step wedged in a dead-tunnel
 # backend init can hold the single chip's connection into the next revival.
@@ -116,21 +116,23 @@ run agent_bench 1200 python -u benchmarks/agent_bench.py --scale reference
 # 5b. R2D2 learner update at the paper's Atari geometry — third model
 #     family on hardware (replay/recurrent-Q; absent from the reference).
 run r2d2_bench 900 python -u benchmarks/r2d2_bench.py
-# 6. Serving under load at d=512/L=8 with the batch-cap sweep.
+# 6. Wide-encoder IMPALA row (64/128/128): analytic ceiling 0.789, so if
+#    the lane-occupancy explanation of the 14% MFU is right, this row's
+#    measured MFU must rise roughly with the ceiling (5.3x the default's).
+#    Before serve_bench: the key falsifiability row must not queue behind
+#    a potentially 50-minute step when windows run ~35-45 min.
+#    (1200 s: the first wide attempt hit the 600 s cap mid-compile — the
+#    64/128/128 encoder compiles much slower than the reference shape.)
+run impala_wide 1200 env MOOLIB_BENCH_CHILD=tpu MOOLIB_BENCH_CHANNELS=64,128,128 \
+  python -u bench.py
+# 6b. EnvPool ingestion at Atari geometry (mostly host-side; cheap).
+run envpool_atari 600 python -u benchmarks/envpool_bench.py --env synthetic \
+  --batch_size 128 --num_processes 8 --steps 100
+# 7. Serving under load at d=512/L=8 with the batch-cap sweep.
 run serve_bench 3000 python -u benchmarks/serve_bench.py --seconds 20 \
   --clients 16 --d_model 512 --layers 8 --heads 8 --kv_heads 8 2 \
   --batch_sizes 16 4 32 --seq_len 128 --max_new_tokens 64 --vocab 32000 \
   --ready_timeout 420
-# 6b. Wide-encoder IMPALA row (64/128/128): analytic ceiling 0.789, so if
-#     the lane-occupancy explanation of the 14% MFU is right, this row's
-#     measured MFU must rise roughly with the ceiling (5.3x the default's).
-#     (1200 s: the first wide attempt hit the 600 s cap mid-compile — the
-#     64/128/128 encoder compiles much slower than the reference shape.)
-run impala_wide 1200 env MOOLIB_BENCH_CHILD=tpu MOOLIB_BENCH_CHANNELS=64,128,128 \
-  python -u bench.py
-# 7. EnvPool ingestion at Atari geometry (mostly host-side; cheap).
-run envpool_atari 600 python -u benchmarks/envpool_bench.py --env synthetic \
-  --batch_size 128 --num_processes 8 --steps 100
 # 8. Roofline on-chip pass (analytic part already captured; needs compile).
 run roofline_chip 1200 python -u benchmarks/impala_roofline.py \
   --trace_dir "$OUT/impala_trace"

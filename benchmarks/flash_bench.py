@@ -34,7 +34,8 @@ def _time_or_oom(thunk):
 # A dense path that *barely* fits spills to HBM and can take a minute per
 # call (observed: T=8192 fwd+bwd burned a 20-minute battery step in the
 # 14:04 window after fitting where the 06:27 window OOM'd).  Before running
-# the full marginal-timing chain, estimate one call from a 2-link chain;
+# the full marginal-timing chain, estimate one call from the run(3)-run(2)
+# one-link marginal (tunnel overhead cancels);
 # past this budget, report the estimate (printed with a trailing ``~``)
 # instead of iterating on it.
 _DENSE_SINGLE_CALL_BUDGET_MS = 2000.0
@@ -44,19 +45,26 @@ def _probed_marginal_ms(run, n1, n2):
     """Budget-guarded ``marginal_time``: ms/iteration, or an early estimate.
 
     ``run`` is a data-dependent chain runner as ``marginal_time`` expects.
-    The probe is ``run(2)`` (not an unchained single dispatch: per
-    timing.py, the tunnel can elide identical independent dispatches, so
-    only within-chain links are guaranteed real work).  Returns
-    ``(ms_per_iter, estimated?)``; ``(None, False)`` means the dense path
-    OOM'd outright.  A chain that OOMs where the probe fit keeps the probe
-    estimate rather than discarding a measurement already paid for.
+    The probe estimate is the one-link marginal ``run(3) - run(2)`` — the
+    same subtraction ``marginal_time`` does, so the fixed tunnel
+    dispatch/fetch overhead (~65 ms) cancels instead of inflating the
+    dense-vs-flash speedup ratio the way a ``probe/2`` average would.
+    Chain lengths 1 (warm), 2, 3 are all distinct: per timing.py the
+    tunnel can elide a dispatch identical to an earlier one, so no timed
+    length may repeat the warm-up's.  Returns ``(ms_per_iter,
+    estimated?)``; ``(None, False)`` means the dense path OOM'd outright.
+    A chain that OOMs where the probe fit keeps the probe estimate rather
+    than discarding a measurement already paid for.
     """
     if _time_or_oom(lambda: run(1)) is None:  # compile + warm
         return None, False
-    probe = _time_or_oom(lambda: run(2))
-    if probe is None:
+    t1 = _time_or_oom(lambda: run(2))
+    if t1 is None:
         return None, False
-    probe_ms = probe / 2 * 1e3
+    t2 = _time_or_oom(lambda: run(3))
+    if t2 is None:
+        return None, False
+    probe_ms = max(t2 - t1, 1e-9) * 1e3
     if probe_ms > _DENSE_SINGLE_CALL_BUDGET_MS:
         return probe_ms, True
     full = _time_or_oom(lambda: marginal_time(run, n1, n2) * 1e3)
@@ -103,7 +111,7 @@ def main():
         else:
             print(f"{T:>6} {d_ms:>8.3f}{'~' if d_est else ' '} {f_ms:>9.3f} {d_ms / f_ms:>8.2f}x")
             if d_est:
-                print(f"# dense T={T}: 2-link-chain estimate (full chain skipped past {_DENSE_SINGLE_CALL_BUDGET_MS / 1e3:.0f}s/call budget)")
+                print(f"# dense T={T}: one-link-marginal estimate, run(3)-run(2) (full chain skipped past {_DENSE_SINGLE_CALL_BUDGET_MS / 1e3:.0f}s/call budget)")
 
     # Training path: forward + backward.  flash rides the pallas dq and dk/dv
     # kernels (default); "oracle" is the blockwise-jax VJP it replaced
@@ -156,7 +164,7 @@ def main():
             d_str = f"{d_ms:>8.3f}{'~' if d_est else ' '}"
         print(f"{T:>6} {d_str} {f_ms:>9.3f} {o_ms:>10.3f}")
         if d_ms is not None and d_est:
-            print(f"# dense T={T}: 2-link-chain estimate (full chain skipped past {_DENSE_SINGLE_CALL_BUDGET_MS / 1e3:.0f}s/call budget)")
+            print(f"# dense T={T}: one-link-marginal estimate, run(3)-run(2) (full chain skipped past {_DENSE_SINGLE_CALL_BUDGET_MS / 1e3:.0f}s/call budget)")
 
 
 if __name__ == "__main__":

@@ -88,7 +88,13 @@ def main():
         ms = None
         for line in reversed(out_txt.splitlines()):
             if line.startswith("{"):
-                ms = json.loads(line).get("ms")
+                # A child killed at the 300 s timeout can die mid-print; a
+                # truncated JSON line records a failure row (below) instead
+                # of aborting the whole sweep.
+                try:
+                    ms = json.loads(line).get("ms")
+                except ValueError:
+                    ms = None
                 break
         if rc != 0 or ms is None:
             tail = (err_txt or out_txt).strip().splitlines()[-1:] or ["?"]

@@ -60,6 +60,92 @@ def parse_flash(path):
     return lines if any(re.match(r"\s*\d+\s", l) for l in lines) else None
 
 
+def _split_flash_tables(lines):
+    """Group flash table lines into sections keyed by their header row.
+
+    A section starts at a ``T dense_ms ...`` header; comment lines
+    *leading into* a header (the backend banner, the ``# fwd+bwd`` title —
+    which flash_bench prints after the previous table's last data row) are
+    the next section's preamble, data rows key by T, and other comment
+    lines after a data row (the per-T estimate notes) ride with that row.
+    Classified by lookahead: a comment belongs to the next header if only
+    comments stand between it and that header."""
+
+    def leads_to_header(i):
+        while i < len(lines) and lines[i].startswith("#"):
+            i += 1
+        return i < len(lines) and re.match(r"\s*T\s", lines[i])
+
+    sections = []
+    pre = []
+    cur = None
+    last_t = None
+    for i, l in enumerate(lines):
+        if re.match(r"\s*T\s", l):
+            cur = {"pre": pre, "header": l, "rows": {}}
+            pre = []
+            last_t = None
+            sections.append(cur)
+            continue
+        m = re.match(r"\s*(\d+)\s", l)
+        if m and cur is not None:
+            last_t = int(m.group(1))
+            cur["rows"][last_t] = [l]
+        elif leads_to_header(i) or cur is None or last_t is None:
+            pre.append(l)
+        else:
+            cur["rows"][last_t].append(l)
+    return sections
+
+
+def _merge_flash_tables(old_lines, new_lines):
+    """Row-preservation merge, the same shape as the lm_train rows merge:
+    seed from the committed ``bench_tables``, overlay fresh rows keyed by
+    (section header, T).  A capture that wedged early (e.g. before the
+    fwd+bwd T=8192 row) keeps the committed measurement — the README's
+    headline numbers never silently lose provenance to a partial table."""
+    old = _split_flash_tables(old_lines or [])
+    new = _split_flash_tables(new_lines or [])
+    new_by_header = {s["header"].strip(): s for s in new}
+    merged = []
+    seen = set()
+    for osec in old:
+        key = osec["header"].strip()
+        nsec = new_by_header.get(key)
+        if nsec is None:
+            merged.append(osec)  # section absent from the fresh capture
+            continue
+        seen.add(key)
+        rows = dict(osec["rows"])
+        rows.update(nsec["rows"])  # fresh rows win per T
+        # Drop any stale carried-rows note inherited from a prior fold; the
+        # current merge re-derives it from what actually carried this time.
+        pre = [
+            l for l in (nsec["pre"] or osec["pre"])
+            if not l.startswith("# rows T in")
+        ]
+        carried = sorted(set(osec["rows"]) - set(nsec["rows"]))
+        if carried:
+            # The fresh banner (backend/device) and the section timestamp
+            # describe the new capture; rows it didn't re-measure keep
+            # older provenance — say so rather than silently mixing.
+            pre.append(
+                "# rows T in %s carried from an earlier capture (not re-measured)"
+                % carried
+            )
+        merged.append({"pre": pre, "header": nsec["header"], "rows": rows})
+    for nsec in new:
+        if nsec["header"].strip() not in seen:
+            merged.append(nsec)  # brand-new section (e.g. a new table)
+    out = []
+    for sec in merged:
+        out.extend(sec["pre"])
+        out.append(sec["header"])
+        for t in sorted(sec["rows"]):
+            out.extend(sec["rows"][t])
+    return out
+
+
 def _parse_json_line(path, marker, cpu_gate=True):
     """Last JSON line in ``path`` containing ``marker``; chip-gated unless
     ``cpu_gate=False`` (host-side rows are valid wherever the battery ran)."""
@@ -216,7 +302,9 @@ def main():
     flash = parse_flash(os.path.join(cap, "flash_bench.log"))
     if flash:
         fa = data.setdefault("flash_attention", {})
-        fa["bench_tables"] = flash
+        # Row-preservation merge (same idea as lm_train's): committed rows
+        # a wedged capture didn't re-measure survive, fresh rows win per T.
+        fa["bench_tables"] = _merge_flash_tables(fa.get("bench_tables"), flash)
         fa["bench_tables_captured_when"] = stamp("flash_bench.log")
         updated.append("flash_attention.bench_tables")
     # The XL-geometry LM rows fold into their OWN section: lm_train's rows

@@ -47,6 +47,11 @@ MODULES = [
     ("moolib_tpu.ops.flash_attention", "Ops: Flash attention (pallas)"),
     ("moolib_tpu.ops.returns", "Ops: returns / losses"),
     ("moolib_tpu.ops.xent", "Ops: chunked cross-entropy (LM head)"),
+    ("moolib_tpu.telemetry", "Telemetry (package)"),
+    ("moolib_tpu.telemetry.metrics", "Telemetry: metrics registry"),
+    ("moolib_tpu.telemetry.tracing", "Telemetry: span tracer"),
+    ("moolib_tpu.telemetry.exporters", "Telemetry: exporters"),
+    ("moolib_tpu.telemetry.cohort", "Telemetry: cohort aggregation"),
     ("moolib_tpu.utils", "Utilities"),
     ("moolib_tpu.utils.nest", "Utilities: nest"),
     ("moolib_tpu.utils.config", "Utilities: config"),

@@ -11,6 +11,7 @@ mesh sharding (dp/tp/sp/ep), ring-attention sequence parallelism, and
 flax/optax model + ops libraries (``models``, ``ops``).
 """
 
+from . import telemetry  # noqa: F401  (stdlib-only; rpc/core depends on it)
 from . import utils  # noqa: F401
 from .utils import create_uid, set_log_level, set_logging, set_max_threads  # noqa: F401
 from .rpc import Future, Queue, Rpc, RpcDeferredReturn, RpcError  # noqa: F401
@@ -36,6 +37,7 @@ __all__ = [
     "set_log_level",
     "set_logging",
     "set_max_threads",
+    "telemetry",
     "utils",
 ]
 

@@ -44,10 +44,47 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from . import utils
+from . import telemetry, utils
 from .utils import nest
 from .group import Group
 from .rpc import Rpc, RpcError
+
+# Reduction-machine metrics (docs/TELEMETRY.md).  Counters are process
+# totals across every Accumulator instance; per-instance gauges carry the
+# (accumulator, peer) labels so multi-peer single-process tests don't alias.
+_REG = telemetry.get_registry()
+_M_REDUCES = _REG.counter(
+    "accum_reduces_total", "completed gradient reductions", ("plane",)
+)
+_M_REDUCE_BYTES = _REG.counter(
+    "accum_reduce_bytes_total",
+    "gradient bytes contributed (post-compression, at send time)",
+    ("plane",),
+)
+_M_REDUCE_LATENCY = _REG.histogram(
+    "accum_reduce_seconds", "gradient reduction round trip", ("plane",)
+)
+_M_ROUND_ERRORS = _REG.counter(
+    "accum_round_errors_total", "reduction rounds that errored (churn, timeouts)"
+)
+_M_ELECTIONS = _REG.counter("accum_elections_total", "leader elections completed")
+_M_IS_LEADER = _REG.gauge(
+    "accum_is_leader", "1 while this peer leads its cohort", ("accumulator", "peer")
+)
+_M_VBATCH_FILL = _REG.gauge(
+    "accum_virtual_batch_fill",
+    "global batch count toward the virtual batch target (fraction)",
+    ("accumulator", "peer"),
+)
+_M_GRADIENTS = _REG.counter(
+    "accum_gradients_total", "gradient contributions in applied results"
+)
+_M_SKIPPED = _REG.counter(
+    "accum_skipped_total", "skip contributions in applied results"
+)
+_M_STALE = _REG.counter(
+    "accum_stale_results_total", "results consumed across an epoch boundary"
+)
 
 _MODEL_PUSH_INTERVAL = 600.0  # reference: regular model broadcast every 600 s
 _BUFFERS_PUSH_INTERVAL = 12.0  # reference: buffers broadcast every 12 s
@@ -697,7 +734,9 @@ class Accumulator:
                 )
                 round_ = _Round(fut, kind="full")
                 if gradients is not None:
-                    self._reduce_bytes["rpc"] += _tree_nbytes(gradients)
+                    nb = _tree_nbytes(gradients)
+                    self._reduce_bytes["rpc"] += nb
+                    _M_REDUCE_BYTES.inc(nb, plane="rpc")
                 self._inflight.append(round_)
                 fut.add_done_callback(lambda f, r=round_: self._on_ring_round_done(r, f))
                 return
@@ -717,7 +756,9 @@ class Accumulator:
                 )
                 round_ = _Round(fut, kind="full")
                 if gradients is not None:
-                    self._reduce_bytes["rpc"] += _tree_nbytes(gradients)
+                    nb = _tree_nbytes(gradients)
+                    self._reduce_bytes["rpc"] += nb
+                    _M_REDUCE_BYTES.inc(nb, plane="rpc")
             self._inflight.append(round_)
             fut.add_done_callback(lambda f, r=round_: self._on_round_done(r, f))
 
@@ -782,7 +823,9 @@ class Accumulator:
         with self._lock:
             # Counted at submit time, like the RPC plane — a round that later
             # fails the epoch check still crossed the wire.
-            self._reduce_bytes["ici"] += sum(a.nbytes for a in arrays)
+            nb = sum(a.nbytes for a in arrays)
+            self._reduce_bytes["ici"] += nb
+            _M_REDUCE_BYTES.inc(nb, plane="ici")
         executor.submit(self._ici_execute, round_, arrays, treedef, epoch_tag)
 
     def _ici_execute(self, round_: _Round, arrays, treedef, epoch_tag: int) -> None:
@@ -819,6 +862,8 @@ class Accumulator:
                 if round_.done:
                     return  # timed out by the pump while we were stuck
                 self._ici_reduces += 1
+                _M_REDUCES.inc(plane="ici")
+                _M_REDUCE_LATENCY.observe(time.monotonic() - round_.t0, plane="ici")
                 round_.done = True
                 round_.result = result
                 self._drain_rounds_locked()
@@ -993,7 +1038,9 @@ class Accumulator:
             )
             round_ = _Round(fut, kind="grad", stats=dict(self._fire_stats))
             if grads is not None:
-                self._reduce_bytes["rpc"] += _tree_nbytes(grads)
+                nb = _tree_nbytes(grads)
+                self._reduce_bytes["rpc"] += nb
+                _M_REDUCE_BYTES.inc(nb, plane="rpc")
             self._fire_accum = None
             self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
             self._inflight.append(round_)
@@ -1021,7 +1068,9 @@ class Accumulator:
         )
         round_ = _Round(fut, kind="grad", stats=dict(self._fire_stats))
         if grads is not None:
-            self._reduce_bytes["rpc"] += _tree_nbytes(grads)
+            nb = _tree_nbytes(grads)
+            self._reduce_bytes["rpc"] += nb
+            _M_REDUCE_BYTES.inc(nb, plane="rpc")
         self._fire_accum = None
         self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
         self._inflight.append(round_)
@@ -1033,6 +1082,10 @@ class Accumulator:
             round_.error = fut.exception()
             if round_.error is None:
                 round_.result = fut.result(0)
+                if round_.kind != "count":
+                    _M_REDUCE_LATENCY.observe(
+                        time.monotonic() - round_.t0, plane=round_.plane
+                    )
             self._drain_rounds_locked()
 
     def _on_ring_round_done(self, round_, fut):
@@ -1048,6 +1101,10 @@ class Accumulator:
             round_.done = True
             round_.error = err
             round_.result = norm
+            if err is None:
+                _M_REDUCE_LATENCY.observe(
+                    time.monotonic() - round_.t0, plane=round_.plane
+                )
             self._drain_rounds_locked()
 
     def _drain_rounds_locked(self):
@@ -1061,6 +1118,7 @@ class Accumulator:
                 # Errored rounds free their pipeline slot even while a result
                 # is pending consumption.
                 round_ = self._inflight.popleft()
+                _M_ROUND_ERRORS.inc()
                 utils.log_verbose(
                     "accumulator %s: reduction failed: %s", self._name, round_.error
                 )
@@ -1074,6 +1132,7 @@ class Accumulator:
                 # (count rounds are 3-int control traffic, not reductions).
                 if round_.plane == "rpc":
                     self._rpc_reduces += 1
+                    _M_REDUCES.inc(plane="rpc")
                 self._last_plane = round_.plane
             if round_.kind == "count":
                 # Phase 1 applied in issue order: fold this peer's local f32
@@ -1087,6 +1146,11 @@ class Accumulator:
                 for k in ("num_gradients", "num_skipped", "batch_size"):
                     self._fire_stats[k] += result[k]
                 target = self._virtual_batch_size or 1
+                _M_VBATCH_FILL.set(
+                    self._fire_stats["batch_size"] / target,
+                    accumulator=self._name,
+                    peer=self._rpc.get_name(),
+                )
                 if (
                     self._fire_stats["batch_size"] >= target
                     and self._fire_stats["num_gradients"] > 0
@@ -1107,6 +1171,8 @@ class Accumulator:
                     self._result_stats = dict(round_.stats)
                     self._result_epoch = self._group.sync_id()
                     self._has_gradients = True
+                    _M_GRADIENTS.inc(round_.stats["num_gradients"])
+                    _M_SKIPPED.inc(round_.stats["num_skipped"])
                     self._maybe_checksum_locked()
                 continue
             # kind == "full": single-phase — accumulate across rounds until
@@ -1137,6 +1203,8 @@ class Accumulator:
                     )
                 self._result_stats = dict(self._accum_stats)
                 self._result_epoch = self._group.sync_id()
+                _M_GRADIENTS.inc(self._accum_stats["num_gradients"])
+                _M_SKIPPED.inc(self._accum_stats["num_skipped"])
                 self._accum_grads = None
                 self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
                 self._has_gradients = True
@@ -1267,6 +1335,7 @@ class Accumulator:
             if self._result_epoch == self._group.sync_id():
                 self._model_version += 1
             else:
+                _M_STALE.inc()
                 utils.log_verbose(
                     "accumulator %s: consumed a result from a dead epoch; "
                     "model version not advanced",
@@ -1418,6 +1487,12 @@ class Accumulator:
         with self._lock:
             self._leader = leader
             self._is_leader = leader == self._rpc.get_name()
+            _M_ELECTIONS.inc()
+            _M_IS_LEADER.set(
+                1.0 if self._is_leader else 0.0,
+                accumulator=self._name,
+                peer=self._rpc.get_name(),
+            )
             if self._is_leader:
                 self._epoch_synced = True
                 self._last_model_push = time.monotonic()

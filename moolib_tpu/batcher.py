@@ -19,12 +19,25 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Any, List, Optional
 
 import jax
 import numpy as np
 
+from . import telemetry
 from .utils import nest
+
+# Batch-assembly metrics (docs/TELEMETRY.md): how full batches run and how
+# long completed batches sit ready before the consumer drains them (a
+# persistent ready-wait means the learner, not assembly, is the bottleneck).
+_REG = telemetry.get_registry()
+_M_BATCHES = _REG.counter("batcher_batches_total", "completed batches")
+_M_ITEMS = _REG.counter("batcher_items_total", "rows batched (batch-axis length)")
+_M_READY_DEPTH = _REG.gauge("batcher_ready_depth", "completed batches awaiting get()")
+_M_READY_WAIT = _REG.histogram(
+    "batcher_ready_wait_seconds", "batch completion to get()/await"
+)
 
 
 def _resolve_device(device):
@@ -106,11 +119,15 @@ class Batcher:
         # One device_put of the whole pytree: a single host->HBM hop per leaf.
         if self._device is not None:
             batch = jax.device_put(batch, self._device)
+        _M_BATCHES.inc()
+        _M_ITEMS.inc(self._size)
         if self._waiters:
             loop, af = self._waiters.popleft()
+            _M_READY_WAIT.observe(0.0)  # a consumer was already waiting
             loop.call_soon_threadsafe(_set_result, af, batch)
         else:
-            self._ready.append(batch)
+            self._ready.append((batch, time.monotonic()))
+            _M_READY_DEPTH.inc()
 
     # --------------------------------------------------------------- drain
     def empty(self) -> bool:
@@ -126,7 +143,13 @@ class Batcher:
         with self._lock:
             if not self._ready:
                 raise RuntimeError("Batcher.get() called with no complete batch")
-            return self._ready.popleft()
+            return self._pop_ready_locked()
+
+    def _pop_ready_locked(self):
+        batch, done_at = self._ready.popleft()
+        _M_READY_DEPTH.dec()
+        _M_READY_WAIT.observe(time.monotonic() - done_at)
+        return batch
 
     def __await__(self):
         import asyncio
@@ -135,7 +158,7 @@ class Batcher:
         af = loop.create_future()
         with self._lock:
             if self._ready:
-                af.set_result(self._ready.popleft())
+                af.set_result(self._pop_ready_locked())
             else:
                 self._waiters.append((loop, af))
         return af.__await__()

@@ -42,6 +42,20 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import telemetry
+
+# Pool metrics (docs/TELEMETRY.md), parent-process side only: workers report
+# through shm, and their own counters would land in a registry nobody scrapes.
+_REG = telemetry.get_registry()
+_M_ENV_STEPS = _REG.counter(
+    "envpool_steps_total", "environment steps completed (parent-observed)"
+)
+_M_ENV_BATCHES = _REG.counter("envpool_batches_total", "batch steps completed")
+_M_STEP_WAIT = _REG.histogram(
+    "envpool_step_wait_seconds", "result() wait for a batch step to complete"
+)
+_M_WORKERS = _REG.gauge("envpool_workers", "worker processes of live pools")
+
 
 def _jax_backend_initialized() -> bool:
     """True once any XLA backend client exists in this process — the point
@@ -372,7 +386,8 @@ class EnvStepperFuture:
         s = self._stepper
         import time as _time
 
-        deadline = _time.monotonic() + s._timeout
+        t0 = _time.monotonic()
+        deadline = t0 + s._timeout
         acquired = 0
         while acquired < s._num_workers:
             if s._done_sems[self._batch_index].acquire(timeout=0.5):
@@ -386,6 +401,9 @@ class EnvStepperFuture:
                     f"EnvPool step batch {self._batch_index} timed out "
                     f"({s._timeout}s); an env worker may have died"
                 )
+        _M_STEP_WAIT.observe(_time.monotonic() - t0)
+        _M_ENV_BATCHES.inc()
+        _M_ENV_STEPS.inc(s._pool._batch_size)
         self._done = True
         s._inflight[self._batch_index] = None
         return s._views[self._batch_index]
@@ -572,6 +590,7 @@ class EnvPool:
             self._procs.append(p)
             self._worker_conns.append(pconn)
         self._stepper = EnvStepper(self)
+        _M_WORKERS.inc(num_processes)
 
     def _check_workers(self) -> None:
         """Raise if a worker reported an env exception or died."""
@@ -607,6 +626,9 @@ class EnvPool:
         if self._closed:
             return
         self._closed = True
+        if getattr(self, "_stepper", None) is not None:
+            # The gauge only counted fully-built pools (_build's last line).
+            _M_WORKERS.dec(self._num_processes)
         for q in self._task_queues:
             try:
                 q.put(_SHUTDOWN)

@@ -24,10 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from .. import Accumulator, Broker, EnvPool
+from .. import Accumulator, Broker, EnvPool, telemetry
 from ..envs import CartPoleEnv
 from ..models import ActorCriticNet
 from ..ops import discounted_returns, entropy_loss, softmax_cross_entropy
+from ..utils.profiling import StepTimer
 from .common import finalize_flags
 
 
@@ -78,6 +79,8 @@ def train(flags, on_stats=None) -> dict:
     from ..utils import apply_platform_env
 
     apply_platform_env()
+    # Opt-in exporters (MOOLIB_TELEMETRY_* env knobs, docs/TELEMETRY.md).
+    telemetry.init_from_env()
     # EnvPool must fork before jax spins up device state (same constraint the
     # reference solves with its early fork server, src/env.cc:149-169).
     envs = EnvPool(
@@ -156,6 +159,9 @@ def train(flags, on_stats=None) -> dict:
     steps_collected = []
     last_log = time.time()
     start = time.time()
+    # Loop-phase breakdown: sections export as loop_section_seconds{section=}
+    # histograms + host spans (registry-backed StepTimer).
+    timer = StepTimer()
 
     try:
         while stats["steps"] < flags.total_steps:
@@ -182,7 +188,8 @@ def train(flags, on_stats=None) -> dict:
                         )
 
             # --- act -----------------------------------------------------
-            obs = envs.step(0, np.asarray(action)).result()
+            with timer.section("env_step"):
+                obs = envs.step(0, np.asarray(action)).result()
             reward = np.asarray(obs["reward"])
             done = np.asarray(obs["done"])
             episode_return += reward
@@ -200,7 +207,8 @@ def train(flags, on_stats=None) -> dict:
             }
             rng, act_rng = jax.random.split(rng)
             core_before = core_state  # LSTM state *entering* this step
-            new_action, new_core = act_step(params, inputs, core_state, act_rng)
+            with timer.section("act"):
+                new_action, new_core = act_step(params, inputs, core_state, act_rng)
             # result() returns zero-copy shm views valid only until the next
             # step on this batch index (same contract as the reference's
             # from_blob tensors) — copy anything we keep for the unroll.
@@ -227,24 +235,26 @@ def train(flags, on_stats=None) -> dict:
 
             # --- learn ---------------------------------------------------
             if accumulator.has_gradients():
-                grads = accumulator.gradients()
-                updates, opt_state = opt.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                accumulator.set_parameters(params)
-                accumulator.zero_gradients()
+                with timer.section("apply"):
+                    grads = accumulator.gradients()
+                    updates, opt_state = opt.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    accumulator.set_parameters(params)
+                    accumulator.zero_gradients()
                 stats["sgd_steps"] += 1
             elif len(steps_collected) >= T + 1 and accumulator.wants_gradients():
-                batch = {
-                    k: jnp.asarray(np.stack([s[k] for s in steps_collected]))
-                    for k in steps_collected[0]
-                    if k != "core"
-                }
-                (loss, aux), grads = grad_fn(
-                    params, batch=batch, initial_core_state=steps_collected[0]["core"]
-                )
-                stats["pg_loss"] = float(aux["pg_loss"])
-                stats["entropy_loss"] = float(aux["entropy_loss"])
-                accumulator.reduce_gradients(B, jax.device_get(grads))
+                with timer.section("learn"):
+                    batch = {
+                        k: jnp.asarray(np.stack([s[k] for s in steps_collected]))
+                        for k in steps_collected[0]
+                        if k != "core"
+                    }
+                    (loss, aux), grads = grad_fn(
+                        params, batch=batch, initial_core_state=steps_collected[0]["core"]
+                    )
+                    stats["pg_loss"] = float(aux["pg_loss"])
+                    stats["entropy_loss"] = float(aux["entropy_loss"])
+                    accumulator.reduce_gradients(B, jax.device_get(grads))
                 # Carry the last step into the next unroll (overlap of 1);
                 # it still records the LSTM state that entered it.
                 steps_collected = steps_collected[-1:]
@@ -259,7 +269,8 @@ def train(flags, on_stats=None) -> dict:
                         f"steps={stats['steps']} sps={sps:.0f} "
                         f"return={stats['mean_episode_return']:.1f} "
                         f"episodes={stats['episodes']} sgd={stats['sgd_steps']} "
-                        f"pg={stats['pg_loss']:.3f} ent={stats['entropy_loss']:.3f}",
+                        f"pg={stats['pg_loss']:.3f} ent={stats['entropy_loss']:.3f} "
+                        f"[{timer.report()}]",
                         flush=True,
                     )
                 if on_stats is not None:
@@ -269,6 +280,7 @@ def train(flags, on_stats=None) -> dict:
         accumulator.close()
         if broker is not None:
             broker.close()
+        telemetry.flush()  # final JSONL snapshot + host trace, if enabled
     if window_returns:
         stats["mean_episode_return"] = float(np.mean(window_returns[-100:]))
     stats["window_returns"] = window_returns

@@ -141,12 +141,19 @@ class GlobalStatsAccumulator:
 def _delta_add(a, b):
     if isinstance(a, tuple):
         return tuple(x + y for x, y in zip(a, b))
+    if isinstance(a, dict):
+        # Union of keys: telemetry CohortCounters deltas are {series: incr}
+        # maps whose keys appear over time (a new label set binds) and can
+        # differ across peers; a missing series means "started at zero".
+        return {k: a.get(k, 0.0) + b.get(k, 0.0) for k in set(a) | set(b)}
     return a + b
 
 
 def _delta_sub(a, b):
     if isinstance(a, tuple):
         return tuple(x - y for x, y in zip(a, b))
+    if isinstance(a, dict):
+        return {k: a.get(k, 0.0) - b.get(k, 0.0) for k in set(a) | set(b)}
     return a - b
 
 

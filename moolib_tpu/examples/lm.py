@@ -30,7 +30,8 @@ import numpy as np
 import optax
 
 from ..models.transformer import TransformerLM
-from .. import parallel
+from .. import parallel, telemetry
+from ..utils.profiling import StepTimer
 from . import common
 
 
@@ -136,6 +137,7 @@ def train(flags, on_stats=None) -> dict:
     from ..utils import apply_platform_env
 
     apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
+    telemetry.init_from_env()  # opt-in exporters (docs/TELEMETRY.md)
     if flags.seq_len % 2:
         raise ValueError("--seq_len must be even")
     if flags.address or flags.connect:
@@ -284,9 +286,12 @@ def train(flags, on_stats=None) -> dict:
     float(wl)
     start = time.time()
     loss = acc = None
+    timer = StepTimer()  # registry-backed section breakdown (docs/TELEMETRY.md)
     for i in range(flags.steps):
-        tokens = put(jnp.asarray(make_batch(rng, flags)))
-        params, opt_state, loss, acc = jstep(params, opt_state, tokens)
+        with timer.section("make_batch"):
+            tokens = put(jnp.asarray(make_batch(rng, flags)))
+        with timer.section("train_step"):
+            params, opt_state, loss, acc = jstep(params, opt_state, tokens)
         if (i + 1) % flags.log_interval == 0:
             loss_v, acc_v = float(loss), float(acc)
             if not flags.quiet:
@@ -295,6 +300,7 @@ def train(flags, on_stats=None) -> dict:
                 on_stats({"step": i + 1, "loss": loss_v, "acc": acc_v})
     loss_v, acc_v = float(loss), float(acc)  # force the chain before reading the clock
     elapsed = time.time() - start
+    telemetry.flush()  # final JSONL snapshot + host trace, if enabled
     return {
         "steps": flags.steps,
         "loss": loss_v,
@@ -344,6 +350,7 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
     steps_done = 0
     loss_v = acc_v = None
     start = time.time()
+    timer = StepTimer()  # registry-backed section breakdown
     try:
         while steps_done < flags.steps:
             if broker is not None:
@@ -364,10 +371,11 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                 time.sleep(0.02)
                 continue
             if acc.has_gradients():
-                grads = acc.gradients()
-                params, opt_state = japply(acc.parameters(), opt_state, grads)
-                acc.set_parameters(params)
-                acc.zero_gradients()
+                with timer.section("apply"):
+                    grads = acc.gradients()
+                    params, opt_state = japply(acc.parameters(), opt_state, grads)
+                    acc.set_parameters(params)
+                    acc.zero_gradients()
                 steps_done += 1
                 if steps_done % flags.log_interval == 0:
                     if not flags.quiet:
@@ -379,10 +387,11 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                     if on_stats is not None:
                         on_stats({"step": steps_done, "loss": loss_v, "acc": acc_v})
             elif acc.wants_gradients():
-                tokens = jnp.asarray(make_batch(rng, flags))
-                (loss, a), grads = jgrad(params, tokens)
-                loss_v, acc_v = float(loss), float(a)
-                acc.reduce_gradients(flags.batch_size, grads)
+                with timer.section("learn"):
+                    tokens = jnp.asarray(make_batch(rng, flags))
+                    (loss, a), grads = jgrad(params, tokens)
+                    loss_v, acc_v = float(loss), float(a)
+                    acc.reduce_gradients(flags.batch_size, grads)
             else:
                 time.sleep(0.002)
     finally:
@@ -390,6 +399,7 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
         acc.close()
         if broker is not None:
             broker.close()
+        telemetry.flush()  # final JSONL snapshot + host trace, if enabled
     elapsed = time.time() - start
     return {
         "steps": steps_done,

@@ -35,10 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ... import Accumulator, Batcher, Broker, EnvPool, Group, Rpc, utils
+from ... import Accumulator, Batcher, Broker, EnvPool, Group, Rpc, telemetry, utils
 from ...envs import CartPoleEnv, CatchEnv, SyntheticAtariEnv
 from ...models import ActorCriticNet, ImpalaNet
 from ...ops import entropy_loss, softmax_cross_entropy, vtrace
+from ...utils.profiling import StepTimer
 from .. import common
 
 
@@ -278,6 +279,11 @@ def train(flags, on_stats=None) -> dict:
     from ...utils import apply_platform_env
 
     apply_platform_env()
+    # Opt-in exporters (MOOLIB_TELEMETRY_* env knobs, docs/TELEMETRY.md):
+    # Prometheus /metrics endpoint, JSONL snapshots, SIGUSR1 dumps.
+    tele = telemetry.init_from_env()
+    if tele["http_port"]:
+        print(f"telemetry: http://127.0.0.1:{tele['http_port']}/metrics", flush=True)
     if flags.coordinator:
         # Multi-host: join the jax.distributed world before any device use.
         from ... import parallel as _parallel
@@ -430,7 +436,9 @@ def train(flags, on_stats=None) -> dict:
     if flags.chunked:
         accumulator.set_chunked_allreduce(True)
     if flags.trace_dir:
-        # Trace the first seconds of training (compile + early steps).
+        # Trace the first seconds of training (compile + early steps); host
+        # spans mirror into the device trace while it runs.
+        telemetry.get_tracer().enable_jax_annotations(True)
         jax.profiler.start_trace(flags.trace_dir)
         trace_stop_at = time.monotonic() + 30.0
     else:
@@ -448,7 +456,11 @@ def train(flags, on_stats=None) -> dict:
     }
     # Resume: continue the step count from the checkpoint.
     stats["steps_done"] += steps_done
+    # Registry counter deltas piggyback on the same periodic stats reduce:
+    # leader logs can show fleet-wide env/wire rates with no extra protocol.
+    stats["telemetry"] = telemetry.CohortCounters()
     global_stats = common.GlobalStatsAccumulator(rpc_group, stats)
+    timer = StepTimer()  # registry-backed loop-phase breakdown
 
     tsv = None
     if flags.localdir:
@@ -541,6 +553,9 @@ def train(flags, on_stats=None) -> dict:
             if trace_stop_at is not None and now > trace_stop_at:
                 trace_stop_at = None
                 jax.profiler.stop_trace()
+                # Stop paying per-span TraceAnnotation cost once no device
+                # trace is consuming the annotations.
+                telemetry.get_tracer().enable_jax_annotations(False)
                 print(f"profiler trace written to {flags.trace_dir}")
             if now - last_stats > flags.stats_interval:
                 last_stats = now
@@ -557,27 +572,30 @@ def train(flags, on_stats=None) -> dict:
                 )
 
             if accumulator.has_gradients():
-                grads = accumulator.gradients()
-                if opt_apply is not None:
-                    params, opt_state = opt_apply(params, opt_state, grads)
-                else:
-                    updates, opt_state = opt.update(grads, opt_state, params)
-                    params = optax.apply_updates(params, updates)
-                accumulator.set_parameters(params)
-                accumulator.zero_gradients()
+                with timer.section("apply"):
+                    grads = accumulator.gradients()
+                    if opt_apply is not None:
+                        params, opt_state = opt_apply(params, opt_state, grads)
+                    else:
+                        updates, opt_state = opt.update(grads, opt_state, params)
+                        params = optax.apply_updates(params, updates)
+                    accumulator.set_parameters(params)
+                    accumulator.zero_gradients()
                 stats["sgd_steps"] += 1
             elif not learn_batcher.empty() and accumulator.wants_gradients():
-                batch = learn_batcher.get()
-                initial_core = core_batcher.get() if core_batcher is not None else ()
-                (loss, aux), grads = grad_fn(params, batch, initial_core)
-                stats["loss"] += float(loss)
-                stats["pg_loss"] += float(aux["pg_loss"])
-                stats["entropy_loss"] += float(aux["entropy_loss"])
-                accumulator.reduce_gradients(flags.batch_size, jax.device_get(grads))
+                with timer.section("learn"):
+                    batch = learn_batcher.get()
+                    initial_core = core_batcher.get() if core_batcher is not None else ()
+                    (loss, aux), grads = grad_fn(params, batch, initial_core)
+                    stats["loss"] += float(loss)
+                    stats["pg_loss"] += float(aux["pg_loss"])
+                    stats["entropy_loss"] += float(aux["entropy_loss"])
+                    accumulator.reduce_gradients(flags.batch_size, jax.device_get(grads))
             else:
                 # --- act ------------------------------------------------
                 st = env_states[cur]
-                obs = st.future.result()
+                with timer.section("env_wait"):
+                    obs = st.future.result()
                 st.update(obs, stats)
                 inputs = {
                     "state": jnp.asarray(np.asarray(obs["state"], np.float32))[None],
@@ -587,7 +605,8 @@ def train(flags, on_stats=None) -> dict:
                 }
                 rng, act_rng = jax.random.split(rng)
                 core_before = st.core_state  # LSTM state entering this step
-                out, new_core = act_step(params, inputs, st.core_state, act_rng)
+                with timer.section("act"):
+                    out, new_core = act_step(params, inputs, st.core_state, act_rng)
                 action = out["action"][0]
                 # Queue the next env step immediately (overlaps with learning).
                 st.future = envs[cur].step(0, np.asarray(action))
@@ -622,17 +641,22 @@ def train(flags, on_stats=None) -> dict:
                 sps_samples.append((time.time(), stats["steps_done"].value))
                 ret = stats["mean_episode_return"].result()
                 if not flags.quiet:
+                    # Fleet-wide env step total: this peer's counter plus
+                    # every remote delta learned through the stats reduce.
+                    fleet_env = stats["telemetry"].value("envpool_steps_total")
                     print(
                         f"steps={int(stats['steps_done'].value)} sps={sps:.0f} "
                         f"return={ret if ret is None else round(ret, 2)} "
                         f"sgd={int(stats['sgd_steps'].value)} "
-                        f"loss={stats['loss'].result()}",
+                        f"loss={stats['loss'].result()} "
+                        f"fleet_env_steps={int(fleet_env)} [{timer.report()}]",
                         flush=True,
                     )
                 if on_stats is not None or tsv is not None or wandb_run is not None:
                     row = {
                         k: v.result() if hasattr(v, "result") else v
                         for k, v in stats.items()
+                        if not isinstance(v, telemetry.CohortCounters)
                     }
                     if on_stats is not None:
                         on_stats(row)
@@ -672,6 +696,7 @@ def train(flags, on_stats=None) -> dict:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+            telemetry.get_tracer().enable_jax_annotations(False)
         _signal.signal(_signal.SIGTERM, prev_sigterm)
         if flags.checkpoint and accumulator.is_leader():
             save_checkpoint(
@@ -689,6 +714,7 @@ def train(flags, on_stats=None) -> dict:
                 wandb_run.finish()
             except Exception:  # noqa: BLE001
                 pass
+        telemetry.flush()  # final JSONL snapshot + host trace, if enabled
 
     recent = stats["mean_episode_return"].result()
     final_steps = stats["steps_done"].value

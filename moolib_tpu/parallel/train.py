@@ -18,7 +18,28 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
 from .mesh import replicated
+
+# Host-side view of the jitted step: dispatch wall time (async — the device
+# may still be executing) and a step counter.  The device-side truth lives
+# in jax.profiler traces; this is the cheap always-on signal.
+_REG = telemetry.get_registry()
+_M_STEPS = _REG.counter("train_steps_total", "train-step invocations")
+_M_DISPATCH = _REG.histogram(
+    "train_step_dispatch_seconds",
+    "host time in the jitted train step call (dispatch, not device time)",
+)
+
+
+def _instrument_step(fn):
+    def timed_step(*args, **kwargs):
+        with _M_DISPATCH.time():
+            out = fn(*args, **kwargs)
+        _M_STEPS.inc()
+        return out
+
+    return timed_step
 
 
 def fsdp_spec(x, axis: str = "dp", min_size: int = 2**16) -> P:
@@ -115,7 +136,7 @@ def make_train_step(
         return params, opt_state, loss, aux
 
     if mesh is None:
-        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+        return _instrument_step(jax.jit(step, donate_argnums=(0, 1) if donate else ()))
 
     if params_sharding is None:
         params_sharding = "replicated"
@@ -161,4 +182,4 @@ def make_train_step(
             )
         return compiled["fn"](params, opt_state, batch, rng)
 
-    return sharded_step
+    return _instrument_step(sharded_step)

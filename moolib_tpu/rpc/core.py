@@ -46,9 +46,52 @@ import traceback
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from .. import utils
+from .. import telemetry, utils
 from ..utils import nest
 from . import serialization
+
+# Process-wide wire metrics (docs/TELEMETRY.md).  Per-Rpc views stay on the
+# connection objects (transport_stats/debug_info); the registry carries the
+# same counters labeled by transport for exporters and cohort aggregation.
+_REG = telemetry.get_registry()
+_M_TX_BYTES = _REG.counter(
+    "rpc_tx_bytes_total", "bytes sent on the wire (frame payloads)", ("transport",)
+)
+_M_RX_BYTES = _REG.counter(
+    "rpc_rx_bytes_total", "bytes received on the wire", ("transport",)
+)
+_M_TX_FRAMES = _REG.counter("rpc_tx_frames_total", "frames sent", ("transport",))
+_M_RX_FRAMES = _REG.counter("rpc_rx_frames_total", "frames received", ("transport",))
+_M_RTT = _REG.histogram(
+    "rpc_rtt_seconds", "request->response round trips (clean samples only)",
+    ("transport",),
+)
+_M_PEER_LATENCY = _REG.gauge(
+    "rpc_peer_latency_seconds",
+    "per-peer-connection latency EMA (the bandit's input)",
+    ("peer", "transport"),
+)
+_M_CALL_ERRORS = _REG.counter(
+    "rpc_call_errors_total", "calls completed with an error", ("kind",)
+)
+_M_NACKS = _REG.counter(
+    "rpc_nacks_recovered_total", "requests resent after a receiver NACK"
+)
+_M_CONNECTS = _REG.counter(
+    "rpc_connections_total", "connections registered", ("transport", "direction")
+)
+_M_QUEUE_DEPTH = _REG.gauge(
+    "rpc_queue_depth", "calls waiting in a define_queue", ("queue",)
+)
+_M_QUEUE_ITEMS = _REG.counter(
+    "rpc_queue_items_total", "calls serviced through a define_queue", ("queue",)
+)
+_M_QUEUE_TAKES = _REG.counter(
+    "rpc_queue_takes_total", "service takes (batches) from a define_queue", ("queue",)
+)
+_M_QUEUE_WAIT = _REG.histogram(
+    "rpc_queue_wait_seconds", "enqueue to service start", ("queue",)
+)
 
 # Protocol signature; a peer greeting with a different signature is rejected
 # (reference kSignature, src/rpc.cc:810). Bumped when wire behavior changes
@@ -313,6 +356,12 @@ class _Connection:
         "initiator_uid",
         "conn_seq",
         "_explicit_addr",
+        "_m_tx_bytes",
+        "_m_rx_bytes",
+        "_m_tx_frames",
+        "_m_rx_frames",
+        "_m_rtt",
+        "_m_peer_lat",
     )
 
     def __init__(self, transport: str, reader, writer, inbound: bool = False):
@@ -320,6 +369,16 @@ class _Connection:
         self.reader = reader
         self.writer = writer
         self.inbound = inbound
+        # Bind the registry children once (per-frame cost is one locked add).
+        self._m_tx_bytes = _M_TX_BYTES.labels(transport=transport)
+        self._m_rx_bytes = _M_RX_BYTES.labels(transport=transport)
+        self._m_tx_frames = _M_TX_FRAMES.labels(transport=transport)
+        self._m_rx_frames = _M_RX_FRAMES.labels(transport=transport)
+        self._m_rtt = _M_RTT.labels(transport=transport)
+        self._m_peer_lat = None  # bound on first RTT (peer name from greeting)
+        _M_CONNECTS.inc(
+            transport=transport, direction="inbound" if inbound else "outbound"
+        )
         self.peer_name: Optional[str] = None
         self.peer_uid: Optional[str] = None
         self.send_count = 0
@@ -368,6 +427,8 @@ class _Connection:
         self.writer.write(buf)
         self.send_count += 1
         self.bytes_out += total
+        self._m_tx_frames.inc()
+        self._m_tx_bytes.inc(total)
 
     def close(self) -> None:
         if not self.closed:
@@ -410,11 +471,15 @@ class _NativeConnection(_Connection):
                 if self.net.send_memfd(self.conn_id, chunks):
                     self.send_count += 1
                     self.bytes_out += total
+                    self._m_tx_frames.inc()
+                    self._m_tx_bytes.inc(total)
                     return
         if not self.net.send_iov(self.conn_id, chunks):
             raise RpcError("native send failed (engine destroyed or conn gone)")
         self.send_count += 1
         self.bytes_out += total
+        self._m_tx_frames.inc()
+        self._m_tx_bytes.inc(total)
 
     def close(self) -> None:
         if not self.closed:
@@ -499,6 +564,16 @@ class _Peer:
         bandit values of every live connection to this peer (the analogue of
         the reference's addLatency, ``src/rpc.cc:2448-2486``)."""
         conn.latency = rtt if conn.latency is None else conn.latency * 0.9 + rtt * 0.1
+        conn._m_rtt.observe(rtt)
+        if conn.peer_name:
+            # The EMA the bandit scores on, readable through the registry;
+            # debug_info stays a view.  Bound lazily: the peer name only
+            # exists after the greeting.
+            if conn._m_peer_lat is None:
+                conn._m_peer_lat = _M_PEER_LATENCY.labels(
+                    peer=conn.peer_name, transport=conn.transport
+                )
+            conn._m_peer_lat.set(conn.latency)
         measured = [
             c
             for c in self.connections.values()
@@ -590,7 +665,12 @@ class Queue:
     (reference ``QueueWrapper`` ``src/moolib.cc:426-576,1122-1178``).
     """
 
-    def __init__(self, batch_size: Optional[int] = None, dynamic_batching: bool = False):
+    def __init__(
+        self,
+        batch_size: Optional[int] = None,
+        dynamic_batching: bool = False,
+        name: str = "anon",
+    ):
         self._items: collections.deque = collections.deque()
         self._lock = threading.Lock()
         self._waiters: collections.deque = collections.deque()  # (loop, asyncio.Future)
@@ -598,17 +678,27 @@ class Queue:
         self._dynamic = dynamic_batching
         # Cumulative service-quality counters (serve_bench reads these to
         # make the batching crossover visible: how full batches run and how
-        # long calls sat queued before service).
+        # long calls sat queued before service).  The same numbers feed the
+        # process registry labeled by queue name — stats() stays the
+        # per-instance view, the registry the exported one.
         self._stats = {
             "items": 0, "takes": 0, "wait_s_sum": 0.0, "wait_s_max": 0.0,
             "depth_max": 0,
         }
+        self._m_depth = _M_QUEUE_DEPTH.labels(queue=name)
+        self._m_items = _M_QUEUE_ITEMS.labels(queue=name)
+        self._m_takes = _M_QUEUE_TAKES.labels(queue=name)
+        self._m_wait = _M_QUEUE_WAIT.labels(queue=name)
 
     # producer (rpc engine or user's enqueue) ------------------------------
     def enqueue(self, return_callback, args=None, kwargs=None) -> None:
         with self._lock:
             self._items.append((return_callback, args or (), kwargs or {}, time.monotonic()))
             self._stats["depth_max"] = max(self._stats["depth_max"], len(self._items))
+            # inc/dec (not set): instances sharing a queue name — two peers
+            # in one process defining the same fn — then SUM to a meaningful
+            # process-wide depth instead of last-writer-wins clobbering.
+            self._m_depth.inc()
             self._maybe_wake_locked()
 
     def _maybe_wake_locked(self) -> None:
@@ -623,10 +713,14 @@ class Queue:
         s = self._stats
         s["takes"] += 1
         s["items"] += len(calls)
+        self._m_takes.inc()
+        self._m_items.inc(len(calls))
+        self._m_depth.dec(len(calls))
         for c in calls:
             wait = now - c[3]
             s["wait_s_sum"] += wait
             s["wait_s_max"] = max(s["wait_s_max"], wait)
+            self._m_wait.observe(wait)
         return [c[:3] for c in calls]
 
     def _take_locked(self):
@@ -645,7 +739,9 @@ class Queue:
         """Cumulative queue service counters: ``items`` serviced, service
         ``takes`` (batches — average batch fill is items/takes), queue
         ``wait_s_sum``/``wait_s_max`` (enqueue to service start), and
-        high-water ``depth_max``."""
+        high-water ``depth_max``.  Thin per-instance view; the same numbers
+        export through the registry as ``rpc_queue_*{queue=<name>}``
+        (docs/TELEMETRY.md)."""
         with self._lock:
             return dict(self._stats)
 
@@ -927,7 +1023,7 @@ class Rpc:
     ) -> Queue:
         if name in self._functions:
             raise RpcError(f"function {name!r} already defined")
-        q = Queue(batch_size, dynamic_batching)
+        q = Queue(batch_size, dynamic_batching, name=name)
         fd = _FnDef(name, q, "queue", batch_size, dynamic_batching)
         self._functions[name] = fd
         return q
@@ -982,7 +1078,9 @@ class Rpc:
         """Aggregate wire counters across every live/dead-but-tracked
         connection: {"tx_bytes", "rx_bytes", "tx_frames", "rx_frames"}.
         The allreduce benchmark uses the per-peer spread of these to show
-        the chunked ring's even load (vs the tree root's 2x hotspot)."""
+        the chunked ring's even load (vs the tree root's 2x hotspot).
+        Thin per-Rpc view; the process-wide equivalents export through the
+        registry as ``rpc_{tx,rx}_{bytes,frames}_total{transport=...}``."""
         with self._state:
             tx = rx = txf = rxf = 0
             for c in self._conns:
@@ -1257,6 +1355,8 @@ class Rpc:
             conn.recv_count += 1
             conn.bytes_in += len(frame)
             conn.last_recv = time.monotonic()
+            conn._m_rx_frames.inc()
+            conn._m_rx_bytes.inc(len(frame))
         self._on_frame(conn, frame)
 
     def _net_on_close(self, conn_id: int):
@@ -1347,6 +1447,8 @@ class Rpc:
                 conn.recv_count += 1
                 conn.bytes_in += length
                 conn.last_recv = time.monotonic()
+                conn._m_rx_frames.inc()
+                conn._m_rx_bytes.inc(length)
                 self._on_frame(conn, frame)
         except (asyncio.IncompleteReadError, ConnectionError, OSError, asyncio.CancelledError):
             pass
@@ -1425,6 +1527,7 @@ class Rpc:
             out = self._outgoing.get(rid)
             if out is not None:
                 self._nacks_recovered += 1
+                _M_NACKS.inc()
                 out.resent = True
                 self._try_send(out)
 
@@ -1715,9 +1818,11 @@ class Rpc:
         try:
             value = serialization.deserialize(serialization.unpack(frame, 9))
         except Exception as e:  # noqa: BLE001
+            _M_CALL_ERRORS.inc(kind="deserialization")
             out.future.set_exception(RpcError(f"response deserialization error: {e}"))
             return
         if is_error:
+            _M_CALL_ERRORS.inc(kind="remote")
             out.future.set_exception(RpcError(str(value)))
         else:
             out.future.set_result(value)
@@ -1733,6 +1838,7 @@ class Rpc:
                     self._outgoing.pop(out.rid, None)
             # Complete outside the lock (done-callbacks take caller locks).
             for out in expired:
+                _M_CALL_ERRORS.inc(kind="timeout")
                 out.future.set_exception(
                     RpcError(f"Call ({out.peer_name}::{out.fn_name}) timed out")
                 )

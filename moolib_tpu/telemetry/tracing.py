@@ -1,0 +1,171 @@
+"""Lightweight host-side span tracer with Chrome trace-event export.
+
+``jax.profiler`` owns the *device* timeline (XLA execution, HBM, ICI); this
+tracer owns the *host* side: nested spans around the train loop's act/learn/
+reduce phases, RPC rounds, env waits.  Spans export as Chrome trace-event
+JSON (``chrome://tracing`` / Perfetto "Complete" events), so a host trace
+can sit next to a ``jax.profiler`` capture — and when a jax trace is active
+and annotations are enabled, each span also enters a
+``jax.profiler.TraceAnnotation`` so the same names appear inside the device
+timeline (the merge path :func:`moolib_tpu.utils.profiling.annotate`
+documents).
+
+Recording is bounded (a ring of the newest ``capacity`` spans) and cheap:
+one ``perf_counter_ns`` pair plus a deque append per span; nesting depth is
+tracked per-thread with no locks on the hot path.  Stdlib only unless
+annotations are switched on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "get_tracer", "span"]
+
+
+class Span:
+    """One closed span: name, start (ns since epoch-ish origin), duration."""
+
+    __slots__ = ("name", "start_ns", "dur_ns", "tid", "thread_name", "args")
+
+    def __init__(self, name, start_ns, dur_ns, tid, thread_name, args):
+        self.name = name
+        self.start_ns = start_ns
+        self.dur_ns = dur_ns
+        self.tid = tid
+        self.thread_name = thread_name
+        self.args = args
+
+
+class _ActiveSpan:
+    __slots__ = ("_tracer", "_name", "_args", "_t0", "_annotation")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Optional[dict]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+        self._annotation = None
+
+    def __enter__(self):
+        if self._tracer._annotate:
+            ann = _jax_annotation(self._name)
+            if ann is not None:
+                ann.__enter__()
+                self._annotation = ann
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter_ns() - self._t0
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
+        t = threading.current_thread()
+        self._tracer._spans.append(
+            Span(self._name, self._t0, dur, t.ident or 0, t.name, self._args)
+        )
+        return False
+
+
+def _jax_annotation(name: str):
+    """A jax TraceAnnotation when jax is already imported; never imports it
+    (the tracer must stay usable in env workers that never touch jax)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — annotation is best-effort decoration
+        return None
+
+
+class Tracer:
+    """Bounded span recorder.  ``get_tracer()`` returns the process default."""
+
+    def __init__(self, capacity: int = 65536):
+        self._spans: deque = deque(maxlen=capacity)
+        self._annotate = False
+
+    def span(self, name: str, **args) -> _ActiveSpan:
+        """Context manager recording one span; nest freely (the Chrome view
+        reconstructs nesting from same-thread containment)."""
+        return _ActiveSpan(self, name, args or None)
+
+    def enable_jax_annotations(self, enabled: bool = True) -> None:
+        """Mirror every span into ``jax.profiler.TraceAnnotation`` so host
+        phases appear inside device traces.  Off by default: creating an
+        annotation per span costs even when no device trace is running."""
+        self._annotate = bool(enabled)
+
+    def clear(self) -> None:
+        self._spans.clear()
+
+    def spans(self) -> List[Span]:
+        return list(self._spans)
+
+    # ------------------------------------------------------------- exporting
+    def chrome_trace(self) -> Dict[str, object]:
+        """Chrome trace-event JSON object: ``{"traceEvents": [...]}`` of
+        "X" (complete) events, timestamps in microseconds.  Loadable by
+        chrome://tracing and Perfetto, mergeable next to a jax device trace.
+        """
+        pid = os.getpid()
+        events: List[dict] = []
+        seen_tids = {}
+        for s in self.spans():
+            if s.tid not in seen_tids:
+                seen_tids[s.tid] = s.thread_name
+                events.append(
+                    {
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": s.tid,
+                        "name": "thread_name",
+                        "args": {"name": s.thread_name},
+                    }
+                )
+            ev = {
+                "ph": "X",
+                "pid": pid,
+                "tid": s.tid,
+                "name": s.name,
+                "ts": s.start_ns / 1000.0,
+                "dur": s.dur_ns / 1000.0,
+            }
+            if s.args:
+                ev["args"] = dict(s.args)
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write :meth:`chrome_trace` to ``path`` (atomic rename)."""
+        data = self.chrome_trace()
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+        return path
+
+
+_default_tracer: Optional[Tracer] = None
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    global _default_tracer
+    if _default_tracer is None:
+        with _default_lock:
+            if _default_tracer is None:
+                _default_tracer = Tracer()
+    return _default_tracer
+
+
+def span(name: str, **args) -> _ActiveSpan:
+    """``with telemetry.span("act"): ...`` against the default tracer."""
+    return get_tracer().span(name, **args)

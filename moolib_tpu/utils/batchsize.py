@@ -8,12 +8,11 @@ below ``threshold`` or memory runs out, and return the best batch size.
 
 from __future__ import annotations
 
-import time
 from typing import Callable, Optional
 
 import jax
 
-from . import log_info
+from . import Timer, log_info
 
 
 def find_batch_size(
@@ -40,11 +39,11 @@ def find_batch_size(
             args = make_batch(bs)
             out = jfn(*args)  # compile
             jax.block_until_ready(out)
-            t0 = time.perf_counter()
+            timer = Timer()
             for _ in range(iters):
                 out = jfn(*args)
             jax.block_until_ready(out)
-            dt = (time.perf_counter() - t0) / iters
+            dt = timer.elapsed() / iters
         except (RuntimeError, jax.errors.JaxRuntimeError) as e:  # OOM etc.
             log_info("batch size %d failed (%s); stopping search", bs, type(e).__name__)
             break

@@ -6,7 +6,11 @@ The reference has no tracer — only ``debug_info`` dumps and log timings
 - :func:`trace` — context manager around ``jax.profiler`` emitting a
   TensorBoard-loadable trace of XLA execution (compile, HBM, ICI waits).
 - :class:`StepTimer` — cheap wall-clock section timing with EMA summaries,
-  for the python-side loop (act/learn/reduce shares).
+  for the python-side loop (act/learn/reduce shares).  Registry-backed:
+  every section also lands in the telemetry registry
+  (``loop_section_seconds{section=...}``) and records a host span, so the
+  loop breakdown exports through Prometheus/Chrome-trace without the loop
+  doing anything beyond ``timer.section(...)``.
 - :func:`annotate` — ``jax.profiler.TraceAnnotation`` passthrough so loop
   phases show up inside device traces.
 """
@@ -19,6 +23,8 @@ from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
 import jax
+
+from .. import telemetry
 
 
 @contextlib.contextmanager
@@ -37,20 +43,48 @@ def annotate(name: str):
 
 
 class StepTimer:
-    """EMA section timer for the training loop's python side."""
+    """EMA section timer for the training loop's python side.
 
-    def __init__(self, alpha: float = 0.05):
+    Each ``section`` observation additionally feeds the process telemetry:
+    a ``loop_section_seconds{section=<name>}`` histogram sample in the
+    registry and a span in the default tracer.  Pass ``publish=False`` (or
+    a private ``registry``/``tracer``) to opt out — e.g. micro-benchmarks
+    that would flood the span ring.
+    """
+
+    def __init__(
+        self,
+        alpha: float = 0.05,
+        publish: bool = True,
+        registry: Optional["telemetry.Registry"] = None,
+        tracer: Optional["telemetry.Tracer"] = None,
+    ):
         self._alpha = alpha
         self._ema: Dict[str, float] = {}
         self._counts: Dict[str, int] = defaultdict(int)
+        self._hist = None
+        self._tracer = None
+        if publish:
+            reg = registry or telemetry.get_registry()
+            self._hist = reg.histogram(
+                "loop_section_seconds", "train-loop section wall time", ("section",)
+            )
+            self._tracer = tracer or telemetry.get_tracer()
 
     @contextlib.contextmanager
     def section(self, name: str) -> Iterator[None]:
+        span = self._tracer.span(name) if self._tracer is not None else None
+        if span is not None:
+            span.__enter__()
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
+            if span is not None:
+                span.__exit__(None, None, None)
+            if self._hist is not None:
+                self._hist.observe(dt, section=name)
             prev = self._ema.get(name)
             self._ema[name] = dt if prev is None else (1 - self._alpha) * prev + self._alpha * dt
             self._counts[name] += 1

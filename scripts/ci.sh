@@ -34,6 +34,25 @@ fi
 step "API reference freshness (docs/gen_api.py --check)"
 python docs/gen_api.py --check || fail=1
 
+step "telemetry guard (no bare perf_counter timing outside telemetry/profiling)"
+# New timing blocks belong in telemetry spans / Histogram.time() /
+# StepTimer (or utils.Timer for raw harnesses), not hand-rolled
+# time.perf_counter() pairs — those are invisible to every exporter.
+# docs/TELEMETRY.md documents the conventions.
+bare=$(grep -rn "time\.perf_counter" moolib_tpu \
+  --include='*.py' \
+  | grep -v "^moolib_tpu/telemetry/" \
+  | grep -v "^moolib_tpu/utils/profiling.py" || true)
+if [ -n "$bare" ]; then
+  echo "bare time.perf_counter() outside telemetry//utils/profiling.py:"
+  echo "$bare"
+  echo "use telemetry.span / Histogram.time() / StepTimer instead"
+  fail=1
+fi
+
+step "telemetry tests"
+python -m pytest tests/test_telemetry.py tests/test_profiling.py -q || fail=1
+
 step "sanitizer matrix (skips where the runtime is missing)"
 python -m pytest tests/test_native_sanitizers.py -q || fail=1
 

@@ -30,3 +30,28 @@ def test_trace_and_annotate(tmp_path):
     # A profile dump was produced.
     dumped = list(tmp_path.rglob("*.pb")) + list(tmp_path.rglob("*.json.gz"))
     assert dumped, f"no trace artifacts under {tmp_path}"
+
+
+def test_step_timer_publishes_to_registry():
+    from moolib_tpu import telemetry
+
+    reg = telemetry.Registry()
+    tracer = telemetry.Tracer()
+    t = StepTimer(alpha=0.5, registry=reg, tracer=tracer)
+    with t.section("act"):
+        time.sleep(0.001)
+    hist = reg.histogram("loop_section_seconds", labelnames=("section",))
+    s = hist.labels(section="act").get()
+    assert s["count"] == 1 and s["sum"] >= 0.001
+    assert [sp.name for sp in tracer.spans()] == ["act"]
+
+
+def test_step_timer_publish_opt_out():
+    from moolib_tpu import telemetry
+
+    before = len(telemetry.get_tracer().spans())
+    t = StepTimer(publish=False)
+    with t.section("quiet"):
+        pass
+    assert t.summary()["quiet"] >= 0
+    assert len(telemetry.get_tracer().spans()) == before

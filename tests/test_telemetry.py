@@ -1,0 +1,336 @@
+"""Telemetry subsystem: registry semantics, exporters, span tracing, cohort
+deltas, and the wiring smoke test (rpc + accumulator + envpool populate the
+expected metric families — the single-process acceptance demo, no TPU)."""
+
+import json
+import os
+import signal
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from moolib_tpu import telemetry
+
+
+@pytest.fixture
+def reg():
+    return telemetry.Registry()
+
+
+# --------------------------------------------------------------- instruments
+def test_counter_semantics(reg):
+    c = reg.counter("events_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert reg.counter_values() == {"events_total": 3.5}
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_labeled_counter_and_label_validation(reg):
+    c = reg.counter("bytes_total", "", ("transport",))
+    c.inc(10, transport="tcp")
+    c.labels(transport="ipc").inc(5)
+    vals = reg.counter_values()
+    assert vals['bytes_total{transport="tcp"}'] == 10
+    assert vals['bytes_total{transport="ipc"}'] == 5
+    with pytest.raises(ValueError):
+        c.labels(transport="tcp", extra="x")  # unknown label
+    with pytest.raises(ValueError):
+        c.labels()  # missing label
+    with pytest.raises(ValueError):
+        c.inc(1)  # unlabeled inc on a labeled family
+
+
+def test_registration_idempotent_and_type_conflicts(reg):
+    c1 = reg.counter("n_total", "h")
+    c2 = reg.counter("n_total", "h")
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        reg.gauge("n_total")  # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("n_total", "h", ("lab",))  # label-set conflict
+
+
+def test_gauge_semantics(reg):
+    g = reg.gauge("depth", "", ("q",))
+    g.set(4, q="a")
+    g.inc(2, q="a")
+    g.dec(1, q="a")
+    assert g.labels(q="a").get() == 5
+    assert g.samples() == [({"q": "a"}, 5.0)]
+
+
+def test_histogram_buckets_sum_count(reg):
+    h = reg.histogram("lat", "", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    s = h.labels().get()
+    assert s["buckets"] == [1, 1, 1, 1]  # one per bucket incl. +Inf overflow
+    assert s["count"] == 4
+    assert abs(s["sum"] - 5.555) < 1e-9
+    with h.time():
+        pass
+    assert h.labels().get()["count"] == 5
+
+
+# ----------------------------------------------------------------- exporters
+def test_prometheus_exposition_format(reg):
+    reg.counter("c_total", "a counter").inc(2)
+    reg.gauge("g", "a gauge", ("k",)).set(1.5, k='va"l')
+    h = reg.histogram("h_seconds", "a hist", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(2.0)
+    text = telemetry.prometheus_text(reg)
+    lines = text.splitlines()
+    assert "# TYPE c_total counter" in lines
+    assert "c_total 2" in lines
+    assert "# TYPE g gauge" in lines
+    assert 'g{k="va\\"l"} 1.5' in lines
+    # Histogram: cumulative buckets, +Inf, _sum/_count.
+    assert 'h_seconds_bucket{le="0.1"} 1' in lines
+    assert 'h_seconds_bucket{le="1"} 1' in lines
+    assert 'h_seconds_bucket{le="+Inf"} 2' in lines
+    assert "h_seconds_count 2" in lines
+    assert any(l.startswith("h_seconds_sum ") for l in lines)
+
+
+def test_http_endpoint(reg):
+    reg.counter("served_total").inc()
+    tracer = telemetry.Tracer()
+    with tracer.span("probe"):
+        pass
+    port = telemetry.serve_http(0, registry=reg, tracer=tracer)
+    body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).read()
+    assert b"served_total 1" in body
+    trace = json.loads(
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/trace", timeout=5).read()
+    )
+    assert any(e.get("name") == "probe" for e in trace["traceEvents"])
+    with pytest.raises(urllib.request.HTTPError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=5)
+
+
+def test_jsonl_snapshotter(tmp_path, reg):
+    reg.counter("snap_total").inc(7)
+    snap = telemetry.JsonlSnapshotter(str(tmp_path), interval=3600, registry=reg)
+    snap.snapshot_now()
+    snap.close()
+    lines = (tmp_path / "telemetry.jsonl").read_text().splitlines()
+    assert len(lines) >= 2  # explicit snapshot + close()
+    row = json.loads(lines[0])
+    assert row["metrics"]["snap_total"]["series"][0]["value"] == 7
+    # close() also wrote the host Chrome trace.
+    trace = json.loads((tmp_path / "host_trace.json").read_text())
+    assert "traceEvents" in trace
+
+
+def test_sigusr1_dump(capfd, reg, tmp_path):
+    reg.counter("kicked_total").inc()
+    prev = signal.getsignal(signal.SIGUSR1)
+    try:
+        assert telemetry.install_signal_dump(str(tmp_path), registry=reg)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.1)
+        err = capfd.readouterr().err
+        assert "telemetry dump" in err and "kicked_total 1" in err
+        assert (tmp_path / "host_trace.json").exists()
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+# ------------------------------------------------------------------- tracing
+def test_chrome_trace_nested_spans():
+    tracer = telemetry.Tracer()
+    with tracer.span("outer", step=1):
+        with tracer.span("inner"):
+            time.sleep(0.002)
+    data = tracer.chrome_trace()
+    json.dumps(data)  # must be valid JSON
+    ev = {e["name"]: e for e in data["traceEvents"] if e["ph"] == "X"}
+    assert set(ev) == {"outer", "inner"}
+    assert ev["outer"]["args"] == {"step": 1}
+    # Nesting: inner is contained within outer on the same thread.
+    o, i = ev["outer"], ev["inner"]
+    assert o["tid"] == i["tid"]
+    assert o["ts"] <= i["ts"]
+    assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+    assert i["dur"] >= 2000  # microseconds
+
+
+def test_tracer_ring_is_bounded():
+    tracer = telemetry.Tracer(capacity=8)
+    for k in range(20):
+        with tracer.span(f"s{k}"):
+            pass
+    names = [s.name for s in tracer.spans()]
+    assert len(names) == 8 and names[-1] == "s19"
+
+
+# -------------------------------------------------------------------- cohort
+def test_cohort_counters_delta_protocol(reg):
+    c = reg.counter("work_total")
+    c.inc(10)
+    stat = telemetry.CohortCounters(reg)
+    snap = stat.snapshot()
+    c.inc(5)
+    assert stat.delta(snap) == {"work_total": 5.0}
+    # Remote contributions land in the overlay, never the local counter.
+    stat.apply_delta({"work_total": 100.0, "other_total": 3.0})
+    assert stat.value("work_total") == 115.0
+    assert stat.value("other_total") == 3.0
+    assert reg.counter_values()["work_total"] == 15.0
+    # The baseline ignores remote application (GlobalStatsAccumulator calls
+    # this on the snapshot): the next local delta must stay local.
+    snap.apply_delta({"work_total": 100.0})
+    c.inc(1)
+    assert stat.delta(snap)["work_total"] == 6.0
+
+
+def test_common_delta_helpers_handle_dicts():
+    from moolib_tpu.examples.common import _delta_add, _delta_reduce_op, _delta_sub
+
+    a, b = {"x": 1.0}, {"x": 2.0, "y": 3.0}
+    assert _delta_add(a, b) == {"x": 3.0, "y": 3.0}
+    assert _delta_sub(b, a) == {"x": 1.0, "y": 3.0}
+    assert _delta_reduce_op({"t": a}, {"t": b}) == {"t": {"x": 3.0, "y": 3.0}}
+
+
+# ------------------------------------------------------------- wiring smoke
+class _TeleEnv:
+    """Minimal env (module-level: picklable under forkserver)."""
+
+    def reset(self):
+        return np.zeros(2, np.float32)
+
+    def step(self, action):
+        return np.zeros(2, np.float32), 1.0, False, {}
+
+
+def _pump(broker, acc, seconds, until):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        broker.update()
+        acc.update()
+        if until():
+            return True
+        time.sleep(0.02)
+    return until()
+
+
+def test_wiring_smoke_rpc_accumulator_envpool(free_port, tmp_path):
+    """The acceptance demo: an RPC echo, one accumulator reduction, and one
+    EnvPool batch step populate the rpc/accum/envpool metric families; the
+    Prometheus dump, Chrome trace, and JSONL snapshot all come out valid —
+    no TPU involved."""
+    from moolib_tpu import Accumulator, Broker, EnvPool, Rpc
+
+    pool = EnvPool(_TeleEnv, num_processes=2, batch_size=4, num_batches=1)
+    try:
+        pool.step(0, np.zeros(4, np.int64)).result()
+    finally:
+        pool.close()
+
+    # RPC echo.
+    a, b = Rpc(), Rpc()
+    a.set_name("tele-a")
+    b.set_name("tele-b")
+    b.define("echo", lambda x: x)
+    b.listen("127.0.0.1:0")
+    addr = next(x for x in b._listen_addrs if x.startswith("tcp://127"))
+    a.connect(addr)
+    try:
+        assert a.sync("tele-b", "echo", 1) == 1
+    finally:
+        a.close()
+        b.close()
+
+    # One single-peer accumulator reduction (standalone broker mode).
+    with telemetry.span("accum_round"):
+        broker = Broker()
+        broker.set_name("broker")
+        broker.listen(f"127.0.0.1:{free_port}")
+        acc = Accumulator("tele", {"w": np.zeros(2, np.float32)})
+        acc._rpc.set_name("tele-peer")
+        acc.listen("127.0.0.1:0")
+        acc.connect(f"127.0.0.1:{free_port}")
+        try:
+            assert _pump(broker, acc, 30, lambda: acc.connected())
+            acc.reduce_gradients(1, {"w": np.ones(2, np.float32)})
+            assert _pump(broker, acc, 30, lambda: acc.has_gradients())
+            np.testing.assert_allclose(acc.gradients()["w"], 1.0)
+            acc.zero_gradients()
+        finally:
+            acc.close()
+            broker.close()
+
+    text = telemetry.prometheus_text()
+    for family in (
+        "rpc_tx_bytes_total",
+        "rpc_rx_bytes_total",
+        "rpc_rtt_seconds_count",
+        "rpc_peer_latency_seconds",
+        "accum_reduces_total",
+        "accum_gradients_total",
+        "accum_elections_total",
+        "envpool_steps_total",
+        "envpool_step_wait_seconds_count",
+    ):
+        assert family in text, f"{family} missing from exposition:\n{text[:2000]}"
+    assert 'accum_reduces_total{plane="rpc"}' in text
+    # accum_is_leader: single peer elected itself.
+    assert 'accum_is_leader{accumulator="tele",peer="tele-peer"} 1' in text
+
+    # Chrome trace with the span we opened around the accumulator round.
+    path = telemetry.get_tracer().export_chrome_trace(str(tmp_path / "trace.json"))
+    trace = json.loads(open(path).read())
+    assert any(e.get("name") == "accum_round" for e in trace["traceEvents"])
+
+    # JSONL snapshot of the same registry.
+    snap = telemetry.JsonlSnapshotter(str(tmp_path), interval=3600)
+    snap.snapshot_now()
+    snap.close()
+    rows = [json.loads(l) for l in (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    assert rows and "envpool_steps_total" in rows[0]["metrics"]
+
+
+def test_queue_stats_readable_through_registry():
+    """define_queue service counters export as rpc_queue_*{queue=<name>}
+    while the old Queue.stats() view keeps working."""
+    import asyncio
+
+    from moolib_tpu import Rpc
+
+    a, b = Rpc(), Rpc()
+    a.set_name("tele-qa")
+    b.set_name("tele-qb")
+    q = b.define_queue("tele_q")
+    b.listen("127.0.0.1:0")
+    addr = next(x for x in b._listen_addrs if x.startswith("tcp://127"))
+    a.connect(addr)
+
+    async def serve_one():
+        ret, args, kwargs = await q
+        ret(args[0] * 2)
+
+    t = None
+    try:
+        fut = a.async_("tele-qb", "tele_q", 21)
+        loop = asyncio.new_event_loop()
+        import threading
+
+        t = threading.Thread(target=lambda: loop.run_until_complete(serve_one()))
+        t.start()
+        assert fut.result(30) == 42
+    finally:
+        if t is not None:
+            t.join(10)
+        a.close()
+        b.close()
+    st = q.stats()
+    assert st["items"] == 1 and st["takes"] == 1
+    text = telemetry.prometheus_text()
+    assert 'rpc_queue_items_total{queue="tele_q"} 1' in text
+    assert 'rpc_queue_wait_seconds_count{queue="tele_q"} 1' in text

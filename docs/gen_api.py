@@ -33,6 +33,8 @@ MODULES = [
     ("moolib_tpu.batcher", "Batcher"),
     ("moolib_tpu.replay", "Replay"),
     ("moolib_tpu.checkpoint", "Checkpointing"),
+    ("moolib_tpu.watchdog", "Watchdog (run-loop deadman)"),
+    ("moolib_tpu.testing.faults", "Testing: seeded fault injection"),
     ("moolib_tpu.parallel", "Parallelism (package)"),
     ("moolib_tpu.parallel.mesh", "Parallelism: mesh + shardings"),
     ("moolib_tpu.parallel.collectives", "Parallelism: collectives"),

@@ -30,9 +30,12 @@ __all__ = [
     "Future",
     "Group",
     "Queue",
+    "RestartPolicy",
     "Rpc",
     "RpcDeferredReturn",
     "RpcError",
+    "Watchdog",
+    "WatchdogTimeout",
     "create_uid",
     "set_log_level",
     "set_logging",
@@ -52,6 +55,9 @@ _LAZY = {
     "EnvRunner": "envpool",
     "EnvStepper": "envpool",
     "EnvStepperFuture": "envpool",
+    "RestartPolicy": "envpool",
+    "Watchdog": "watchdog",
+    "WatchdogTimeout": "watchdog",
 }
 
 

@@ -8,6 +8,14 @@ and provides the cohort-sync hooks (``Accumulator.set_state/state``,
 - :class:`Checkpointer` — orbax-backed when available (async-capable,
   sharding-aware: restores resharded arrays directly onto a mesh), with a
   pickle fallback; atomic installs either way; retains the last N.
+- Integrity is first-class (docs/RESILIENCE.md): every ``step_<N>/``
+  carries a ``manifest.json`` (step, file list, sizes, sha256) written
+  before the atomic rename, so a checkpoint is either whole or
+  detectably partial.  ``restore()`` validates the manifest and, on
+  corruption/truncation, *falls back to the newest intact older
+  checkpoint* instead of raising — logging what it skipped and bumping
+  the ``checkpoint_corrupt_skipped`` telemetry counter.  ``all_steps()``
+  ignores manifest-less partial directories for the same reason.
 - The cohort-sync side stays on the Accumulator exactly like the reference:
   restore → ``accumulator.set_model_version(step)`` so leader election
   prefers the restored peer.
@@ -15,15 +23,17 @@ and provides the cohort-sync hooks (``Accumulator.set_state/state``,
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pickle
 import shutil
 import time
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 
-from . import utils
+from . import telemetry, utils
 
 try:
     import orbax.checkpoint as ocp
@@ -33,11 +43,28 @@ except ImportError:  # pragma: no cover
     ocp = None
     _HAS_ORBAX = False
 
+_REG = telemetry.get_registry()
+_M_CORRUPT_SKIPPED = _REG.counter(
+    "checkpoint_corrupt_skipped",
+    "corrupt/partial checkpoints skipped by restore() fallback",
+)
+
+_MANIFEST = "manifest.json"
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
 
 class Checkpointer:
     """Save/restore arbitrary pytrees of arrays + metadata under a directory.
 
-    Layout: ``<dir>/step_<N>/`` per checkpoint plus a ``latest`` symlink.
+    Layout: ``<dir>/step_<N>/`` per checkpoint (each with a
+    ``manifest.json`` integrity record) plus a ``latest`` symlink.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3, use_orbax: Optional[bool] = None):
@@ -46,11 +73,13 @@ class Checkpointer:
         self.max_to_keep = max_to_keep
         self._use_orbax = _HAS_ORBAX if use_orbax is None else (use_orbax and _HAS_ORBAX)
         self._ckptr = ocp.PyTreeCheckpointer() if self._use_orbax else None
+        self._warned_partial: set = set()  # manifest-less dirs already logged
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, state: Any) -> str:
         """Write a checkpoint for ``step``; returns its path. Atomic: partial
-        writes land in a tmp dir that is renamed into place."""
+        writes land in a tmp dir (manifest included) that is renamed into
+        place — a crash mid-save can only ever leave a ``.tmp`` husk."""
         path = self._step_path(step)
         tmp = path + ".tmp"
         if os.path.exists(tmp):
@@ -62,6 +91,7 @@ class Checkpointer:
             os.makedirs(tmp, exist_ok=True)
             with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                 pickle.dump(host_state, f)
+        self._write_manifest(tmp, step)
         if os.path.exists(path):
             shutil.rmtree(path)
         os.replace(tmp, path)
@@ -70,22 +100,66 @@ class Checkpointer:
         utils.log_info("checkpoint: saved step %d to %s", step, path)
         return path
 
+    def _write_manifest(self, tmp: str, step: int) -> None:
+        files: Dict[str, Dict[str, object]] = {}
+        for root, _dirs, names in os.walk(tmp):
+            for name in names:
+                if name == _MANIFEST:
+                    continue
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, tmp)
+                files[rel] = {"size": os.path.getsize(full), "sha256": _sha256(full)}
+        manifest = {
+            "step": int(step),
+            "format": "orbax" if self._use_orbax else "pickle",
+            "time": time.time(),
+            "files": files,
+        }
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+
     # --------------------------------------------------------------- restore
     def restore(self, step: Optional[int] = None, target: Any = None) -> Optional[Any]:
-        """Load a checkpoint (latest by default); None if none exist.
+        """Load the newest *intact* checkpoint (≤ ``step`` when given);
+        None if none exists.
 
-        With orbax and a ``target`` pytree of sharded arrays, restored leaves
-        land directly with the target's shardings (no host round trip on the
-        user side).
+        A candidate whose manifest is missing, unparsable, or whose files
+        fail the size/sha256 check — or whose payload fails to deserialize
+        — is logged, counted (``checkpoint_corrupt_skipped``) and skipped
+        in favor of the next older one: a torn write or a truncated disk
+        must cost one checkpoint interval, not the run.
+
+        With orbax and a ``target`` pytree of sharded arrays, restored
+        leaves land directly with the target's shardings (no host round
+        trip on the user side).
         """
-        if step is None:
-            steps = self.all_steps()
-            if not steps:
-                return None
-            step = steps[-1]
-        path = self._step_path(step)
-        if not os.path.exists(path):
-            return None
+        candidates = self.all_steps()
+        if step is not None:
+            candidates = [s for s in candidates if s <= step]
+            if not candidates or candidates[-1] != step:
+                utils.log_error(
+                    "checkpoint: step %s missing or partial under %s",
+                    step, self.directory,
+                )
+        for cand in reversed(candidates):
+            path = self._step_path(cand)
+            reason = self._verify(path)
+            if reason is not None:
+                _M_CORRUPT_SKIPPED.inc()
+                utils.log_error(
+                    "checkpoint: skipping corrupt %s (%s); falling back", path, reason
+                )
+                continue
+            try:
+                return self._load(path, target)
+            except Exception as e:  # noqa: BLE001 — treat as corruption
+                _M_CORRUPT_SKIPPED.inc()
+                utils.log_error(
+                    "checkpoint: skipping unreadable %s (%r); falling back", path, e
+                )
+        return None
+
+    def _load(self, path: str, target: Any):
         is_pickle = os.path.exists(os.path.join(path, "state.pkl"))
         if not is_pickle:
             if not self._use_orbax:
@@ -100,10 +174,51 @@ class Checkpointer:
         with open(os.path.join(path, "state.pkl"), "rb") as f:
             return pickle.load(f)
 
+    def _verify(self, path: str) -> Optional[str]:
+        """None when ``path`` matches its manifest; else a human reason."""
+        mpath = os.path.join(path, _MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            return f"manifest unreadable: {e}"
+        files = manifest.get("files")
+        if not isinstance(files, dict):
+            return "manifest has no file table"
+        for rel, meta in files.items():
+            full = os.path.join(path, rel)
+            if not os.path.exists(full):
+                return f"missing file {rel}"
+            size = os.path.getsize(full)
+            if size != meta.get("size"):
+                return f"truncated {rel} ({size} != {meta.get('size')} bytes)"
+            if _sha256(full) != meta.get("sha256"):
+                return f"checksum mismatch on {rel}"
+        return None
+
+    def verify(self, step: int) -> bool:
+        """Public integrity probe: does ``step`` exist and match its
+        manifest byte-for-byte?"""
+        return self._verify(self._step_path(step)) is None
+
     def all_steps(self) -> List[int]:
+        """Steps with a manifest present.  A ``step_<N>/`` without one is a
+        partial artifact (pre-rename husk, hand-damaged, or written by a
+        pre-manifest version) and is ignored — it must never be selected as
+        'latest'.  Skips are logged once per directory so a legacy
+        checkpoint dir can't silently read as empty."""
         steps = []
         for name in os.listdir(self.directory):
             if name.startswith("step_") and not name.endswith(".tmp"):
+                if not os.path.exists(os.path.join(self.directory, name, _MANIFEST)):
+                    if name not in self._warned_partial:
+                        self._warned_partial.add(name)
+                        utils.log_error(
+                            "checkpoint: ignoring %s/%s (no %s — partial or "
+                            "pre-manifest; re-save to adopt it)",
+                            self.directory, name, _MANIFEST,
+                        )
+                    continue
                 try:
                     steps.append(int(name[len("step_") :]))
                 except ValueError:

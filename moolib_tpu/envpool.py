@@ -22,6 +22,13 @@ Design differences from the reference (TPU-first, not a translation):
   (futex-backed) instead of spin-waiting on atomic action words.
 - results are host numpy views meant to be fed to ``Batcher``/``jax.device_put``
   which lands them in TPU HBM in one hop.
+- worker death is a supervised event, not a run-killer: a slot that dies is
+  respawned and re-attached to the existing shm segments/doorbells, its
+  in-flight step tasks are re-issued (pending ``EnvStepperFuture``s complete
+  through a shm progress ledger), and only a slot exceeding its
+  :class:`RestartPolicy` respawn budget surfaces a hard error
+  (docs/RESILIENCE.md; ``envpool_worker_restarts`` /
+  ``envpool_worker_quarantined`` telemetry counters).
 
 Env protocol: ``create_env()`` returns an object with ``reset() -> obs`` and
 ``step(action) -> (obs, reward, done, info[, truncated])`` (both gym 4-tuple
@@ -32,17 +39,20 @@ ndarrays with fixed shapes/dtypes.
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 import multiprocessing as mp
 import os
 import pickle
 import sys
+import time
 import traceback
+from collections import deque
 from multiprocessing import shared_memory
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import telemetry
+from . import telemetry, utils
 
 # Pool metrics (docs/TELEMETRY.md), parent-process side only: workers report
 # through shm, and their own counters would land in a registry nobody scrapes.
@@ -55,6 +65,31 @@ _M_STEP_WAIT = _REG.histogram(
     "envpool_step_wait_seconds", "result() wait for a batch step to complete"
 )
 _M_WORKERS = _REG.gauge("envpool_workers", "worker processes of live pools")
+_M_RESTARTS = _REG.counter(
+    "envpool_worker_restarts", "worker processes respawned after an unexpected death"
+)
+_M_QUARANTINED = _REG.counter(
+    "envpool_worker_quarantined", "worker slots hard-failed after repeated deaths"
+)
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """Supervision policy for EnvPool worker processes (docs/RESILIENCE.md).
+
+    A worker that dies without reporting an env exception is respawned and
+    re-attached to the pool's existing shm segments and doorbells; in-flight
+    step tasks it never completed are re-issued so pending futures still
+    complete.  A slot that dies more than ``max_restarts`` times within
+    ``window`` seconds is quarantined: the death surfaces as a hard
+    ``RuntimeError`` (crash loops must not spin silently).  ``enabled=False``
+    (or ``max_restarts=0``) restores the fail-fast behavior: any worker
+    death raises immediately.
+    """
+
+    max_restarts: int = 3
+    window: float = 60.0
+    enabled: bool = True
 
 
 def _jax_backend_initialized() -> bool:
@@ -88,6 +123,12 @@ class _MpQueue:
     def get(self) -> int:
         return self._q.get()
 
+    def drain(self) -> None:
+        """Discard queued tasks.  Only safe while the consumer is dead and
+        the caller is the sole producer (worker-respawn recovery)."""
+        while not self._q.empty():
+            self._q.get()
+
 
 class _MpSem:
     def __init__(self, ctx):
@@ -110,6 +151,11 @@ class _RingQueue:
     def get(self) -> int:
         out = self._ring.pop()
         return _SHUTDOWN if out is None else out
+
+    def drain(self) -> None:
+        """Discard queued tasks (worker-respawn recovery; see _MpQueue)."""
+        while self._ring.pop(timeout=0) is not None:
+            pass
 
 
 def _doorbell_layout(lib, cap, num_processes, num_batches):
@@ -303,6 +349,17 @@ class EnvRunner:
             seg = shared_memory.SharedMemory(name=shm_name)
             segs.append(seg)
             act_views[b] = np.ndarray(shape, dtype=dtype, buffer=seg.buf)
+        # Completion ledger [num_batches, num_processes]: cell (b, w) counts
+        # the batch-b steps THIS worker finished.  Written after the slice
+        # lands, so the parent can always tell a completed slice from one a
+        # killed worker left half-written — the recovery ground truth (the
+        # per-batch semaphore is only a wake-up hint).
+        progress = None
+        if "progress" in layout:
+            shm_name, shape, dtype = layout["progress"]
+            seg = shared_memory.SharedMemory(name=shm_name)
+            segs.append(seg)
+            progress = np.ndarray(shape, dtype=dtype, buffer=seg.buf)
         try:
             while True:
                 b = self._get_task()
@@ -322,6 +379,8 @@ class EnvRunner:
                     except Exception:
                         pass
                     raise
+                if progress is not None:
+                    progress[b, self.worker_index] += 1
                 self.done_sems[b].release()
         finally:
             for seg in segs:
@@ -380,33 +439,52 @@ class EnvStepperFuture:
         self._done = False
 
     def result(self) -> Dict[str, np.ndarray]:
-        """Wait for every worker, then return zero-copy shm views."""
+        """Wait for every worker, then return zero-copy shm views.
+
+        Completion is judged from the shm progress ledger (each worker's
+        per-batch step count reaching the pool's issued count) rather than
+        by counting semaphore permits: the semaphore is just a wake-up
+        hint, so a worker killed mid-step and respawned by the supervisor
+        (``RestartPolicy``) completes this same future once its re-issued
+        slice lands — no permit bookkeeping can go stale.  On timeout or a
+        hard worker failure the in-flight slot is cleared and the
+        semaphore drained before the error propagates, so the next
+        ``step()`` on this batch (and teardown) cannot wedge on the
+        leftovers of a failed one.
+        """
         if self._done:
             return self._stepper._views[self._batch_index]
         s = self._stepper
-        import time as _time
-
-        t0 = _time.monotonic()
+        pool = s._pool
+        b = self._batch_index
+        t0 = time.monotonic()
         deadline = t0 + s._timeout
-        acquired = 0
-        while acquired < s._num_workers:
-            if s._done_sems[self._batch_index].acquire(timeout=0.5):
-                acquired += 1
-                continue
-            # Slow path: while waiting, surface worker failures promptly
-            # with the env's real traceback instead of a blind timeout.
-            s._pool._check_workers()
-            if _time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"EnvPool step batch {self._batch_index} timed out "
-                    f"({s._timeout}s); an env worker may have died"
-                )
-        _M_STEP_WAIT.observe(_time.monotonic() - t0)
+        sem = s._done_sems[b]
+        try:
+            while not pool._batch_complete(b):
+                if sem.acquire(timeout=0.25):
+                    continue
+                # Slow path: surface env exceptions promptly with their real
+                # traceback, and respawn/quarantine dead workers per the
+                # restart policy (a respawn re-issues this batch's task, so
+                # the loop then completes via the progress ledger).
+                pool._check_workers()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"EnvPool step batch {b} timed out "
+                        f"({s._timeout}s); an env worker may be wedged"
+                    )
+        except BaseException:
+            pool._abort_batch(b)
+            raise
+        while sem.acquire(timeout=0):  # drop leftover wake-up hints
+            pass
+        _M_STEP_WAIT.observe(time.monotonic() - t0)
         _M_ENV_BATCHES.inc()
-        _M_ENV_STEPS.inc(s._pool._batch_size)
+        _M_ENV_STEPS.inc(pool._batch_size)
         self._done = True
-        s._inflight[self._batch_index] = None
-        return s._views[self._batch_index]
+        s._inflight[b] = None
+        return s._views[b]
 
 
 class EnvStepper:
@@ -435,6 +513,9 @@ class EnvStepper:
         av[...] = act
         fut = EnvStepperFuture(self, batch_index)
         self._inflight[batch_index] = fut
+        # Bump the issued-step count BEFORE ringing any doorbell: a worker's
+        # progress cell must never be observed ahead of the target.
+        self._pool._targets[batch_index] += 1
         for q in self._task_queues:
             q.put(batch_index)
         return fut
@@ -442,7 +523,10 @@ class EnvStepper:
 
 class EnvPool:
     """User-facing pool (reference ctor args: create_env, num_processes,
-    batch_size, num_batches — ``src/moolib.cc:1614-1615``)."""
+    batch_size, num_batches — ``src/moolib.cc:1614-1615``), plus
+    ``restart_policy`` governing worker-death supervision
+    (:class:`RestartPolicy`; pass ``RestartPolicy(enabled=False)`` for the
+    fail-fast behavior)."""
 
     def __init__(
         self,
@@ -452,6 +536,7 @@ class EnvPool:
         num_batches: int = 1,
         action_dtype=np.int64,
         action_shape: Tuple[int, ...] = (),
+        restart_policy: Optional[RestartPolicy] = None,
     ):
         if num_processes < 1 or batch_size < 1 or num_batches < 1:
             raise ValueError("num_processes, batch_size, num_batches must be >= 1")
@@ -459,6 +544,14 @@ class EnvPool:
         self._num_processes = num_processes
         self._batch_size = batch_size
         self._num_batches = num_batches
+        self._restart_policy = (
+            restart_policy if restart_policy is not None else RestartPolicy()
+        )
+        # Per-slot respawn timestamps for the quarantine window.
+        self._restart_times: List[deque] = [deque() for _ in range(num_processes)]
+        self._quarantined: set = set()  # slots past the policy: always raise
+        # Issued batch-step counts; compared against the shm progress ledger.
+        self._targets = [0] * num_batches
         # Set teardown state first: a ctor failure after shm allocation must
         # reach close() (named segments outlive the process if never
         # unlinked, unlike the anonymous mappings they replaced).
@@ -504,6 +597,20 @@ class EnvPool:
                 ) from e
         ctx = mp.get_context(start)
 
+        # The shm resource tracker must exist BEFORE the first worker forks,
+        # so every worker inherits the parent's tracker.  A worker that has
+        # to spawn its own (possible in the mp-doorbell fallback, where no
+        # shm exists pre-fork) takes that private tracker down with it when
+        # SIGKILLed — and the dying tracker unlinks every segment the worker
+        # had attached, yanking live obs/act buffers out from under the
+        # whole pool (observed as FileNotFoundError on respawn re-attach).
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.ensure_running()
+        except Exception:  # noqa: BLE001 — platform without the tracker
+            pass
+
         # 1. Spawn worker 0 first: it discovers the observation spec from its
         # own first env (which it keeps and steps) — the shm layout derives
         # from a real first observation, reference ``src/env.h:214-246``.
@@ -519,26 +626,15 @@ class EnvPool:
             bounds.append((lo, hi))
             lo = hi
 
-        def spawn(w, discover=False):
-            pconn, cconn = ctx.Pipe()
-            p = ctx.Process(
-                target=_worker_main,
-                args=(
-                    create_env,
-                    w,
-                    bounds[w][0],
-                    bounds[w][1],
-                    num_batches,
-                    cconn,
-                    _worker_doorbell_desc(doorbell_desc, w),
-                    discover,
-                ),
-                daemon=True,
-            )
-            p.start()
-            return p, pconn
+        # Saved so a dead worker can be respawned later with identical
+        # arguments and re-attached to the same shm/doorbell descriptors.
+        self._ctx = ctx
+        self._create_env = create_env
+        self._bounds = bounds
+        self._doorbell_desc = doorbell_desc
+        self._layout = None
 
-        p0, p0conn = spawn(0, discover=True)
+        p0, p0conn = self._spawn(0, discover=True)
         self._procs = [p0]
         self._worker_conns = [p0conn]
         if not p0conn.poll(60):
@@ -581,20 +677,76 @@ class EnvPool:
             self._act_views.append(av)
             layout_act.append((seg.name, act_shape, np.dtype(action_dtype).str))
 
+        # Completion ledger (see EnvRunner.run): one int64 cell per
+        # (batch, worker), zero-initialized alongside the data segments.
+        prog_shape = (num_batches, num_processes)
+        seg = shared_memory.SharedMemory(
+            create=True, size=int(np.prod(prog_shape, dtype=np.int64)) * 8
+        )
+        self._segments.append(seg)
+        self._progress = np.ndarray(prog_shape, dtype=np.int64, buffer=seg.buf)
+        self._progress.fill(0)
+        layout_progress = (seg.name, prog_shape, "<i8")
+
         # 3. Ship the layout to worker 0 and spawn the rest with it.
-        layout = {"obs": layout_obs, "act": layout_act}
+        layout = {"obs": layout_obs, "act": layout_act, "progress": layout_progress}
+        self._layout = layout
         p0conn.send(layout)
         for w in range(1, num_processes):
-            p, pconn = spawn(w)
+            p, pconn = self._spawn(w)
             pconn.send(layout)
             self._procs.append(p)
             self._worker_conns.append(pconn)
         self._stepper = EnvStepper(self)
         _M_WORKERS.inc(num_processes)
 
+    def _spawn(self, w: int, discover: bool = False):
+        """Start (or restart) worker ``w`` attached to the pool's doorbell
+        descriptor; the caller sends ``self._layout`` over the returned pipe
+        (except for the discovery worker, which gets it after spec probe)."""
+        pconn, cconn = self._ctx.Pipe()
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(
+                self._create_env,
+                w,
+                self._bounds[w][0],
+                self._bounds[w][1],
+                self._num_batches,
+                cconn,
+                _worker_doorbell_desc(self._doorbell_desc, w),
+                discover,
+            ),
+            daemon=True,
+        )
+        p.start()
+        return p, pconn
+
+    def _batch_complete(self, b: int) -> bool:
+        """True once every worker's progress cell reached the issued count."""
+        return bool((self._progress[b] >= self._targets[b]).all())
+
+    def _abort_batch(self, b: int) -> None:
+        """Failure-path cleanup: clear the in-flight future and drain the
+        completion semaphore so a failed step can't wedge the next
+        ``step()`` on this batch or teardown (stale permits / a stuck
+        'already in flight' slot)."""
+        st = getattr(self, "_stepper", None)
+        if st is None:
+            return
+        st._inflight[b] = None
+        try:
+            while st._done_sems[b].acquire(timeout=0):
+                pass
+        except Exception:  # noqa: BLE001 — best-effort drain during teardown
+            pass
+
     def _check_workers(self) -> None:
-        """Raise if a worker reported an env exception or died."""
-        for i, (p, conn) in enumerate(zip(self._procs, self._worker_conns)):
+        """Service worker health: raise env exceptions with their real
+        traceback, and supervise unexplained deaths — respawn + re-attach
+        per ``RestartPolicy``, quarantining slots that keep dying."""
+        for i in range(self._num_processes):
+            p, conn = self._procs[i], self._worker_conns[i]
             try:
                 while conn.poll():
                     msg = conn.recv()
@@ -605,9 +757,65 @@ class EnvPool:
             except (EOFError, OSError):
                 pass
             if not p.is_alive():
-                raise RuntimeError(
-                    f"EnvPool worker {i} died (exit code {p.exitcode})"
-                )
+                self._supervise_dead_worker(i)
+
+    def _supervise_dead_worker(self, i: int) -> None:
+        """Worker ``i`` died without an env traceback (SIGKILL, OOM, hard
+        crash): respawn it onto the existing shm segments/doorbells and
+        re-issue any in-flight batch steps it never completed, unless the
+        restart policy says the slot is beyond saving."""
+        p = self._procs[i]
+        exitcode = p.exitcode
+        policy = self._restart_policy
+        if not policy.enabled or policy.max_restarts <= 0:
+            raise RuntimeError(f"EnvPool worker {i} died (exit code {exitcode})")
+        now = time.monotonic()
+        window = self._restart_times[i]
+        while window and now - window[0] > policy.window:
+            window.popleft()
+        if i in self._quarantined or len(window) >= policy.max_restarts:
+            if i not in self._quarantined:
+                self._quarantined.add(i)
+                _M_QUARANTINED.inc()
+            raise RuntimeError(
+                f"EnvPool worker {i} quarantined: died {len(window) + 1} times "
+                f"within {policy.window:.0f}s (last exit code {exitcode}); "
+                "the env or host is unhealthy beyond respawn"
+            )
+        window.append(now)
+        utils.log_error(
+            "envpool: worker %d died (exit code %s); respawning (%d/%d in %.0fs window)",
+            i, exitcode, len(window), policy.max_restarts, policy.window,
+        )
+        try:
+            p.join(timeout=0)  # reap the zombie
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._worker_conns[i].close()
+        except Exception:  # noqa: BLE001
+            pass
+        # Tasks the dead worker never popped are still queued; the respawn
+        # below recomputes what to run from the progress ledger, so drain
+        # them or re-issued batches would be stepped twice.
+        try:
+            self._task_queues[i].drain()
+        except Exception:  # noqa: BLE001
+            pass
+        proc, conn = self._spawn(i)
+        conn.send(self._layout)
+        self._procs[i] = proc
+        self._worker_conns[i] = conn
+        _M_RESTARTS.inc()
+        # Re-issue in-flight steps this worker hadn't finished: envs in its
+        # slice are recreated lazily on the respawn's first step of each
+        # batch, the slice is rewritten whole, and the pending
+        # EnvStepperFuture completes through the progress ledger.
+        st = getattr(self, "_stepper", None)
+        if st is not None:
+            for b in range(self._num_batches):
+                if st._inflight[b] is not None and self._progress[b, i] < self._targets[b]:
+                    self._task_queues[i].put(b)
 
     def step(self, batch_index: int, action) -> EnvStepperFuture:
         if not 0 <= batch_index < self._num_batches:

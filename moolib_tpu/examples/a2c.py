@@ -29,6 +29,7 @@ from ..envs import CartPoleEnv
 from ..models import ActorCriticNet
 from ..ops import discounted_returns, entropy_loss, softmax_cross_entropy
 from ..utils.profiling import StepTimer
+from ..watchdog import Watchdog
 from .common import finalize_flags
 
 
@@ -71,6 +72,10 @@ def make_flags(argv=None):
     p.add_argument("--log_interval", type=float, default=2.0)
     p.add_argument("--no_lstm", action="store_true")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--watchdog", type=float, default=0.0,
+                   help="deadman seconds per loop section (0 = off); expiry "
+                   "dumps telemetry + thread stacks and raises "
+                   "WatchdogTimeout (docs/RESILIENCE.md)")
     return finalize_flags(p, argv)
 
 
@@ -81,6 +86,9 @@ def train(flags, on_stats=None) -> dict:
     apply_platform_env()
     # Opt-in exporters (MOOLIB_TELEMETRY_* env knobs, docs/TELEMETRY.md).
     telemetry.init_from_env()
+    from ..testing import faults as _faults
+
+    _faults.install_from_env()  # opt-in chaos (MOOLIB_FAULTS; no-op unset)
     # EnvPool must fork before jax spins up device state (same constraint the
     # reference solves with its early fork server, src/env.cc:149-169).
     envs = EnvPool(
@@ -162,6 +170,9 @@ def train(flags, on_stats=None) -> dict:
     # Loop-phase breakdown: sections export as loop_section_seconds{section=}
     # histograms + host spans (registry-backed StepTimer).
     timer = StepTimer()
+    # Per-section deadman (--watchdog seconds; disabled at 0): a wedged env
+    # step / learn step dumps diagnostics and raises instead of hanging.
+    wd = Watchdog(timeout=flags.watchdog, name="a2c")
 
     try:
         while stats["steps"] < flags.total_steps:
@@ -188,7 +199,7 @@ def train(flags, on_stats=None) -> dict:
                         )
 
             # --- act -----------------------------------------------------
-            with timer.section("env_step"):
+            with timer.section("env_step"), wd.section("env_step"):
                 obs = envs.step(0, np.asarray(action)).result()
             reward = np.asarray(obs["reward"])
             done = np.asarray(obs["done"])
@@ -207,7 +218,7 @@ def train(flags, on_stats=None) -> dict:
             }
             rng, act_rng = jax.random.split(rng)
             core_before = core_state  # LSTM state *entering* this step
-            with timer.section("act"):
+            with timer.section("act"), wd.section("act"):
                 new_action, new_core = act_step(params, inputs, core_state, act_rng)
             # result() returns zero-copy shm views valid only until the next
             # step on this batch index (same contract as the reference's
@@ -235,7 +246,7 @@ def train(flags, on_stats=None) -> dict:
 
             # --- learn ---------------------------------------------------
             if accumulator.has_gradients():
-                with timer.section("apply"):
+                with timer.section("apply"), wd.section("apply"):
                     grads = accumulator.gradients()
                     updates, opt_state = opt.update(grads, opt_state, params)
                     params = optax.apply_updates(params, updates)
@@ -243,7 +254,7 @@ def train(flags, on_stats=None) -> dict:
                     accumulator.zero_gradients()
                 stats["sgd_steps"] += 1
             elif len(steps_collected) >= T + 1 and accumulator.wants_gradients():
-                with timer.section("learn"):
+                with timer.section("learn"), wd.section("learn"):
                     batch = {
                         k: jnp.asarray(np.stack([s[k] for s in steps_collected]))
                         for k in steps_collected[0]
@@ -276,6 +287,7 @@ def train(flags, on_stats=None) -> dict:
                 if on_stats is not None:
                     on_stats(dict(stats))
     finally:
+        wd.close()
         envs.close()
         accumulator.close()
         if broker is not None:

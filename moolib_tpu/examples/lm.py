@@ -32,6 +32,7 @@ import optax
 from ..models.transformer import TransformerLM
 from .. import parallel, telemetry
 from ..utils.profiling import StepTimer
+from ..watchdog import Watchdog
 from . import common
 
 
@@ -122,6 +123,18 @@ def make_flags(argv=None):
                    help="global batch per optimizer step (0: one reduction "
                    "per contribution)")
     p.add_argument("--wire_dtype", default=None, choices=[None, "bf16", "int8"])
+    p.add_argument("--checkpoint_dir", default=None,
+                   help="Checkpointer directory (manifest-validated "
+                   "step_<N>/ dirs); the run resumes from the newest "
+                   "intact checkpoint on restart")
+    p.add_argument("--checkpoint_interval", type=float, default=30.0,
+                   help="seconds between checkpoint saves (leader-only in "
+                   "elastic runs)")
+    p.add_argument("--watchdog", type=float, default=0.0,
+                   help="deadman seconds per loop section (0 = off): a "
+                   "wedged section dumps telemetry + thread stacks and "
+                   "raises WatchdogTimeout so the finally-block checkpoint "
+                   "still happens (docs/RESILIENCE.md)")
     return common.finalize_flags(p, argv)
 
 
@@ -138,6 +151,9 @@ def train(flags, on_stats=None) -> dict:
 
     apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
     telemetry.init_from_env()  # opt-in exporters (docs/TELEMETRY.md)
+    from ..testing import faults as _faults
+
+    _faults.install_from_env()  # opt-in chaos (MOOLIB_FAULTS; no-op unset)
     if flags.seq_len % 2:
         raise ValueError("--seq_len must be even")
     if flags.address or flags.connect:
@@ -253,9 +269,33 @@ def train(flags, on_stats=None) -> dict:
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss, acc
 
+    # Durable state (docs/RESILIENCE.md): manifest-validated checkpoints;
+    # resume picks the newest INTACT one (corruption costs one interval).
+    ckpt = None
+    start_step = 0
+    if flags.checkpoint_dir:
+        from ..checkpoint import Checkpointer
+
+        ckpt = Checkpointer(flags.checkpoint_dir)
+        # The template pytree makes orbax restore container types (optax
+        # states are NamedTuples) faithfully; pickle preserves them anyway.
+        st = ckpt.restore(
+            target={"params": params, "opt_state": opt_state, "steps": 0}
+        )
+        if st is not None:
+            params = st["params"]
+            opt_state = st["opt_state"]
+            start_step = int(st["steps"])
+            # Not restored: the numpy data rng — the resumed stream replays
+            # from the seed.  Immaterial for this synthetic i.i.d. copy task
+            # (every draw is fresh random data); a real-corpus loader must
+            # checkpoint its cursor alongside params.
+            if not flags.quiet:
+                print(f"resumed from checkpoint step {start_step}", flush=True)
+
     if flags.address or flags.connect:
         return _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
-                              on_stats=on_stats)
+                              on_stats=on_stats, ckpt=ckpt, start_step=start_step)
 
     if mesh is None:
         jstep = jax.jit(step)
@@ -285,37 +325,67 @@ def train(flags, on_stats=None) -> dict:
     _, _, wl, _ = jstep(params, opt_state, put(tokens0))
     float(wl)
     start = time.time()
+    last_ckpt = start
     loss = acc = None
+    steps_done = start_step
     timer = StepTimer()  # registry-backed section breakdown (docs/TELEMETRY.md)
-    for i in range(flags.steps):
-        with timer.section("make_batch"):
-            tokens = put(jnp.asarray(make_batch(rng, flags)))
-        with timer.section("train_step"):
-            params, opt_state, loss, acc = jstep(params, opt_state, tokens)
-        if (i + 1) % flags.log_interval == 0:
-            loss_v, acc_v = float(loss), float(acc)
-            if not flags.quiet:
-                print(f"step={i + 1} loss={loss_v:.4f} acc={acc_v:.3f}", flush=True)
-            if on_stats is not None:
-                on_stats({"step": i + 1, "loss": loss_v, "acc": acc_v})
-    loss_v, acc_v = float(loss), float(acc)  # force the chain before reading the clock
+    wd = Watchdog(timeout=flags.watchdog, name="lm")
+    try:
+        for i in range(start_step, flags.steps):
+            with timer.section("make_batch"), wd.section("make_batch"):
+                tokens = put(jnp.asarray(make_batch(rng, flags)))
+            with timer.section("train_step"), wd.section("train_step"):
+                params, opt_state, loss, acc = jstep(params, opt_state, tokens)
+            steps_done = i + 1
+            if steps_done % flags.log_interval == 0:
+                loss_v, acc_v = float(loss), float(acc)
+                if not flags.quiet:
+                    print(f"step={steps_done} loss={loss_v:.4f} acc={acc_v:.3f}", flush=True)
+                if on_stats is not None:
+                    on_stats({"step": steps_done, "loss": loss_v, "acc": acc_v})
+            if ckpt is not None and time.time() - last_ckpt > flags.checkpoint_interval:
+                last_ckpt = time.time()
+                ckpt.save(steps_done, {
+                    "params": jax.device_get(params),
+                    "opt_state": jax.device_get(opt_state),
+                    "steps": steps_done,
+                })
+    finally:
+        wd.close()
+        # A watchdog expiry / interrupt still leaves a resumable checkpoint.
+        if ckpt is not None and steps_done > start_step:
+            ckpt.save(steps_done, {
+                "params": jax.device_get(params),
+                "opt_state": jax.device_get(opt_state),
+                "steps": steps_done,
+            })
+        telemetry.flush()  # final JSONL snapshot + host trace, if enabled
+    loss_v = None if loss is None else float(loss)  # force the async chain
+    acc_v = None if acc is None else float(acc)
     elapsed = time.time() - start
-    telemetry.flush()  # final JSONL snapshot + host trace, if enabled
     return {
-        "steps": flags.steps,
+        "steps": steps_done,
         "loss": loss_v,
         "acc": acc_v,
-        "tokens_per_s": flags.steps * flags.batch_size * flags.seq_len / elapsed,
+        "tokens_per_s": (steps_done - start_step)
+        * flags.batch_size * flags.seq_len / max(elapsed, 1e-6),
     }
 
 
 def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
-                   on_stats=None) -> dict:
+                   on_stats=None, ckpt=None, start_step=0) -> dict:
     """Elastic data-parallel LM training over the Accumulator cohort: the
     wants/has gradient protocol the RL agents ride (leader election, model
     sync, virtual batches, wire compression), applied unchanged to
     TransformerLM — the elastic plane is model-agnostic by construction.
     Peers join/leave freely; a joiner adopts the leader's model + opt state.
+
+    Fault domains (docs/RESILIENCE.md): the leader checkpoints on an
+    interval and on the way out (so a kill resumes from the newest intact
+    ``step_<N>/``); a restored peer advertises its step count as its model
+    version so election prefers it; an optional watchdog turns a wedged
+    section — or stalled step progress — into a diagnosable
+    ``WatchdogTimeout`` instead of a silent hang.
     """
     import os as _os
 
@@ -330,6 +400,9 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
 
     acc = Accumulator("lm", params)
     acc.set_name(flags.local_name or f"lm_{_os.getpid()}")
+    if start_step:
+        # Leader election prefers the restored peer (checkpoint.py docs).
+        acc.set_model_version(start_step)
     acc.listen()
     if flags.virtual_batch_size:
         acc.set_virtual_batch_size(flags.virtual_batch_size)
@@ -347,10 +420,24 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
 
     japply = jax.jit(apply_fn)
 
-    steps_done = 0
+    steps_done = start_step
     loss_v = acc_v = None
     start = time.time()
+    last_ckpt = start
     timer = StepTimer()  # registry-backed section breakdown
+    wd = Watchdog(timeout=flags.watchdog, name="lm")
+    # Whole-run deadman: fed on every optimizer step, so a run whose
+    # *progress* stalls (wedged reduce, lost cohort) fires even though no
+    # single section is stuck.
+    progress_token = wd.arm("step_progress")
+
+    def save_checkpoint():
+        ckpt.save(steps_done, {
+            "params": jax.device_get(params),
+            "opt_state": jax.device_get(opt_state),
+            "steps": steps_done,
+        })
+
     try:
         while steps_done < flags.steps:
             if broker is not None:
@@ -371,12 +458,13 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                 time.sleep(0.02)
                 continue
             if acc.has_gradients():
-                with timer.section("apply"):
+                with timer.section("apply"), wd.section("apply"):
                     grads = acc.gradients()
                     params, opt_state = japply(acc.parameters(), opt_state, grads)
                     acc.set_parameters(params)
                     acc.zero_gradients()
                 steps_done += 1
+                wd.feed(progress_token)
                 if steps_done % flags.log_interval == 0:
                     if not flags.quiet:
                         print(
@@ -386,8 +474,15 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                         )
                     if on_stats is not None:
                         on_stats({"step": steps_done, "loss": loss_v, "acc": acc_v})
+                if (
+                    ckpt is not None
+                    and acc.is_leader()
+                    and time.time() - last_ckpt > flags.checkpoint_interval
+                ):
+                    last_ckpt = time.time()
+                    save_checkpoint()
             elif acc.wants_gradients():
-                with timer.section("learn"):
+                with timer.section("learn"), wd.section("learn"):
                     tokens = jnp.asarray(make_batch(rng, flags))
                     (loss, a), grads = jgrad(params, tokens)
                     loss_v, acc_v = float(loss), float(a)
@@ -395,6 +490,12 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
             else:
                 time.sleep(0.002)
     finally:
+        wd.close()
+        if ckpt is not None and steps_done > start_step and acc.is_leader():
+            try:
+                save_checkpoint()
+            except Exception:  # noqa: BLE001 — teardown must reach close()
+                pass
         info = acc.debug_info()
         acc.close()
         if broker is not None:

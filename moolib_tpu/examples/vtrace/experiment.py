@@ -40,6 +40,7 @@ from ...envs import CartPoleEnv, CatchEnv, SyntheticAtariEnv
 from ...models import ActorCriticNet, ImpalaNet
 from ...ops import entropy_loss, softmax_cross_entropy, vtrace
 from ...utils.profiling import StepTimer
+from ...watchdog import Watchdog
 from .. import common
 
 
@@ -127,6 +128,11 @@ def make_flags(argv=None):
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--watchdog", type=float, default=0.0,
+                   help="deadman seconds per loop section (0 = off); expiry "
+                   "dumps telemetry + thread stacks and raises "
+                   "WatchdogTimeout, so the finally-block leader checkpoint "
+                   "still lands (docs/RESILIENCE.md)")
     return common.finalize_flags(p, argv)
 
 
@@ -284,6 +290,9 @@ def train(flags, on_stats=None) -> dict:
     tele = telemetry.init_from_env()
     if tele["http_port"]:
         print(f"telemetry: http://127.0.0.1:{tele['http_port']}/metrics", flush=True)
+    from ...testing import faults as _faults
+
+    _faults.install_from_env()  # opt-in chaos (MOOLIB_FAULTS; no-op unset)
     if flags.coordinator:
         # Multi-host: join the jax.distributed world before any device use.
         from ... import parallel as _parallel
@@ -461,6 +470,11 @@ def train(flags, on_stats=None) -> dict:
     stats["telemetry"] = telemetry.CohortCounters()
     global_stats = common.GlobalStatsAccumulator(rpc_group, stats)
     timer = StepTimer()  # registry-backed loop-phase breakdown
+    # Per-section deadman (--watchdog seconds; disabled at 0): a wedged
+    # section raises through the loop so the finally block below still
+    # writes the leader checkpoint — a preempted-but-hung run stays
+    # resumable (docs/RESILIENCE.md).
+    wd = Watchdog(timeout=flags.watchdog, name="impala")
 
     tsv = None
     if flags.localdir:
@@ -572,7 +586,7 @@ def train(flags, on_stats=None) -> dict:
                 )
 
             if accumulator.has_gradients():
-                with timer.section("apply"):
+                with timer.section("apply"), wd.section("apply"):
                     grads = accumulator.gradients()
                     if opt_apply is not None:
                         params, opt_state = opt_apply(params, opt_state, grads)
@@ -583,7 +597,7 @@ def train(flags, on_stats=None) -> dict:
                     accumulator.zero_gradients()
                 stats["sgd_steps"] += 1
             elif not learn_batcher.empty() and accumulator.wants_gradients():
-                with timer.section("learn"):
+                with timer.section("learn"), wd.section("learn"):
                     batch = learn_batcher.get()
                     initial_core = core_batcher.get() if core_batcher is not None else ()
                     (loss, aux), grads = grad_fn(params, batch, initial_core)
@@ -594,7 +608,7 @@ def train(flags, on_stats=None) -> dict:
             else:
                 # --- act ------------------------------------------------
                 st = env_states[cur]
-                with timer.section("env_wait"):
+                with timer.section("env_wait"), wd.section("env_wait"):
                     obs = st.future.result()
                 st.update(obs, stats)
                 inputs = {
@@ -605,7 +619,7 @@ def train(flags, on_stats=None) -> dict:
                 }
                 rng, act_rng = jax.random.split(rng)
                 core_before = st.core_state  # LSTM state entering this step
-                with timer.section("act"):
+                with timer.section("act"), wd.section("act"):
                     out, new_core = act_step(params, inputs, st.core_state, act_rng)
                 action = out["action"][0]
                 # Queue the next env step immediately (overlaps with learning).
@@ -691,6 +705,7 @@ def train(flags, on_stats=None) -> dict:
         # steady-state window it exists to measure.
         sps_samples.append((time.time(), stats["steps_done"].value))
     finally:
+        wd.close()
         if trace_stop_at is not None:
             try:
                 jax.profiler.stop_trace()

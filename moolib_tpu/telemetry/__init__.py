@@ -59,6 +59,7 @@ from .metrics import (  # noqa: F401
 from .tracing import Span, Tracer, get_tracer, span  # noqa: F401
 from .exporters import (  # noqa: F401
     JsonlSnapshotter,
+    dump_diagnostics,
     install_signal_dump,
     prometheus_text,
     serve_http,
@@ -74,6 +75,7 @@ __all__ = [
     "Registry",
     "Span",
     "Tracer",
+    "dump_diagnostics",
     "flush",
     "get_registry",
     "get_tracer",

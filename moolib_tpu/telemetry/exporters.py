@@ -26,6 +26,7 @@ import signal
 import sys
 import threading
 import time
+import traceback
 from typing import Optional
 
 from .metrics import Registry, get_registry
@@ -35,6 +36,7 @@ __all__ = [
     "prometheus_text",
     "serve_http",
     "JsonlSnapshotter",
+    "dump_diagnostics",
     "install_signal_dump",
 ]
 
@@ -186,6 +188,43 @@ class JsonlSnapshotter:
         self.flush()
 
 
+def dump_diagnostics(
+    reason: str = "",
+    run_dir: Optional[str] = None,
+    registry: Optional[Registry] = None,
+    tracer: Optional[Tracer] = None,
+    file=None,
+    stacks: bool = True,
+) -> None:
+    """One-stop diagnostic dump shared by the SIGUSR1 handler and the
+    run-loop watchdog (:mod:`moolib_tpu.watchdog`): the registry in
+    Prometheus text, the python stack of every live thread (wedge triage:
+    *where* is each thread blocked?), and — when a run dir is known — the
+    host Chrome trace.  Only formats already-collected data, so it is safe
+    from a signal handler or a monitor thread."""
+    registry = registry or get_registry()
+    tracer = tracer or get_tracer()
+    out = file or sys.stderr
+    header = f"pid {os.getpid()}" + (f", {reason}" if reason else "")
+    parts = [f"--- telemetry dump ({header}) ---\n", prometheus_text(registry)]
+    if stacks:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            parts.append(f"--- thread {names.get(tid, '?')!r} (ident {tid}) ---\n")
+            parts.append("".join(traceback.format_stack(frame)))
+    parts.append("--- end telemetry dump ---\n")
+    out.write("".join(parts))
+    try:
+        out.flush()
+    except OSError:
+        pass
+    if run_dir:
+        try:
+            tracer.export_chrome_trace(os.path.join(run_dir, "host_trace.json"))
+        except OSError:
+            pass
+
+
 _signal_installed = False
 
 
@@ -195,26 +234,18 @@ def install_signal_dump(
     tracer: Optional[Tracer] = None,
     signum: int = signal.SIGUSR1,
 ) -> bool:
-    """SIGUSR1 → dump the Prometheus text to stderr (and the Chrome trace
-    to ``run_dir`` when given).  Main thread only (CPython restriction);
-    returns False when the handler could not be installed.  The handler
-    only formats already-collected data — safe at signal time."""
+    """SIGUSR1 → :func:`dump_diagnostics` to stderr (metrics + thread
+    stacks, plus the Chrome trace into ``run_dir`` when given).  Main
+    thread only (CPython restriction); returns False when the handler
+    could not be installed."""
     global _signal_installed
     registry = registry or get_registry()
     tracer = tracer or get_tracer()
 
     def _dump(sig, frame):
-        sys.stderr.write(
-            f"--- telemetry dump (pid {os.getpid()}) ---\n"
-            + prometheus_text(registry)
-            + "--- end telemetry dump ---\n"
+        dump_diagnostics(
+            reason=f"signal {sig}", run_dir=run_dir, registry=registry, tracer=tracer
         )
-        sys.stderr.flush()
-        if run_dir:
-            try:
-                tracer.export_chrome_trace(os.path.join(run_dir, "host_trace.json"))
-            except OSError:
-                pass
 
     try:
         signal.signal(signum, _dump)

@@ -9,10 +9,12 @@ and the docs generator without touching jax.
 
 Hot-path design: callers bind a labeled child once (``counter.labels(...)``
 at wiring time — e.g. per RPC connection) and the per-event cost is one
-``child.inc(n)``: a single uncontended ``threading.Lock`` acquire around a
-float add.  CPython can't do true lock-free, but the lock is per-child, never
-shared across metrics, and held for two bytecodes — cheap enough for the
-per-frame RPC path (~100 ns), and consistent reads come for free.
+``child.inc(n)``: a single uncontended ``threading.RLock`` acquire around a
+float add (reentrant so the SIGUSR1/watchdog diagnostics dump can't
+self-deadlock against an interrupted update).  CPython can't do true
+lock-free, but the lock is per-child, never shared across metrics, and held
+for two bytecodes — cheap enough for the per-frame RPC path (~100 ns), and
+consistent reads come for free.
 
 Naming follows Prometheus conventions: ``snake_case``, ``_total`` suffix on
 counters, base-unit ``_seconds``/``_bytes`` suffixes.  Metric names are
@@ -52,12 +54,20 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
 
 
 class _Child:
-    """One (metric, label-set) time series."""
+    """One (metric, label-set) time series.
+
+    Locks here (and on histograms / metric families / the registry) are
+    REENTRANT: the SIGUSR1/watchdog diagnostics dump formats the registry
+    from the main thread, and a signal can land while that same thread is
+    inside an ``inc()``/``observe()`` — a plain Lock would self-deadlock
+    the process the dump exists to diagnose.  CPython's RLock is C-level
+    and keeps the fast path a single uncontended acquire.
+    """
 
     __slots__ = ("_lock", "_value")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._value = 0.0
 
     def get(self) -> float:
@@ -95,7 +105,7 @@ class _HistogramChild:
     __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count")
 
     def __init__(self, bounds: Sequence[float]):
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # reentrant: see _Child
         self._bounds = tuple(bounds)
         self._counts = [0] * (len(bounds) + 1)  # last bucket = +Inf
         self._sum = 0.0
@@ -145,7 +155,7 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # reentrant: see _Child
         self._children: Dict[Tuple[Tuple[str, str], ...], object] = {}
 
     def _new_child(self):
@@ -237,7 +247,7 @@ class Registry:
     subsystem can declare its metrics at wiring time without coordination."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # reentrant: see _Child
         self._metrics: Dict[str, _Metric] = {}
 
     def _register(self, cls, name, help, labelnames, **kw) -> _Metric:

@@ -1,0 +1,291 @@
+"""Seeded fault-injection plane (docs/RESILIENCE.md).
+
+``tests/test_rpc_sim.py`` proved the value of *deterministic* faults at the
+``send_frame`` seam — every reliability invariant is pinned by a scripted
+scenario instead of a flaky churn loop.  This module generalizes that idea
+into one seed-driven plane covering every fault domain the stack claims to
+survive:
+
+- **RPC frames**: :class:`FrameFaults` wraps the ``send_frame`` seam both
+  transport backends share and drops / duplicates / holds (reorders) frames
+  with seeded per-frame decisions — same seed, same frame sequence, same
+  faults.
+- **EnvPool workers**: SIGKILL / SIGSTOP / SIGCONT a worker slot of a live
+  pool (exercises the :class:`~moolib_tpu.envpool.RestartPolicy`
+  supervisor).
+- **Cohort peers**: kill a peer process (broker eviction + epoch churn).
+- **Checkpoints**: truncate files inside the newest ``step_<N>/`` so
+  ``Checkpointer.restore()`` must fall back to the newest *intact* one.
+
+A :class:`FaultPlan` owns independent seeded RNG streams per fault kind and
+records every action it takes (``plan.actions``) so a failing chaos run can
+be replayed exactly.  ``scripts/chaos_soak.py`` and the supervision tests
+are the consumers; :func:`install_from_env` lets a *subprocess* opt into
+frame faults via the ``MOOLIB_FAULTS`` env knob (a strict no-op when
+unset), which is how the soak injects RPC chaos into real training peers.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["FaultPlan", "FrameFaults", "install_from_env"]
+
+
+class FrameFaults:
+    """Seeded drop/dup/hold of outgoing RPC frames at the ``send_frame``
+    seam (the single choke point both the asyncio and the native transport
+    share — same seam as ``tests/test_rpc_sim.py``'s scripted ``FrameSim``).
+
+    Probabilities are per frame; decisions come from a private
+    ``random.Random`` under a lock, so for a given seed the decision
+    *sequence* is deterministic (the mapping onto frames follows the send
+    order, which concurrency can vary — chaos runs assert on recovery, not
+    on which exact frame was hit).  A held frame is flushed right after the
+    next passing frame on the same connection: a deterministic reorder.
+
+    Use as a context manager, or ``install()``/``uninstall()`` for
+    process-lifetime injection (:func:`install_from_env`).
+    """
+
+    def __init__(
+        self,
+        rng: random.Random,
+        drop: float = 0.0,
+        dup: float = 0.0,
+        hold: float = 0.0,
+        kinds: Optional[Sequence[int]] = None,
+    ):
+        if drop + dup + hold > 1.0:
+            raise ValueError("drop + dup + hold must be <= 1")
+        self._rng = rng
+        self.drop = float(drop)
+        self.dup = float(dup)
+        self.hold = float(hold)
+        self.kinds = None if kinds is None else frozenset(int(k) for k in kinds)
+        self.counts: Dict[str, int] = {"pass": 0, "drop": 0, "dup": 0, "hold": 0}
+        self._lock = threading.Lock()
+        self._held: Dict[int, List[list]] = {}  # id(conn) -> held frames
+        self._originals: List[Tuple[type, object]] = []
+
+    def _decide(self) -> str:
+        with self._lock:
+            r = self._rng.random()
+        if r < self.drop:
+            return "drop"
+        if r < self.drop + self.dup:
+            return "dup"
+        if r < self.drop + self.dup + self.hold:
+            return "hold"
+        return "pass"
+
+    def _wrap(self, cls, orig):
+        faults = self
+
+        def send(conn_self, chunks):
+            if not chunks:
+                return orig(conn_self, chunks)
+            if faults.kinds is not None:
+                kind = bytes(chunks[0][:1])
+                if not kind or kind[0] not in faults.kinds:
+                    return orig(conn_self, chunks)
+            action = faults._decide()
+            with faults._lock:
+                faults.counts[action] += 1
+                if action == "drop":
+                    return None
+                if action == "hold":
+                    # Materialize: callers may reuse their buffers.
+                    faults._held.setdefault(id(conn_self), []).append(
+                        [bytes(c) for c in chunks]
+                    )
+                    return None
+                held = faults._held.pop(id(conn_self), [])
+            rv = orig(conn_self, chunks)
+            if action == "dup":
+                orig(conn_self, chunks)
+            for h in held:  # flush AFTER the passing frame: reorder
+                orig(conn_self, h)
+            return rv
+
+        return send
+
+    def install(self) -> "FrameFaults":
+        if self._originals:
+            return self  # already installed
+        from ..rpc import core as rpc_core
+
+        # Both backends override send_frame, so patch each class's own.
+        for cls in (rpc_core._Connection, rpc_core._NativeConnection):
+            orig = cls.__dict__["send_frame"]
+            self._originals.append((cls, orig))
+            cls.send_frame = self._wrap(cls, orig)
+        return self
+
+    def uninstall(self) -> None:
+        for cls, orig in self._originals:
+            cls.send_frame = orig
+        self._originals = []
+
+    def __enter__(self) -> "FrameFaults":
+        return self.install()
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
+
+class FaultPlan:
+    """Deterministic, seed-driven fault schedule.
+
+    Each fault kind draws from its own derived RNG stream (``seed:name``),
+    so adding faults of one kind never perturbs another kind's sequence.
+    Every injected fault is appended to ``actions`` for replay/triage.
+    """
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self.actions: List[Tuple] = []
+        self._streams: Dict[str, random.Random] = {}
+
+    def rng(self, name: str) -> random.Random:
+        """The named derived stream (created on first use)."""
+        r = self._streams.get(name)
+        if r is None:
+            r = self._streams[name] = random.Random(f"{self.seed}:{name}")
+        return r
+
+    def _record(self, *event) -> None:
+        self.actions.append(event)
+
+    # ------------------------------------------------------------ rpc frames
+    def frame_faults(
+        self,
+        drop: float = 0.0,
+        dup: float = 0.0,
+        hold: float = 0.0,
+        kinds: Optional[Sequence[int]] = None,
+    ) -> FrameFaults:
+        """A :class:`FrameFaults` injector on this plan's ``rpc`` stream."""
+        self._record("frame_faults", drop, dup, hold)
+        return FrameFaults(self.rng("rpc"), drop=drop, dup=dup, hold=hold, kinds=kinds)
+
+    # -------------------------------------------------------- envpool workers
+    def _pick_worker(self, pool, index: Optional[int]) -> int:
+        if index is None:
+            index = self.rng("envpool").randrange(pool._num_processes)
+        return int(index)
+
+    def kill_envpool_worker(self, pool, index: Optional[int] = None,
+                            sig: int = signal.SIGKILL) -> int:
+        """SIGKILL (by default) one worker of a live pool; returns the slot
+        index.  The pool's supervisor respawns it per its RestartPolicy."""
+        index = self._pick_worker(pool, index)
+        pid = pool._procs[index].pid
+        self._record("kill_envpool_worker", index, pid, sig)
+        os.kill(pid, sig)
+        return index
+
+    def freeze_envpool_worker(self, pool, index: Optional[int] = None) -> int:
+        """SIGSTOP a worker: alive but not progressing — the wedge the
+        step timeout / watchdog must catch (not a respawn case)."""
+        index = self._pick_worker(pool, index)
+        self._record("freeze_envpool_worker", index)
+        os.kill(pool._procs[index].pid, signal.SIGSTOP)
+        return index
+
+    def thaw_envpool_worker(self, pool, index: int) -> None:
+        self._record("thaw_envpool_worker", index)
+        os.kill(pool._procs[index].pid, signal.SIGCONT)
+
+    # ----------------------------------------------------------- cohort peers
+    def kill_process(self, proc, sig: int = signal.SIGKILL) -> None:
+        """Kill a peer process (``subprocess.Popen`` or bare pid): broker
+        eviction, epoch churn, and leader re-election on the survivors."""
+        pid = getattr(proc, "pid", proc)
+        self._record("kill_process", pid, sig)
+        os.kill(pid, sig)
+
+    # ------------------------------------------------------------ checkpoints
+    def truncate_checkpoint(self, path: str, step: Optional[int] = None) -> Optional[str]:
+        """Truncate the biggest payload file of a checkpoint to half its
+        size (manifest left intact, so validation sees the corruption).
+
+        ``path`` is a ``Checkpointer`` directory (newest ``step_<N>/`` by
+        default, or ``step``) or a single pickle file.  Returns the
+        truncated file path, or None when there was nothing to corrupt."""
+        target_dir = path
+        if os.path.isfile(path):
+            return self._truncate_file(path)
+        if not os.path.isdir(path):
+            return None
+        if step is None:
+            steps = []
+            for name in os.listdir(path):
+                if name.startswith("step_") and not name.endswith(".tmp"):
+                    try:
+                        steps.append(int(name[len("step_"):]))
+                    except ValueError:
+                        pass
+            if not steps:
+                return None
+            step = max(steps)
+        target_dir = os.path.join(path, f"step_{step}")
+        victim, size = None, -1
+        for root, _dirs, files in os.walk(target_dir):
+            for f in files:
+                if f == "manifest.json":
+                    continue
+                full = os.path.join(root, f)
+                s = os.path.getsize(full)
+                if s > size:
+                    victim, size = full, s
+        if victim is None:
+            return None
+        return self._truncate_file(victim)
+
+    def _truncate_file(self, path: str) -> str:
+        size = os.path.getsize(path)
+        keep = size // 2
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+        self._record("truncate", path, size, keep)
+        return path
+
+
+_env_installed: Optional[FrameFaults] = None
+
+
+def install_from_env() -> Optional[FrameFaults]:
+    """Opt-in chaos for real entry points: when ``MOOLIB_FAULTS`` is set
+    (e.g. ``"seed=7,rpc_drop=0.02,rpc_dup=0.01,rpc_hold=0.005"``), install
+    seeded frame faults for the life of the process and return the
+    injector.  Unset/empty → None, nothing touched.  Idempotent.
+    """
+    global _env_installed
+    spec = os.environ.get("MOOLIB_FAULTS")
+    if not spec:
+        return None
+    if _env_installed is not None:
+        return _env_installed
+    kv: Dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"MOOLIB_FAULTS: expected key=value, got {part!r}")
+        k, v = part.split("=", 1)
+        kv[k.strip()] = v.strip()
+    plan = FaultPlan(int(kv.get("seed", "0")))
+    faults = plan.frame_faults(
+        drop=float(kv.get("rpc_drop", "0")),
+        dup=float(kv.get("rpc_dup", "0")),
+        hold=float(kv.get("rpc_hold", "0")),
+    )
+    _env_installed = faults.install()
+    return _env_installed

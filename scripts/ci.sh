@@ -53,6 +53,15 @@ fi
 step "telemetry tests"
 python -m pytest tests/test_telemetry.py tests/test_profiling.py -q || fail=1
 
+step "fault-domain supervision tests (envpool respawn, watchdog, checkpoint integrity)"
+python -m pytest tests/test_envpool_supervision.py tests/test_watchdog.py \
+  tests/test_checkpoint_corrupt.py -q || fail=1
+
+step "chaos soak (seeded, ~60 s smoke: worker/peer kills, RPC frame chaos, forced-kill resume)"
+# Exits non-zero if any phase stalls past its watchdog/deadline
+# (docs/RESILIENCE.md).
+python scripts/chaos_soak.py --smoke || fail=1
+
 step "sanitizer matrix (skips where the runtime is missing)"
 python -m pytest tests/test_native_sanitizers.py -q || fail=1
 

@@ -1,0 +1,128 @@
+"""EnvPool worker supervision: respawn, re-issue, quarantine
+(docs/RESILIENCE.md; ISSUE 2 tentpole).
+
+A SIGKILLed worker must be a *supervised* event: the pending
+``EnvStepperFuture`` completes on the respawned worker via the shm progress
+ledger, telemetry counters move, and only a crash-looping slot surfaces a
+hard error.  The failure path must also leave the pool steppable/closable
+(no stale in-flight slot, no uncounted semaphore permits).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu import EnvPool, RestartPolicy, telemetry
+from moolib_tpu.testing import FaultPlan
+
+
+class SlowEnv:
+    """0.3 s steps: a wide window to land a kill mid-step, deterministic
+    observations to prove the re-issued slice was actually recomputed."""
+
+    def reset(self):
+        return np.zeros(2, np.float32)
+
+    def step(self, action):
+        time.sleep(0.3)
+        return np.full(2, 7.0, np.float32), 1.0, False, {}
+
+
+def _counter(name):
+    return telemetry.get_registry().counter_values().get(name, 0.0)
+
+
+def test_worker_killed_mid_step_respawns_and_future_completes():
+    plan = FaultPlan(seed=3)
+    restarts_before = _counter("envpool_worker_restarts")
+    pool = EnvPool(SlowEnv, num_processes=2, batch_size=4, num_batches=1)
+    try:
+        fut = pool.step(0, np.zeros(4, np.int64))
+        time.sleep(0.1)  # step is in flight on both workers
+        plan.kill_envpool_worker(pool)
+        out = fut.result()  # the SAME future completes; no raise
+        np.testing.assert_allclose(out["state"][:, 0], 7.0)
+        np.testing.assert_allclose(out["reward"], 1.0)
+        # The respawned worker serves subsequent steps too.
+        out = pool.step(0, np.zeros(4, np.int64)).result()
+        np.testing.assert_allclose(out["state"][:, 0], 7.0)
+        assert _counter("envpool_worker_restarts") == restarts_before + 1
+    finally:
+        pool.close()
+
+
+def test_quarantine_after_repeated_crashes():
+    """A slot that keeps dying exhausts its RestartPolicy budget and the
+    next death surfaces as a hard error — after one successful respawn."""
+    plan = FaultPlan(seed=4)
+    quarantined_before = _counter("envpool_worker_quarantined")
+    pool = EnvPool(
+        SlowEnv, num_processes=1, batch_size=2, num_batches=1,
+        restart_policy=RestartPolicy(max_restarts=1, window=60.0),
+    )
+    try:
+        fut = pool.step(0, np.zeros(2, np.int64))
+        time.sleep(0.1)
+        plan.kill_envpool_worker(pool, index=0)
+        out = fut.result()  # first death: respawned, future completes
+        np.testing.assert_allclose(out["state"][:, 0], 7.0)
+
+        fut = pool.step(0, np.zeros(2, np.int64))
+        time.sleep(0.1)
+        plan.kill_envpool_worker(pool, index=0)
+        with pytest.raises(RuntimeError, match="quarantined"):
+            fut.result()
+        assert _counter("envpool_worker_quarantined") == quarantined_before + 1
+        # Satellite: the failed step cleared its in-flight slot, so another
+        # step() must not raise "already in flight" ...
+        pool.step(0, np.zeros(2, np.int64))
+    finally:
+        # ... and teardown must not wedge on the dead slot.
+        t0 = time.monotonic()
+        pool.close()
+        assert time.monotonic() - t0 < 15
+
+
+def test_mp_fallback_double_buffer_respawn(monkeypatch):
+    """Supervision on the multiprocessing-doorbell fallback (no native
+    shmq), with num_batches=2: both in-flight futures complete after the
+    kill.  Regression guard for the private-resource-tracker pitfall: a
+    worker forked before any parent shm existed would spawn its own
+    tracker, whose death on SIGKILL unlinked the pool's live segments."""
+    # get_shmq() latches on first use, so patch the accessor, not the env.
+    monkeypatch.setattr("moolib_tpu.native.get_shmq", lambda: None)
+    plan = FaultPlan(seed=6)
+    pool = EnvPool(SlowEnv, num_processes=2, batch_size=4, num_batches=2)
+    try:
+        f0 = pool.step(0, np.zeros(4, np.int64))
+        f1 = pool.step(1, np.zeros(4, np.int64))
+        time.sleep(0.1)
+        plan.kill_envpool_worker(pool, index=0)
+        np.testing.assert_allclose(f0.result()["state"][:, 0], 7.0)
+        np.testing.assert_allclose(f1.result()["state"][:, 0], 7.0)
+        out = pool.step(0, np.zeros(4, np.int64)).result()
+        np.testing.assert_allclose(out["state"][:, 0], 7.0)
+    finally:
+        pool.close()
+
+
+def test_restart_policy_disabled_is_fail_fast():
+    plan = FaultPlan(seed=5)
+    pool = EnvPool(
+        SlowEnv, num_processes=2, batch_size=4, num_batches=1,
+        restart_policy=RestartPolicy(enabled=False),
+    )
+    try:
+        fut = pool.step(0, np.zeros(4, np.int64))
+        time.sleep(0.1)
+        plan.kill_envpool_worker(pool, index=1)
+        with pytest.raises(RuntimeError, match="died"):
+            fut.result()
+        # Failure path still cleans up: no stale in-flight slot.
+        assert pool._stepper._inflight[0] is None
+        pool.step(0, np.zeros(4, np.int64))  # must not raise "in flight"
+    finally:
+        t0 = time.monotonic()
+        pool.close()
+        assert time.monotonic() - t0 < 15

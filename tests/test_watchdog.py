@@ -1,0 +1,113 @@
+"""Run-loop watchdog: arm/disarm, expiry dump + in-thread raise, hooks
+(docs/RESILIENCE.md; ISSUE 2 tentpole)."""
+
+import threading
+import time
+
+import pytest
+
+from moolib_tpu import telemetry
+from moolib_tpu.watchdog import Watchdog, WatchdogTimeout
+
+
+def test_fast_section_never_fires():
+    wd = Watchdog(timeout=0.5, dump=False)
+    try:
+        for _ in range(3):
+            with wd.section("fast"):
+                time.sleep(0.01)
+        time.sleep(0.3)  # give the monitor a chance to mis-fire
+        assert wd.expired == []
+    finally:
+        wd.close()
+
+
+def test_expiry_raises_in_armed_thread():
+    wd = Watchdog(timeout=0.2, dump=False)
+    try:
+        with pytest.raises(WatchdogTimeout):
+            with wd.section("wedged"):
+                # Polling sleep: the async exception lands between bytecodes,
+                # exactly like the framework's own sub-second wait loops.
+                for _ in range(200):
+                    time.sleep(0.02)
+        assert wd.expired and wd.expired[0][0] == "wedged"
+    finally:
+        wd.close()
+
+
+def test_expiry_dumps_metrics_and_thread_stacks(capfd):
+    before = telemetry.get_registry().counter_values().get(
+        "watchdog_expirations_total", 0.0
+    )
+    fired = []
+    wd = Watchdog(timeout=0.2, on_expire=lambda s, t: fired.append(s))
+    try:
+        with wd.section("dumped"):
+            time.sleep(0.6)
+        _out, err = capfd.readouterr()
+        # Same artifact as the SIGUSR1 path: registry text + thread stacks.
+        assert "telemetry dump" in err and "watchdog" in err
+        assert "--- thread" in err and "MainThread" in err
+        assert fired == ["dumped"]
+        after = telemetry.get_registry().counter_values().get(
+            "watchdog_expirations_total", 0.0
+        )
+        assert after == before + 1
+    finally:
+        wd.close()
+
+
+def test_on_expire_hook_replaces_the_raise():
+    calls = []
+    wd = Watchdog(timeout=0.15, dump=False, on_expire=lambda s, t: calls.append((s, t)))
+    try:
+        with wd.section("hooked"):  # no WatchdogTimeout with a hook installed
+            time.sleep(0.5)
+        assert calls == [("hooked", 0.15)]
+    finally:
+        wd.close()
+
+
+def test_feed_defers_the_deadline():
+    wd = Watchdog(timeout=0.3, dump=False)
+    try:
+        token = wd.arm("heartbeat")
+        for _ in range(4):  # 0.6 s total, but fed every 0.15 s
+            time.sleep(0.15)
+            wd.feed(token)
+        assert wd.expired == []
+        wd.disarm(token)
+    finally:
+        wd.close()
+
+
+def test_disabled_watchdog_is_a_noop():
+    wd = Watchdog(timeout=0)
+    assert not wd.enabled
+    assert wd.arm("x") is None
+    with wd.section("anything"):
+        time.sleep(0.01)
+    assert wd.expired == []
+    wd.close()
+
+
+def test_expiry_targets_the_arming_thread():
+    """The raise lands in the thread that armed the section, not the
+    monitor or the main thread."""
+    wd = Watchdog(timeout=0.2, dump=False)
+    caught = []
+
+    def worker():
+        try:
+            with wd.section("worker-wedge"):
+                for _ in range(200):
+                    time.sleep(0.02)
+        except WatchdogTimeout:
+            caught.append(True)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=10)
+    wd.close()
+    assert caught == [True]

@@ -54,12 +54,14 @@ MODULES = [
     ("moolib_tpu.telemetry.tracing", "Telemetry: span tracer"),
     ("moolib_tpu.telemetry.exporters", "Telemetry: exporters"),
     ("moolib_tpu.telemetry.cohort", "Telemetry: cohort aggregation"),
+    ("moolib_tpu.telemetry.recovery", "Telemetry: recovery-phase accounting"),
     ("moolib_tpu.utils", "Utilities"),
     ("moolib_tpu.utils.nest", "Utilities: nest"),
     ("moolib_tpu.utils.config", "Utilities: config"),
     ("moolib_tpu.utils.batchsize", "Utilities: batch-size finder"),
     ("moolib_tpu.utils.profiling", "Utilities: profiling"),
     ("moolib_tpu.utils.stats", "Utilities: running stats"),
+    ("moolib_tpu.utils.compile_cache", "Utilities: persistent compile cache"),
     ("moolib_tpu.envs.atari", "Envs: Atari preprocessing"),
 ]
 

@@ -37,6 +37,9 @@ jitted train step instead — same math, collective data plane.
 from __future__ import annotations
 
 import collections
+import hashlib
+import os
+import pickle
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -85,10 +88,30 @@ _M_SKIPPED = _REG.counter(
 _M_STALE = _REG.counter(
     "accum_stale_results_total", "results consumed across an epoch boundary"
 )
+# Chunked model sync (warm-rejoin plane, docs/RESILIENCE.md "Recovery
+# budget"): bytes/chunks per direction, resumes, and zero-byte warm rejoins.
+_M_SYNC_BYTES = _REG.counter(
+    "accum_model_sync_bytes_total", "model-sync chunk bytes", ("direction",)
+)
+_M_SYNC_CHUNKS = _REG.counter(
+    "accum_model_sync_chunks_total", "model-sync chunks", ("direction",)
+)
+_M_SYNC_RESUMES = _REG.counter(
+    "accum_model_sync_resumes_total",
+    "chunked model transfers resumed from a partial buffer (not from chunk 0)",
+)
+_M_WARM_REJOINS = _REG.counter(
+    "accum_warm_rejoins_total",
+    "restarts whose checkpoint-restored version matched the leader: synced "
+    "with zero model-sync bytes",
+)
 
 _MODEL_PUSH_INTERVAL = 600.0  # reference: regular model broadcast every 600 s
 _BUFFERS_PUSH_INTERVAL = 12.0  # reference: buffers broadcast every 12 s
 _MODEL_REQUEST_RETRY = 2.0
+# Chunk size for the streamed model sync; must only affect pacing, never
+# semantics (the transfer is resumable at any chunk boundary).
+_MODEL_CHUNK_BYTES = int(os.environ.get("MOOLIB_MODEL_CHUNK_BYTES", 1 << 20))
 
 
 def _tree_add(a, b):
@@ -205,6 +228,16 @@ class Accumulator:
         self._leader: Optional[str] = None
         self._is_leader = False
         self._election_future = None
+        # Election repair (docs/RESILIENCE.md recovery budget): an election
+        # allreduce that errors (timeout under load) used to leave this
+        # peer leaderless FOREVER on a stable epoch — the membership never
+        # changes again, so no new election ever fires.  A leaderless peer
+        # now retries after this deadline: it queries members for the
+        # already-agreed result (an allreduce completes only with every
+        # member's contribution, so any completed result already includes
+        # our vote) and re-issues the election for the all-failed case.
+        self._election_retry_at: Optional[float] = None
+        self._election_retry_interval = 5.0
         self._epoch_synced = False  # got (or am serving) the model this epoch
         self._staged_model = None  # incoming model update awaiting commit
         self._buffers_version = -1  # last applied buffers-push version
@@ -212,10 +245,50 @@ class Accumulator:
         self._last_model_push = 0.0
         self._last_buffers_push = 0.0
 
-        # state (user blob) machinery
-        self._state_requesters: List[str] = []
+        # state (user blob) machinery.  Requesters queue as
+        # (peer, have_version, resume_version, resume_chunks) tuples: the
+        # advertised version enables the warm-rejoin fast path and the
+        # resume fields let a transfer continue from the last acked chunk.
+        self._state_requesters: List[Tuple[str, int, int, int]] = []
         self._received_state = None
         self._has_new_state = False
+
+        # Chunked model sync (docs/RESILIENCE.md "Recovery budget").
+        # Leader side: pickled-blob chunk cache keyed by model version, and
+        # the set of peers with a send chain in flight (re-requests while a
+        # transfer runs must not start a second chain).  Requester side: the
+        # partial chunk buffer — keyed by (version, sha), NOT by epoch, so a
+        # transfer interrupted by leader death resumes from the last acked
+        # chunk under the new epoch's leader when the bytes still match.
+        self._model_chunk_bytes = _MODEL_CHUNK_BYTES
+        self._sync_cache: Optional[Tuple[int, str, List[bytes]]] = None
+        self._active_transfers: Dict[str, Tuple[Any, int]] = {}
+        self._in_transfer: Optional[Dict[str, Any]] = None
+        self._model_sync_bytes_rx = 0
+        self._model_sync_bytes_tx = 0
+        self._warm_rejoin = False
+        # Count of results consumed across an epoch boundary: each one
+        # mutates params WITHOUT bumping the version (see zero_gradients),
+        # so while nonzero our version number no longer names our bytes.  A
+        # stale peer never advertises its version for the current-model
+        # fast path (it needs the leader's full sync to reconverge) — and a
+        # stale peer that WINS the election bumps its version by this count
+        # first: its params are exactly that many cohort results ahead, so
+        # the bump restores the version-names-bytes invariant instead of
+        # letting two different byte strings share one version number.
+        self._stale_applies = 0
+
+        # Recovery phase accounting (telemetry.recovery): milestone stamps
+        # along the rejoin chain; _rec_phases keeps the FIRST occurrence of
+        # each phase (the process-restart chain the soak decomposes), the
+        # shared recovery_seconds histogram gets every occurrence.
+        self._rec_t_init = time.monotonic()
+        self._rec_t_active: Optional[float] = None
+        self._rec_t_epoch: Optional[float] = None
+        self._rec_t_elect: Optional[float] = None
+        self._rec_t_synced: Optional[float] = None
+        self._rec_t_first_reduce: Optional[float] = None
+        self._rec_phases: Dict[str, float] = {}
 
         # gradient machinery
         self._virtual_batch_size: Optional[int] = None
@@ -314,7 +387,9 @@ class Accumulator:
                 return handler
 
             rpc.define("__accum_request_model", dispatch("_on_request_model"))
+            rpc.define("__accum_model_chunk", dispatch("_on_model_chunk"))
             rpc.define("__accum_model_update", dispatch("_on_model_update"))
+            rpc.define("__accum_leader_query", dispatch("_on_leader_query"))
             rpc.define("__accum_buffers_update", dispatch("_on_buffers_update"))
             rpc.define("__accum_ici_abort", dispatch("_on_ici_abort"))
         if self._name in registry:
@@ -575,24 +650,222 @@ class Accumulator:
             return self._is_leader and bool(self._state_requesters)
 
     def set_state(self, state) -> None:
-        """Leader: provide user state; it is pushed (with the model) to every
-        peer that requested it."""
+        """Leader: provide user state; the model + state stream to every
+        requesting peer as version-keyed chunks (see ``_on_model_chunk``).
+
+        Unlike the old monolithic push, the stream is a windowed, ack-paced
+        chunk pipeline (``_send_model_chunks``): a huge model never
+        serializes into one giant frame, in-flight gradient rounds
+        interleave with sync traffic instead of stalling behind it, and a
+        transfer that dies with its leader resumes from the last acked
+        chunk under the new epoch (the requester re-advertises its partial
+        buffer)."""
         with self._lock:
             requesters, self._state_requesters = self._state_requesters, []
             params, buffers, version = self._params, self._buffers, self._model_version
         epoch = self._group.sync_id()
-        for peer in requesters:
+        chunks = sha = None
+        for peer, _have, resume_version, resume_chunks in requesters:
+            if chunks is None:
+                chunks, sha = self._sync_chunks(version, params, buffers, state)
+            start = 0
+            if resume_version == version and 0 < resume_chunks <= len(chunks):
+                start = resume_chunks
+                _M_SYNC_RESUMES.inc()
+                utils.log_info(
+                    "accumulator %s: resuming model sync to %s from chunk "
+                    "%d/%d (version %s)",
+                    self._name, peer, start, len(chunks), version,
+                )
+            with self._lock:
+                self._active_transfers[peer] = (epoch, version)
+            self._send_model_chunks(peer, epoch, version, sha, chunks, start)
+
+    def set_model_chunk_bytes(self, n: int) -> None:
+        """Chunk size for the streamed model sync (default 1 MiB, env
+        ``MOOLIB_MODEL_CHUNK_BYTES``).  Pacing only — never semantics: the
+        transfer resumes at any chunk boundary.  Tests shrink it to land
+        kills mid-transfer deterministically."""
+        if n < 1:
+            raise ValueError("model chunk size must be >= 1 byte")
+        self._model_chunk_bytes = int(n)
+
+    def _sync_chunks(self, version, params, buffers, state):
+        """(chunks, sha16) of the pickled host-side (params, buffers, state)
+        blob for ``version``; cached per version so N simultaneous joiners
+        serialize once.
+
+        The sha identifies the blob bytes, not just the version: resume
+        across a leader change is only valid when the NEW leader's blob at
+        the same version is byte-identical (deterministic pickling of the
+        identically-replicated model/opt state — true in lockstep cohorts).
+        When it is not, the receiver detects the sha mismatch, resets its
+        buffer, and the transfer restarts cleanly from chunk 0."""
+        with self._lock:
+            cached = self._sync_cache
+            if cached is not None and cached[0] == version:
+                return cached[2], cached[1]
+        host = jax.device_get((params, buffers, state))
+        blob = pickle.dumps(host, protocol=pickle.HIGHEST_PROTOCOL)
+        sha = hashlib.sha256(blob).hexdigest()[:16]
+        n = self._model_chunk_bytes
+        chunks = [blob[i : i + n] for i in range(0, len(blob), n)] or [b""]
+        with self._lock:
+            self._sync_cache = (version, sha, chunks)
+        return chunks, sha
+
+    # Chunks in flight per transfer: enough pipelining that one slow chunk
+    # (a dropped frame riding the transport's resend timer) stalls only its
+    # own slot, small enough that a dead requester wastes one window.
+    _SYNC_WINDOW = 8
+
+    def _send_model_chunks(self, peer, epoch, version, sha, chunks, start):
+        """Drive one windowed chunk stream to ``peer``.  Up to
+        ``_SYNC_WINDOW`` chunks ride the wire at once (pipelined — a lossy
+        link costs per-chunk retransmit latency once per window, not once
+        per chunk); each ack carries the receiver's contiguous-chunk count,
+        which is the single source of truth for progress: a duplicated,
+        re-ordered, or regressed ack can only cause re-sends, never skips.
+        An ack of -1 (stale transfer) or an epoch change stops the stream
+        (the requester's next re-request resumes it)."""
+        total = len(chunks)
+        if start >= total:
+            # The requester buffered the whole blob but could not commit it
+            # (the final chunk carried a dead epoch's stamp): re-send the
+            # last chunk under the current epoch so it can commit.
+            start = total - 1
+        st = {"next": start, "acked": start, "stopped": False}
+
+        def _stop():
+            with self._lock:
+                st["stopped"] = True
+                self._active_transfers.pop(peer, None)
+                if not self._active_transfers:
+                    # Last stream ended: drop the pinned blob copy (a full
+                    # host-side model) instead of holding it until the next
+                    # version's set_state, which may never come.
+                    self._sync_cache = None
+
+        def pump():
+            to_send = []
+            with self._lock:
+                while (
+                    not st["stopped"]
+                    and st["next"] < total
+                    and st["next"] < st["acked"] + self._SYNC_WINDOW
+                ):
+                    to_send.append(st["next"])
+                    st["next"] += 1
+            for seq in to_send:
+                send_one(seq)
+
+        def send_one(seq):
+            payload = chunks[seq]
+
+            def _acked(result, error, seq=seq):
+                if error is not None or result is None:
+                    utils.log_verbose(
+                        "accumulator %s: model sync to %s stopped at chunk "
+                        "%d/%d (%s); its re-request will resume",
+                        self._name, peer, seq, total, error,
+                    )
+                    _stop()
+                    return
+                k = int(result)
+                if k < 0 or self._group.sync_id() != epoch:
+                    _stop()
+                    return
+                if k >= total:
+                    _stop()
+                    utils.log_info(
+                        "accumulator %s: model sync to %s complete "
+                        "(version %s, %d chunks, %d B)",
+                        self._name, peer, version, total,
+                        sum(len(c) for c in chunks),
+                    )
+                    return
+                with self._lock:
+                    if k > st["acked"]:
+                        st["acked"] = k
+                    elif k < st["acked"]:
+                        # The receiver reset its buffer (sha changed under a
+                        # leader change) — rewind and restream from its
+                        # contiguous count.  A merely re-ordered ack rewinds
+                        # at most one window of duplicate sends, which the
+                        # receiver dedupes.
+                        st["acked"] = k
+                        st["next"] = min(st["next"], max(k, 0))
+                pump()
+
+            with self._lock:
+                self._model_sync_bytes_tx += len(payload)
+            _M_SYNC_CHUNKS.inc(direction="tx")
+            _M_SYNC_BYTES.inc(len(payload), direction="tx")
             self._rpc.async_callback(
-                peer,
-                "__accum_model_update",
-                lambda r, e: None,
-                self._name,
-                epoch,
-                version,
-                params,
-                buffers,
-                state,
+                peer, "__accum_model_chunk", _acked,
+                self._name, epoch, version, sha, seq, total, payload,
             )
+
+        pump()
+
+    def _on_model_chunk(self, epoch, version, sha, seq, total, payload):
+        """One model-sync chunk.  Returns the contiguous-chunk count as the
+        ack (the sender's next-seq), or -1 to abort a stale transfer.
+
+        The buffer is keyed by (version, sha) and deliberately SURVIVES
+        membership epochs: that is what makes a transfer interrupted by
+        leader death resumable — the new leader at the same version
+        continues from our acked count instead of restarting (ISSUE 3
+        tentpole b).  Only the final commit is epoch-stamped."""
+        with self._lock:
+            if self._epoch_synced and version <= self._model_version:
+                return -1  # already current; stop the sender's chain
+            t = self._in_transfer
+            if t is not None and (t["version"], t["sha"]) != (version, sha):
+                if version < t["version"]:
+                    # A dead leader's stale chain must not clobber progress
+                    # on a newer transfer.
+                    return -1
+                t = None  # newer version or sha mismatch: restart the buffer
+            if t is None or t["total"] != total:
+                t = self._in_transfer = {
+                    "version": version, "sha": sha, "total": total, "chunks": {},
+                }
+            if seq not in t["chunks"]:
+                t["chunks"][seq] = bytes(payload)
+                self._model_sync_bytes_rx += len(payload)
+                _M_SYNC_CHUNKS.inc(direction="rx")
+                _M_SYNC_BYTES.inc(len(payload), direction="rx")
+            k = 0
+            while k in t["chunks"]:
+                k += 1
+            if k < total:
+                return k
+            blob = b"".join(t["chunks"][i] for i in range(total))
+            try:
+                got_sha = hashlib.sha256(blob).hexdigest()[:16]
+                if got_sha != sha:
+                    # Chunks from two leaders with different chunk sizes can
+                    # share (version, sha, total) yet different boundaries;
+                    # the end-to-end digest is the authoritative check.
+                    raise ValueError(f"blob sha {got_sha} != advertised {sha}")
+                params, buffers, state = pickle.loads(blob)
+            except Exception as e:  # noqa: BLE001 — cross-leader byte drift
+                # The determinism assumption behind cross-leader resume
+                # failed (see _sync_chunks): drop the buffer; the next
+                # re-request restarts from chunk 0.
+                utils.log_error(
+                    "accumulator %s: model sync blob failed to decode (%r); "
+                    "restarting transfer", self._name, e,
+                )
+                self._in_transfer = None
+                return 0
+            # Staged like a monolithic push; commit (in update(), on the
+            # user thread) checks the epoch stamp.  The buffer is kept until
+            # the commit actually lands so a stale-epoch final chunk costs a
+            # one-chunk resend, not a full retransfer.
+            self._staged_model = (epoch, version, params, buffers, state)
+            return total
 
     def has_new_state(self) -> bool:
         return self._has_new_state
@@ -628,6 +901,7 @@ class Accumulator:
                 "jax adaptation: pass the gradient pytree explicitly, "
                 "reduce_gradients(batch_size, gradients)"
             )
+        self._rec_note_first_reduce()
         stats = {"num_gradients": 1, "num_skipped": 0, "batch_size": int(batch_size)}
         if self._ici_eligible():
             # ICI data plane: one synchronous XLA psum over the mesh; wire
@@ -673,6 +947,7 @@ class Accumulator:
 
     def skip_gradients(self) -> None:
         """Participate in this reduction round without contributing data."""
+        self._rec_note_first_reduce()
         stats = {"num_gradients": 0, "num_skipped": 1, "batch_size": 0}
         if self._ici_eligible():
             # The collective program must be identical on every process:
@@ -1171,6 +1446,7 @@ class Accumulator:
                     self._result_stats = dict(round_.stats)
                     self._result_epoch = self._group.sync_id()
                     self._has_gradients = True
+                    self._rec_note_first_result_locked()
                     _M_GRADIENTS.inc(round_.stats["num_gradients"])
                     _M_SKIPPED.inc(round_.stats["num_skipped"])
                     self._maybe_checksum_locked()
@@ -1208,6 +1484,7 @@ class Accumulator:
                 self._accum_grads = None
                 self._accum_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
                 self._has_gradients = True
+                self._rec_note_first_result_locked()
                 self._maybe_checksum_locked()
 
     def _maybe_checksum_locked(self) -> None:
@@ -1270,6 +1547,61 @@ class Accumulator:
 
         fut.add_done_callback(_done)
 
+    # -------------------------------------------------- recovery accounting
+    def _rec_mark_synced_locked(self) -> None:
+        """This epoch's model sync just completed (transfer commit, warm
+        rejoin, or becoming leader): close the model_sync phase."""
+        now = time.monotonic()
+        dt = now - self._rec_t_elect if self._rec_t_elect is not None else 0.0
+        self._rec_phases.setdefault("model_sync", dt)
+        telemetry.observe_phase("model_sync", dt)
+        if self._rec_t_synced is None:
+            self._rec_t_synced = now
+
+    def _rec_note_first_reduce(self) -> None:
+        """First gradient contribution call of this process: everything
+        between sync and here is the train loop getting ready — dominated
+        by XLA compile of its grad step (the compile cache's target)."""
+        with self._lock:
+            if self._rec_t_first_reduce is not None:
+                return
+            now = time.monotonic()
+            self._rec_t_first_reduce = now
+            if self._rec_t_synced is not None:
+                dt = now - self._rec_t_synced
+                self._rec_phases.setdefault("first_compile", dt)
+                telemetry.observe_phase("first_compile", dt)
+
+    def _rec_note_first_result_locked(self) -> None:
+        """First applied cohort gradient result: the peer is productive —
+        the restart recovery chain is complete."""
+        if "first_contribution" in self._rec_phases or self._rec_t_first_reduce is None:
+            return
+        dt = time.monotonic() - self._rec_t_first_reduce
+        self._rec_phases["first_contribution"] = dt
+        telemetry.observe_phase("first_contribution", dt)
+
+    def recovery_info(self) -> Dict[str, Any]:
+        """Where this peer's (re)start time went, phase by phase (docs/
+        RESILIENCE.md "Recovery budget").  ``complete`` turns True at the
+        first applied gradient result; soak harnesses persist this dict per
+        restarted peer so every run shows a per-phase breakdown."""
+        chain = (
+            "reconnect", "re_elect", "model_sync",
+            "first_compile", "first_contribution",
+        )
+        with self._lock:
+            phases = {k: round(v, 3) for k, v in self._rec_phases.items()}
+            complete = all(p in phases for p in chain)
+            return {
+                "phases_s": phases,
+                "complete": complete,
+                "total_s": round(sum(phases[p] for p in chain), 3) if complete else None,
+                "model_sync_bytes_rx": self._model_sync_bytes_rx,
+                "model_sync_bytes_tx": self._model_sync_bytes_tx,
+                "warm_rejoin": self._warm_rejoin,
+            }
+
     def gradients(self):
         """The cohort-averaged gradient pytree (valid while has_gradients())."""
         with self._lock:
@@ -1311,6 +1643,11 @@ class Accumulator:
                 "ici_eligible": eligible,
                 "wire_dtype": wire,
                 "reduce_bytes": dict(self._reduce_bytes),
+                "model_sync_bytes": {
+                    "rx": self._model_sync_bytes_rx,
+                    "tx": self._model_sync_bytes_tx,
+                },
+                "warm_rejoin": self._warm_rejoin,
                 # q8 over the chunked ring rides as contributor-side EF
                 # quantization + bf16 hop transport (set_chunked_allreduce).
                 "ring_q8_mode": (
@@ -1336,6 +1673,13 @@ class Accumulator:
                 self._model_version += 1
             else:
                 _M_STALE.inc()
+                # Params changed without a version bump: this peer must not
+                # claim to be "current" at its version — the next epoch's
+                # model sync (full transfer, never the warm fast path)
+                # reconverges it.  The leader-side chunk cache is keyed by
+                # version, so it no longer names these params either.
+                self._stale_applies += 1
+                self._sync_cache = None
                 utils.log_verbose(
                     "accumulator %s: consumed a result from a dead epoch; "
                     "model version not advanced",
@@ -1351,10 +1695,20 @@ class Accumulator:
         if self._standalone:
             self._group.update()
         now = time.monotonic()
+        leader_queries = []
         with self._lock:
             leader = self._leader
             is_leader = self._is_leader
             synced = self._epoch_synced
+            # Election repair: leaderless past the deadline on an active
+            # epoch — learn the result from a member / re-issue the vote.
+            if (
+                leader is None
+                and self._election_retry_at is not None
+                and now > self._election_retry_at
+                and self._group.active()
+            ):
+                leader_queries = self._retry_election_locked(now)
             # Time out ICI rounds stranded by a cohort member dying
             # mid-collective (the runtime rendezvous can hang forever).
             # Gated on the membership no longer matching the process set: a
@@ -1416,9 +1770,15 @@ class Accumulator:
                     if state is not None:
                         self._received_state = state
                         self._has_new_state = True
+                    if not self._epoch_synced:
+                        self._rec_mark_synced_locked()
                     self._epoch_synced = True
+                    self._stale_applies = 0  # leader's model adopted
+                    # The chunk buffer served its purpose; free it.
+                    self._in_transfer = None
                     synced = True
-                # else: staged under an epoch that died before commit — drop.
+                # else: staged under an epoch that died before commit — the
+                # chunk buffer (if any) stays for the resume re-request.
         if abort_send is not None:
             # Outside the lock: async sends must not nest under state the
             # RPC handlers need.
@@ -1428,16 +1788,46 @@ class Accumulator:
                     m, "__accum_ici_abort", lambda r, e: None,
                     self._name, self._rpc.get_name(), epoch, seq,
                 )
-        # Non-leader that hasn't synced this epoch: (re-)request the model.
+        for m, fn, cb, *qargs in leader_queries:
+            self._rpc.async_callback(m, fn, cb, *qargs)
+        # Non-leader that hasn't synced this epoch: (re-)request the model,
+        # advertising what we already hold — the checkpoint-restored version
+        # (warm rejoin skips the transfer entirely) and any partial chunk
+        # buffer (the new leader resumes from the last acked chunk).
         if leader is not None and not is_leader and not synced:
             if now - self._last_model_request > _MODEL_REQUEST_RETRY:
                 self._last_model_request = now
+                with self._lock:
+                    # The current-model fast path is ONLY for a freshly
+                    # (re)started peer advertising its checkpoint-restored
+                    # version — before its first sync in this process.  An
+                    # ESTABLISHED peer always takes the full transfer on an
+                    # epoch change: its params have been mutated by applied
+                    # rounds, and the full re-sync is the universal
+                    # divergence heal the elastic protocol is built on
+                    # (full-reset semantics).  Stale-epoch consumes
+                    # (_stale_applies) disqualify the fast path too.
+                    fresh_process = self._rec_t_synced is None
+                    have_version = (
+                        self._model_version
+                        if fresh_process and not self._stale_applies
+                        else -1
+                    )
+                    resume_version, resume_chunks = -1, 0
+                    t = self._in_transfer
+                    if t is not None:
+                        resume_version = t["version"]
+                        while resume_chunks in t["chunks"]:
+                            resume_chunks += 1
                 self._rpc.async_callback(
                     leader,
                     "__accum_request_model",
-                    lambda r, e: None,
+                    self._on_request_model_reply,
                     self._name,
                     self._rpc.get_name(),
+                    have_version,
+                    resume_version,
+                    resume_chunks,
                 )
         # Leader: periodic model/buffer pushes keep long-lived cohorts fresh.
         if is_leader and self._group.active():
@@ -1453,10 +1843,24 @@ class Accumulator:
         """Membership epoch changed: reset transient state, elect a leader
         (allreduce of max(model_version, name), reference :581-625)."""
         with self._lock:
+            now = time.monotonic()
+            self._rec_t_epoch = now
+            if self._rec_t_active is None and self._group.active():
+                # First membership epoch that includes this peer: the
+                # reconnect phase (broker dial + first push) is over.
+                self._rec_t_active = now
+                dt = now - self._rec_t_init
+                self._rec_phases.setdefault("reconnect", dt)
+                telemetry.observe_phase("reconnect", dt)
             self._leader = None
             self._is_leader = False
+            self._election_retry_at = None  # fresh epoch, fresh election
             self._epoch_synced = False
             self._staged_model = None
+            # Outbound chunk chains die with the epoch (their acks see the
+            # stale epoch and stop); unsynced peers re-request and resume.
+            self._active_transfers.clear()
+            self._sync_cache = None
             self._buffers_version = -1
             # Old-epoch rounds are dead; their futures error via the Group's
             # cancel, but the records must go now so new rounds can start.
@@ -1471,32 +1875,44 @@ class Accumulator:
             self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
             if not self._group.active():
                 return
+            epoch = self._group.sync_id()
             fut = self._group.all_reduce(
                 f"__accum_elect:{self._name}",
                 (self._model_version, self._rpc.get_name()),
                 op=lambda a, b: max(a, b),  # lexicographic (version, name)
             )
-            fut.add_done_callback(self._on_election_done)
+            fut.add_done_callback(
+                lambda f, e=epoch: self._on_election_done(f, e)
+            )
 
-    def _on_election_done(self, fut):
+    def _on_election_done(self, fut, epoch=None):
         exc = fut.exception()
         if exc is not None:
             utils.log_verbose("accumulator %s: election failed: %s", self._name, exc)
+            with self._lock:
+                if (
+                    self._leader is None
+                    and self._group.active()
+                    and (epoch is None or epoch == self._group.sync_id())
+                ):
+                    # Schedule the repair path (see __init__ / update()):
+                    # without it a timed-out election on a STABLE epoch
+                    # leaves this peer leaderless forever.  Epoch-guarded: a
+                    # dead epoch's election cancelled by a membership change
+                    # must not arm retries against the NEW epoch's election
+                    # (a spurious extra __accum_elect op would desync the
+                    # per-name op sequence across peers).
+                    self._election_retry_at = (
+                        time.monotonic() + self._election_retry_interval
+                    )
             return
         version, leader = fut.result(0)
         with self._lock:
-            self._leader = leader
-            self._is_leader = leader == self._rpc.get_name()
-            _M_ELECTIONS.inc()
-            _M_IS_LEADER.set(
-                1.0 if self._is_leader else 0.0,
-                accumulator=self._name,
-                peer=self._rpc.get_name(),
-            )
-            if self._is_leader:
-                self._epoch_synced = True
-                self._last_model_push = time.monotonic()
-            self._last_model_request = 0.0
+            if epoch is not None and epoch != self._group.sync_id():
+                return  # stale epoch's result (cancellation raced)
+            if self._leader is not None:
+                return  # repair path already adopted this epoch's result
+            self._adopt_leader_locked(leader, version)
         utils.log_info(
             "accumulator %s: leader=%s (version %s)%s",
             self._name,
@@ -1505,16 +1921,151 @@ class Accumulator:
             " [me]" if self._is_leader else "",
         )
 
+    def _adopt_leader_locked(self, leader: str, version) -> None:
+        """Install this epoch's election result (from our own allreduce or
+        learned from a member that completed it)."""
+        now = time.monotonic()
+        self._leader = leader
+        self._is_leader = leader == self._rpc.get_name()
+        self._election_retry_at = None
+        _M_ELECTIONS.inc()
+        _M_IS_LEADER.set(
+            1.0 if self._is_leader else 0.0,
+            accumulator=self._name,
+            peer=self._rpc.get_name(),
+        )
+        if self._rec_t_epoch is not None:
+            dt = now - self._rec_t_epoch
+            self._rec_phases.setdefault("re_elect", dt)
+            telemetry.observe_phase("re_elect", dt)
+        self._rec_t_elect = now
+        if self._is_leader:
+            if not self._epoch_synced:
+                self._rec_mark_synced_locked()
+            self._epoch_synced = True
+            self._in_transfer = None  # leading means our model IS the model
+            if self._stale_applies:
+                # Our params are exactly this many cohort results ahead of
+                # our version number (stale-epoch consumes).  Bump so the
+                # version names these bytes again — otherwise a clean peer
+                # still AT the old version would warm-skip the sync and the
+                # cohort would hold two byte strings under one version.
+                self._model_version += self._stale_applies
+                utils.log_info(
+                    "accumulator %s: new leader absorbing %d stale-epoch "
+                    "result(s) into version %d",
+                    self._name, self._stale_applies, self._model_version,
+                )
+                self._stale_applies = 0
+            self._last_model_push = now
+        self._last_model_request = 0.0
+
+    def _on_leader_query(self, epoch):
+        """A leaderless member asks for this epoch's election result.  Any
+        completed result is safe to share: the allreduce only completes
+        once EVERY member (including the asker) contributed its
+        ``(version, name)`` vote."""
+        with self._lock:
+            if epoch != self._group.sync_id() or self._leader is None:
+                return None
+            return (self._leader, self._model_version)
+
+    def _retry_election_locked(self, now: float):
+        """Leaderless past the retry deadline (update() pump): learn the
+        result from members that have it, and re-issue the election for
+        the case where the op died on everyone (then all leaderless peers
+        re-issue together, so the retry allreduce can complete)."""
+        self._election_retry_at = now + self._election_retry_interval
+        epoch = self._group.sync_id()
+        members = [m for m in self._group.members() if m != self._rpc.get_name()]
+        fut = self._group.all_reduce(
+            f"__accum_elect:{self._name}",
+            (self._model_version, self._rpc.get_name()),
+            op=lambda a, b: max(a, b),
+        )
+        fut.add_done_callback(lambda f, e=epoch: self._on_election_done(f, e))
+
+        def _learned(result, error, epoch=epoch):
+            if error is not None or result is None:
+                return
+            leader, version = result
+            with self._lock:
+                if epoch != self._group.sync_id() or self._leader is not None:
+                    return
+                self._adopt_leader_locked(leader, version)
+            utils.log_info(
+                "accumulator %s: leader=%s (version %s) [learned from a "
+                "member after a failed election]",
+                self._name, leader, version,
+            )
+
+        return [
+            (m, "__accum_leader_query", _learned, self._name, epoch)
+            for m in members
+        ]
+
     # --------------------------------------------------------- model service
-    def _on_request_model(self, requester: str):
-        """A peer asks for the model; queue it for wants_state()/set_state()
-        (the reference serves the queue when the user provides state)."""
+    def _on_request_model(self, requester: str, have_version: int = -1,
+                          resume_version: int = -1, resume_chunks: int = 0):
+        """A peer asks for the model, advertising the version it already
+        holds (``have_version``, e.g. from a warm-loaded checkpoint) and any
+        partial transfer buffer (``resume_version``/``resume_chunks``).
+
+        Warm rejoin: when the advertised version already matches the
+        leader's, the reply is ``("current", epoch, version)`` — the peer is
+        synced with ZERO model bytes on the wire and no wait for the user's
+        ``set_state`` call.  Otherwise the requester queues for
+        wants_state()/set_state() exactly like the reference."""
         with self._lock:
             if not self._is_leader:
                 raise RpcError(f"{self._rpc.get_name()} is not the leader")
-            if requester not in self._state_requesters:
-                self._state_requesters.append(requester)
-        return True
+            version = self._model_version
+            if version > 0 and have_version == version and not self._stale_applies:
+                # A restored peer at EXACTLY our version: nothing to
+                # transfer.  Strict equality — a requester somehow AHEAD of
+                # the leader must take the full transfer below (adopting
+                # the leader's model, full-reset semantics); confirming it
+                # "current" at a version it doesn't hold would leave it
+                # permanently unsynced (its reply handler checks equality).
+                # A STALE leader (params mutated without a version bump)
+                # must not confirm anyone either — its version number no
+                # longer names its bytes; the full transfer heals.
+                utils.log_info(
+                    "accumulator %s: warm rejoin of %s at version %s "
+                    "(zero model-sync bytes)", self._name, requester, version,
+                )
+                return ("current", self._group.sync_id(), version)
+            active = self._active_transfers.get(requester)
+            if active == (self._group.sync_id(), version):
+                # A chunk chain to this peer is already running under the
+                # current epoch; a periodic re-request must not fork a
+                # second one.
+                return ("queued",)
+            if not any(r[0] == requester for r in self._state_requesters):
+                self._state_requesters.append(
+                    (requester, int(have_version), int(resume_version),
+                     int(resume_chunks))
+                )
+        return ("queued",)
+
+    def _on_request_model_reply(self, result, error) -> None:
+        """Requester side of the warm-rejoin fast path: a ``current`` reply
+        synchronizes the epoch without any model transfer."""
+        if error is not None or not isinstance(result, (list, tuple)) or not result:
+            return
+        if result[0] != "current":
+            return
+        _, epoch, version = result
+        with self._lock:
+            if epoch != self._group.sync_id() or self._epoch_synced:
+                return
+            if version != self._model_version:
+                return  # raced a version change; the retry re-advertises
+            self._epoch_synced = True
+            self._in_transfer = None
+            self._warm_rejoin = True
+            _M_WARM_REJOINS.inc()
+            self._rec_mark_synced_locked()
 
     def _on_model_update(self, epoch, version: int, params, buffers, state):
         with self._lock:

@@ -229,6 +229,17 @@ class Checkpointer:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def latest_intact_step(self) -> Optional[int]:
+        """The newest step whose manifest verifies byte-for-byte — the step
+        ``restore()`` would actually load.  Warm-rejoin callers use this to
+        learn the version a restart will advertise (and chaos harnesses to
+        predict the resume point after a truncation) WITHOUT paying the
+        payload deserialization."""
+        for step in reversed(self.all_steps()):
+            if self._verify(self._step_path(step)) is None:
+                return step
+        return None
+
     # ------------------------------------------------------------- internals
     def _step_path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")

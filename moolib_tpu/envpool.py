@@ -401,6 +401,10 @@ class EnvRunner:
             env = self.envs.get((b, i))
             if env is None:
                 env = self.create_env()
+                # create_env may have pulled in jax (forkserver workers
+                # start jax-free): wire the compile cache before the env's
+                # first real step compiles anything.
+                _maybe_init_worker_compile_cache()
                 self.envs[(b, i)] = env
                 obs = _normalize_obs(_reset_env(env))
                 reward, done = 0.0, False
@@ -416,8 +420,19 @@ class EnvRunner:
             view["done"][i] = done
 
 
+def _maybe_init_worker_compile_cache() -> None:
+    """Persistent compile cache for jax-USING envs: a respawned worker
+    skips recompilation exactly like a restarted peer.  Strictly gated on
+    jax already being loaded in this worker (fork start inherits it; a
+    forkserver worker only loads it if create_env does): the common
+    jax-free env must never pay a jax import for a cache it cannot use."""
+    if "jax" in sys.modules:
+        utils.init_compile_cache()
+
+
 def _worker_main(create_env, worker_index, lo, hi, num_batches, conn, doorbells,
                  discover=False):
+    _maybe_init_worker_compile_cache()
     task_queue, done_sems, seg = _attach_doorbells(doorbells, worker_index)
     runner = EnvRunner(
         create_env, worker_index, lo, hi, num_batches, conn, task_queue,
@@ -763,7 +778,11 @@ class EnvPool:
         """Worker ``i`` died without an env traceback (SIGKILL, OOM, hard
         crash): respawn it onto the existing shm segments/doorbells and
         re-issue any in-flight batch steps it never completed, unless the
-        restart policy says the slot is beyond saving."""
+        restart policy says the slot is beyond saving.  The death-detected →
+        respawned-and-reissued interval lands in the shared
+        ``recovery_seconds{phase="worker_respawn"}`` histogram so worker and
+        peer recovery read off one metric family (docs/RESILIENCE.md)."""
+        t_detect = time.monotonic()
         p = self._procs[i]
         exitcode = p.exitcode
         policy = self._restart_policy
@@ -816,6 +835,7 @@ class EnvPool:
             for b in range(self._num_batches):
                 if st._inflight[b] is not None and self._progress[b, i] < self._targets[b]:
                     self._task_queues[i].put(b)
+        telemetry.observe_phase("worker_respawn", time.monotonic() - t_detect)
 
     def step(self, batch_index: int, action) -> EnvStepperFuture:
         if not 0 <= batch_index < self._num_batches:

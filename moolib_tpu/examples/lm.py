@@ -135,6 +135,10 @@ def make_flags(argv=None):
                    "wedged section dumps telemetry + thread stacks and "
                    "raises WatchdogTimeout so the finally-block checkpoint "
                    "still happens (docs/RESILIENCE.md)")
+    p.add_argument("--compile_cache_dir", default=None,
+                   help="persistent XLA compile cache directory (also "
+                   "MOOLIB_COMPILE_CACHE): restarts skip recompilation "
+                   "(docs/RESILIENCE.md recovery budget)")
     return common.finalize_flags(p, argv)
 
 
@@ -147,9 +151,12 @@ def make_batch(rng: np.random.Generator, flags):
 
 
 def train(flags, on_stats=None) -> dict:
-    from ..utils import apply_platform_env
+    from ..utils import apply_platform_env, init_compile_cache
 
     apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
+    # Before the first jit: restarts skip recompilation via the persistent
+    # cache (--compile_cache_dir / MOOLIB_COMPILE_CACHE; no-op when unset).
+    init_compile_cache(flags.compile_cache_dir)
     telemetry.init_from_env()  # opt-in exporters (docs/TELEMETRY.md)
     from ..testing import faults as _faults
 
@@ -424,6 +431,7 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
     loss_v = acc_v = None
     start = time.time()
     last_ckpt = start
+    recovery_printed = False  # one-shot per-phase breakdown line
     timer = StepTimer()  # registry-backed section breakdown
     wd = Watchdog(timeout=flags.watchdog, name="lm")
     # Whole-run deadman: fed on every optimizer step, so a run whose
@@ -465,6 +473,15 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                     acc.zero_gradients()
                 steps_done += 1
                 wd.feed(progress_token)
+                if not recovery_printed:
+                    rec = acc.recovery_info()
+                    if rec["complete"]:
+                        recovery_printed = True
+                        import json as _json
+
+                        # Chaos/soak harnesses parse this line to bound the
+                        # kill→contributing interval (docs/RESILIENCE.md).
+                        print(f"recovered: {_json.dumps(rec)}", flush=True)
                 if steps_done % flags.log_interval == 0:
                     if not flags.quiet:
                         print(

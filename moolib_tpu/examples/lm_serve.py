@@ -196,7 +196,17 @@ def main(argv=None):
         try:
             # serve() defines the queue and pre-compiles every bucket shape
             # BEFORE the readiness line prints: clients arriving at
-            # "serving" must never queue behind a startup compile.
+            # "serving" must never queue behind a startup compile.  The
+            # pre-compile line below is the harness's proof of life: a
+            # benchmark can tell "server is compiling (be patient)" from
+            # "server never came up" (serve_bench keys its two timeouts on
+            # exactly these two lines).
+            nbuckets = len(_bucket_shapes(flags.batch_size)) if not flags.no_dynamic_batching else 1
+            print(
+                f"precompiling {nbuckets} bucket shape(s) "
+                f"[platform={jax.devices()[0].platform}]",
+                flush=True,
+            )
             loop = serve(
                 rpc, model, params, flags.max_new_tokens, mesh=mesh,
                 batch_size=flags.batch_size,

@@ -126,6 +126,11 @@ def make_flags(argv=None):
         help="log stats to wandb when the package is installed (gated no-op "
         "otherwise — reference experiment.py:269-276 opt-in)",
     )
+    p.add_argument("--compile_cache_dir", default=None,
+                   help="persistent XLA compile cache directory (also "
+                   "MOOLIB_COMPILE_CACHE): a restarted peer skips "
+                   "recompilation — the dominant cold-restart cost the "
+                   "soak's recovery SLO budgets (docs/RESILIENCE.md)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--watchdog", type=float, default=0.0,
@@ -282,9 +287,12 @@ def load_checkpoint(path, target=None):
 
 
 def train(flags, on_stats=None) -> dict:
-    from ...utils import apply_platform_env
+    from ...utils import apply_platform_env, init_compile_cache
 
     apply_platform_env()
+    # Before the first jit: restarts must hit the persistent compile cache
+    # (--compile_cache_dir / MOOLIB_COMPILE_CACHE; no-op when neither set).
+    init_compile_cache(flags.compile_cache_dir)
     # Opt-in exporters (MOOLIB_TELEMETRY_* env knobs, docs/TELEMETRY.md):
     # Prometheus /metrics endpoint, JSONL snapshots, SIGUSR1 dumps.
     tele = telemetry.init_from_env()
@@ -482,6 +490,11 @@ def train(flags, on_stats=None) -> dict:
             os.path.join(flags.localdir, "logs.tsv"),
             metadata={"train_id": flags.train_id, "env": flags.env},
         )
+    # One-shot per incarnation: the per-phase recovery breakdown
+    # (reconnect/re_elect/model_sync/first_compile/first_contribution) lands
+    # in <localdir>/recovery.json once the chain completes — the soak
+    # harness aggregates these into its summary (docs/RESILIENCE.md).
+    recovery_written = False
     wandb_run = None
     if flags.wandb:
         try:
@@ -648,6 +661,17 @@ def train(flags, on_stats=None) -> dict:
                         {k: v[-1] for k, v in unroll.items()}
                     )
                 cur = (cur + 1) % flags.num_actor_batches
+
+            if not recovery_written and flags.localdir:
+                rec = accumulator.recovery_info()
+                if rec["complete"]:
+                    recovery_written = True
+                    import json as _json
+
+                    with open(os.path.join(flags.localdir, "recovery.json"), "w") as f:
+                        _json.dump(rec, f, indent=1)
+                    if not flags.quiet:
+                        print(f"recovered: {_json.dumps(rec)}", flush=True)
 
             if now - last_log > flags.log_interval:
                 last_log = now

@@ -65,9 +65,19 @@ from .exporters import (  # noqa: F401
     serve_http,
 )
 from .cohort import CohortCounters  # noqa: F401
+from .recovery import (  # noqa: F401
+    RECOVERY_BUCKETS,
+    RECOVERY_PHASES,
+    observe_phase,
+    recovery_histogram,
+)
 
 __all__ = [
     "CohortCounters",
+    "RECOVERY_BUCKETS",
+    "RECOVERY_PHASES",
+    "observe_phase",
+    "recovery_histogram",
     "Counter",
     "Gauge",
     "Histogram",

@@ -14,6 +14,7 @@ from typing import Optional
 
 from . import nest  # noqa: F401
 from .stats import RunningMeanStd, StatMean, StatSum  # noqa: F401
+from .compile_cache import compile_cache_dir, init_compile_cache  # noqa: F401
 
 # ---------------------------------------------------------------------------
 # uid / naming  (reference: randomName(), src/util.h — 16 hex chars)

@@ -54,12 +54,19 @@ def free_port() -> int:
     return port
 
 
+CACHE_DIR = ""  # set in main(): shared persistent compile cache for children
+
+
 def child_env(faults: str = "") -> dict:
     env = dict(
         os.environ,
         PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
         JAX_PLATFORMS="cpu",
     )
+    if CACHE_DIR:
+        # Respawned/relaunched children skip recompilation — the recovery
+        # bound below budgets model re-sync, not XLA compile time.
+        env["MOOLIB_COMPILE_CACHE"] = CACHE_DIR
     if faults:
         env["MOOLIB_FAULTS"] = faults
     else:
@@ -184,36 +191,68 @@ def lm_args(flags, steps, ckpt_dir, port=None, connect=None, watchdog=120.0,
     return args
 
 
+def recovered_line(log_path: str):
+    """The one-shot per-phase recovery breakdown a rejoining peer prints
+    once it is contributing again (``recovered: {...}``), or None."""
+    try:
+        with open(log_path) as f:
+            m = re.search(r"^recovered: (\{.*\})", f.read(), re.M)
+        return m.group(1) if m else None
+    except OSError:
+        return None
+
+
 def phase_cohort(flags, plan, workdir: str) -> int:
-    """2-peer elastic lm under RPC chaos; peer B dies mid-run; A must still
-    reach its target step count.  Returns A's target step count."""
-    log("phase 2: 2-peer elastic lm; kill peer B mid-run")
+    """2-peer elastic lm under RPC chaos; peer B dies mid-run and is
+    RESPAWNED: the rejoiner must be contributing again (its ``recovered:``
+    per-phase line) within ``--recovery_bound_s`` — the warm-rejoin SLO —
+    and A must still reach its target step count.  Returns that target."""
+    log("phase 2: 2-peer elastic lm; kill + respawn peer B mid-run")
     port = free_port()
     ckpt_dir = os.path.join(workdir, "ckpt")
     faults = f"seed={plan.seed},rpc_drop={flags.rpc_drop},rpc_dup={flags.rpc_dup}"
     a_log = os.path.join(workdir, "peerA.log")
     b_log = os.path.join(workdir, "peerB.log")
-    target = flags.steps
+    b2_log = os.path.join(workdir, "peerB_respawn.log")
+    # A's target is stretched: it must outlive B's kill AND the respawned
+    # B's whole recovery (jax start + rejoin + model sync + first step) so
+    # the broker it hosts stays up while the recovery bound is measured.
+    target = flags.steps * 3
     a = spawn_lm(lm_args(flags, target, ckpt_dir, port=port, name="peerA"),
                  a_log, faults)
     b = spawn_lm(lm_args(flags, target, None, connect=port, name="peerB"),
                  b_log, faults)
+    b2 = None
     deadline = time.monotonic() + flags.phase_deadline
     try:
         # Let the cohort make some progress, then kill B.
-        wait_for(lambda: logged_steps(a_log) and logged_steps(a_log)[-1] >= target // 3,
+        wait_for(lambda: logged_steps(a_log) and logged_steps(a_log)[-1] >= flags.steps // 3,
                  deadline, "waiting for early progress", procs=(a,))
         if b.poll() is None:
             plan.kill_process(b)
             log(f"killed peer B (pid {b.pid}) at step "
                 f"~{logged_steps(a_log)[-1]} of {target}")
+        # Respawn B; its rejoin is SLO-gated: kill-to-contributing must fit
+        # --recovery_bound_s (compile cache + chunked model sync do the
+        # heavy lifting; docs/RESILIENCE.md "Recovery budget").
+        t_respawn = time.monotonic()
+        b2 = spawn_lm(lm_args(flags, target, None, connect=port, name="peerB2"),
+                      b2_log, faults)
+        rec_deadline = min(deadline, t_respawn + flags.recovery_bound_s)
+        rec = wait_for(lambda: recovered_line(b2_log), rec_deadline,
+                       f"waiting for respawned peer B to recover "
+                       f"(bound {flags.recovery_bound_s:.0f}s)", procs=(a, b2))
+        took = time.monotonic() - t_respawn
+        log(f"respawned peer B contributing after {took:.1f}s "
+            f"(bound {flags.recovery_bound_s:.0f}s): {rec}")
         rc = a.wait(timeout=max(5.0, deadline - time.monotonic()))
         if rc != 0:
             dump_tail(a_log)
             raise SystemExit(f"FAIL: peer A exited rc={rc}")
         steps = logged_steps(a_log)
         assert steps and steps[-1] >= target - 10, steps[-10:]
-        log(f"phase 2 OK (peer A reached step {steps[-1]}/{target} without B)")
+        log(f"phase 2 OK (peer A reached step {steps[-1]}/{target}; "
+            f"B recovered in {took:.1f}s)")
         return target
     except subprocess.TimeoutExpired:
         dump_tail(a_log)
@@ -221,6 +260,8 @@ def phase_cohort(flags, plan, workdir: str) -> int:
     finally:
         kill_tree(a)
         kill_tree(b)
+        if b2 is not None:
+            kill_tree(b2)
 
 
 def phase_kill_resume(flags, plan, workdir: str, reached: int) -> None:
@@ -251,9 +292,8 @@ def phase_kill_resume(flags, plan, workdir: str, reached: int) -> None:
 
     victim = plan.truncate_checkpoint(ckpt_dir)
     log(f"truncated newest checkpoint payload: {victim}")
-    intact = [s for s in ck.all_steps() if ck.verify(s)]
-    assert intact, "no intact checkpoint left"
-    expect_resume = max(intact)
+    expect_resume = ck.latest_intact_step()
+    assert expect_resume is not None, "no intact checkpoint left"
 
     final_log = os.path.join(workdir, "peerA_final.log")
     target = expect_resume + 30
@@ -290,6 +330,10 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint_interval", type=float, default=1.0)
     ap.add_argument("--rpc_drop", type=float, default=0.02)
     ap.add_argument("--rpc_dup", type=float, default=0.01)
+    ap.add_argument("--recovery_bound_s", type=float, default=None,
+                    help="respawned-peer rejoin SLO: kill-to-contributing "
+                    "seconds (default 60 smoke / 90 full; "
+                    "docs/RESILIENCE.md recovery budget)")
     ap.add_argument("--phase_deadline", type=float, default=None,
                     help="per-phase wall deadline, seconds")
     ap.add_argument("--workdir", default=None)
@@ -297,15 +341,25 @@ def main(argv=None) -> int:
     if flags.steps is None:
         flags.steps = 60 if flags.smoke else 300
     if flags.phase_deadline is None:
-        flags.phase_deadline = 120.0 if flags.smoke else 600.0
+        flags.phase_deadline = 150.0 if flags.smoke else 600.0
+    if flags.recovery_bound_s is None:
+        flags.recovery_bound_s = 60.0 if flags.smoke else 90.0
 
     import tempfile
 
     from moolib_tpu.testing import FaultPlan
 
+    global CACHE_DIR
     workdir = flags.workdir or tempfile.mkdtemp(prefix="chaos_soak_")
+    # An operator/CI-provided cache dir wins: ci.sh points every run at one
+    # shared directory so cross-run warmth keeps first_compile inside the
+    # recovery bound; only fall back to a per-run cache when unset.
+    CACHE_DIR = os.environ.get("MOOLIB_COMPILE_CACHE") or os.path.join(
+        workdir, "jax_cache"
+    )
     plan = FaultPlan(flags.seed)
-    log(f"seed={flags.seed} workdir={workdir} steps={flags.steps}")
+    log(f"seed={flags.seed} workdir={workdir} steps={flags.steps} "
+        f"recovery_bound={flags.recovery_bound_s:.0f}s")
     phase_envpool(plan)
     reached = phase_cohort(flags, plan, workdir)
     phase_kill_resume(flags, plan, workdir, reached)

@@ -57,10 +57,18 @@ step "fault-domain supervision tests (envpool respawn, watchdog, checkpoint inte
 python -m pytest tests/test_envpool_supervision.py tests/test_watchdog.py \
   tests/test_checkpoint_corrupt.py -q || fail=1
 
-step "chaos soak (seeded, ~60 s smoke: worker/peer kills, RPC frame chaos, forced-kill resume)"
-# Exits non-zero if any phase stalls past its watchdog/deadline
-# (docs/RESILIENCE.md).
-python scripts/chaos_soak.py --smoke || fail=1
+step "warm-rejoin plane tests (chunked model sync resume, compile cache)"
+python -m pytest tests/test_accumulator_rejoin.py tests/test_compile_cache.py \
+  -q || fail=1
+
+step "chaos soak (seeded, ~80 s smoke: worker/peer kills + respawn SLO, RPC frame chaos, forced-kill resume)"
+# Exits non-zero if any phase stalls past its watchdog/deadline, or the
+# respawned peer misses its recovery bound (docs/RESILIENCE.md recovery
+# budget).  The shared compile cache below is what keeps the respawn's
+# first_compile phase inside the bound — the soak exercises the same
+# mechanism production restarts rely on.
+MOOLIB_COMPILE_CACHE="${TMPDIR:-/tmp}/moolib_ci_jax_cache" \
+  python scripts/chaos_soak.py --smoke --recovery_bound_s 60 || fail=1
 
 step "sanitizer matrix (skips where the runtime is missing)"
 python -m pytest tests/test_native_sanitizers.py -q || fail=1

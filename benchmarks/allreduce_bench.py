@@ -7,127 +7,253 @@ modes:
 
 - ``rpc``: N peers + broker (single process by default, or one rank per
   process via WORLD_SIZE/RANK/BROKER_ADDR env vars like the reference)
-  running the elastic binary-tree allreduce over loopback/DCN.
+  running the elastic binary-tree allreduce over loopback/DCN.  The tree
+  rows ride the flat-bucket data plane (zero-copy serialization, in-place
+  combine, memfd-multicast share — docs/DESIGN.md "Gradient data plane");
+  ``--legacy`` adds rows on the old per-leaf path for comparison.
 - ``ici``: jitted ``psum`` over every local device — the TPU data plane the
   reference never had. On one chip this measures HBM-loopback; on a slice
   it measures real ICI collective bandwidth.
 
+Timing: one untimed warmup op per row (first use compiles codecs, dials
+transport upgrades, faults fresh buffers), then the MEDIAN of per-iteration
+wall times — so bucket-size sweeps compare medians, not means skewed by a
+cold first iteration.
+
+Knobs: ``--bucket_bytes N`` sets the flat-bucket size for the sweep (0 =
+payload-sized buckets: one bucket per op, the loopback single-core optimum;
+production multi-core hosts pipeline with the 4 MiB default).  ``--wire
+q8`` adds int8-compressed rows.  ``--grad_tree`` shapes each payload as a
+transformer-like gradient pytree instead of one flat array (exercises the
+tree-flatten staging path).  Non-legacy tree rows run the Accumulator's
+``owned=True`` contract (in-place folds, read-only memfd-adopted result
+views — the gradient data plane as trained code exercises it);
+``--no_owned`` measures the copying public default.  ``--smoke`` runs a
+fast correctness pass (bucketed vs legacy vs owned vs numpy reference,
+tree + ring + q8) and prints a loopback bandwidth line — scripts/ci.sh
+runs it.
+
 Prints one line per size: elements, MB, milliseconds, MB/s (bytes, not the
-reference's ambiguous "M/s" element count).
+reference's ambiguous "M/s" element count).  max_peer_tx counts LOGICAL
+per-peer payload bytes (a memfd-multicast share writes those bytes once but
+accounts them on every receiver's connection).
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import statistics
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def bench_rpc(args):
-    from moolib_tpu import Broker, Group, Rpc
 
-    world_size = int(os.environ.get("WORLD_SIZE", args.world_size))
-    rank = os.environ.get("RANK")
-    broker_addr = os.environ.get("BROKER_ADDR", args.broker_addr)
+def _grad_tree(rng, size):
+    """A transformer-ish gradient pytree with ~``size`` total f32 elements
+    (a few big matrices, some vectors) — the tree-flatten staging workload."""
+    leaves = {}
+    remaining = size
+    i = 0
+    while remaining > 0:
+        if remaining > 4096:
+            side = int(min(np.sqrt(remaining // 2), 2048))
+            n = side * side
+            leaves[f"w{i}"] = rng.standard_normal(n).astype(np.float32).reshape(side, side)
+        else:
+            n = remaining
+            leaves[f"b{i}"] = rng.standard_normal(n).astype(np.float32)
+        remaining -= n
+        i += 1
+    return leaves
 
-    if rank is None:
-        # Single-process cohort (the reference's loopback test pattern).
-        broker = Broker()
-        broker.set_name("broker")
-        broker.listen(broker_addr)
-        peers = []
-        for i in range(world_size):
+
+def _tree_elems(t):
+    return sum(int(np.asarray(l).size) for l in t.values()) if isinstance(t, dict) else t.size
+
+
+class _Cohort:
+    """N peers + broker on loopback (or one rank per process)."""
+
+    def __init__(self, args):
+        from moolib_tpu import Broker, Group, Rpc
+
+        world_size = int(os.environ.get("WORLD_SIZE", args.world_size))
+        rank = os.environ.get("RANK")
+        broker_addr = os.environ.get("BROKER_ADDR", args.broker_addr)
+        self.world_size = world_size
+        self.broker = None
+        self.peers = []
+        if rank is None:
+            # Single-process cohort (the reference's loopback test pattern).
+            self.broker = Broker()
+            self.broker.set_name("broker")
+            self.broker.listen(broker_addr)
+            for i in range(world_size):
+                rpc = Rpc()
+                rpc.set_name(f"rank{i}")
+                # Bare ":0" listens on TCP *and* an auto unix socket, so
+                # same-host peers discover the ipc listener and big frames
+                # ride memfd.
+                rpc.listen(":0")
+                rpc.connect(broker_addr)
+                g = Group(rpc, "bench")
+                g.set_timeout(60)
+                self.peers.append((rpc, g))
+        else:
+            # Multi-process/multi-host mode (the reference's env-var pattern,
+            # test/test_multinode_allreduce.cc:155-181): one process per
+            # rank, rank 0 hosts the broker.  Every rank runs the same rows.
+            rank = int(rank)
+            if rank == 0:
+                self.broker = Broker()
+                self.broker.set_name("broker")
+                host, _, port = broker_addr.rpartition(":")
+                self.broker.listen(
+                    f":{port}" if host in ("", "127.0.0.1", "0.0.0.0") else broker_addr
+                )
             rpc = Rpc()
-            rpc.set_name(f"rank{i}")
-            # Bare ":0" listens on TCP *and* an auto unix socket, so same-host
-            # peers discover the ipc listener and big frames ride memfd.
+            rpc.set_name(f"rank{rank}")
             rpc.listen(":0")
             rpc.connect(broker_addr)
             g = Group(rpc, "bench")
-            g.set_timeout(60)
-            peers.append((rpc, g))
-        pump = lambda: (broker.update(), [g.update() for _, g in peers])
-        groups = [g for _, g in peers]
-    else:
-        # Multi-process/multi-host mode (the reference's env-var pattern,
-        # test/test_multinode_allreduce.cc:155-181): one process per rank,
-        # WORLD_SIZE/RANK set, rank 0 hosts the broker.  Every rank runs the
-        # same rows; each prints its own table (rank 0's is the record).
-        rank = int(rank)
-        broker = None
-        if rank == 0:
-            broker = Broker()
-            broker.set_name("broker")
-            host, _, port = broker_addr.rpartition(":")
-            broker.listen(f":{port}" if host in ("", "127.0.0.1", "0.0.0.0") else broker_addr)
-        rpc = Rpc()
-        rpc.set_name(f"rank{rank}")
-        rpc.listen(":0")
-        rpc.connect(broker_addr)
-        g = Group(rpc, "bench")
-        g.set_timeout(120)
-        peers = [(rpc, g)]
-        groups = [g]
+            g.set_timeout(120)
+            self.peers.append((rpc, g))
+        self.groups = [g for _, g in self.peers]
 
-        def pump():
-            if broker is not None:
-                broker.update()
+    def pump(self):
+        if self.broker is not None:
+            self.broker.update()
+        for g in self.groups:
             g.update()
 
-    def converged():
-        return all(
-            g.active() and len(g.members()) == world_size for g in groups
+    def converge(self):
+        deadline = time.time() + 120
+        ok = lambda: all(  # noqa: E731
+            g.active() and len(g.members()) == self.world_size for g in self.groups
         )
+        while not ok() and time.time() < deadline:
+            self.pump()
+            time.sleep(0.01)
+        assert ok(), f"cohort never converged: {[g.members() for g in self.groups]}"
 
-    deadline = time.time() + 120
-    while not converged() and time.time() < deadline:
-        pump()
-        time.sleep(0.01)
-    assert converged(), f"cohort never converged: {[g.members() for g in groups]}"
+    def wait(self, futs):
+        """Event-driven wait: block on the first pending future's event (the
+        IO engines complete ops on their own threads) with a short timeout
+        so the broker ping / timeout sweep keeps running."""
+        while True:
+            pending = [f for f in futs if not f.done()]
+            if not pending:
+                return
+            self.pump()
+            try:
+                pending[0].wait(0.003)
+            except TimeoutError:
+                pass
 
-    def wait(futs):
-        # Throttled pumping: the IO engines and reduce math run on their own
-        # threads; a busy pump() loop would starve them of the core.
-        while not all(f.done() for f in futs):
-            pump()
-            time.sleep(0.002)
+    def close(self):
+        for rpc, _ in self.peers:
+            rpc.close()
+        if self.broker is not None:
+            self.broker.close()
 
-    def run_rows(algo: str):
+
+def _allreduce_kwargs(algo, wire, legacy, owned=True):
+    kw = {}
+    if algo == "ring":
+        kw["chunked"] = True
+        if wire:
+            kw["wire"] = wire
+    else:
+        kw["chunked"] = False
+        if legacy:
+            kw["bucketed"] = False
+        else:
+            # The gradient data plane's contract: the Accumulator hands its
+            # staged flats over with owned=True (folds may accumulate in
+            # place, results may be read-only adopted views) — that is what
+            # unlocks the memfd-adopt zero-copy share terminus the headline
+            # number measures.  --no_owned measures the copying public
+            # default instead.
+            if owned:
+                kw["owned"] = True
+            if wire:
+                kw["bucketed"] = True
+                kw["wire"] = wire
+        # else: auto (bucketed above MOOLIB_BUCKET_THRESHOLD)
+    return kw
+
+
+def bench_rpc(args):
+    import moolib_tpu.buckets as buckets
+
+    if args.bucket_bytes == 0:
+        # Payload-sized buckets: one bucket per op.  On a single-core
+        # loopback box the per-bucket pipeline cannot overlap, so the
+        # fixed per-bucket cost is pure loss; production multi-core hosts
+        # use the 4 MiB default for staging/wire overlap.
+        buckets.set_bucket_bytes(1 << 31)
+        bucket_note = "payload-sized"
+    else:
+        buckets.set_bucket_bytes(args.bucket_bytes)
+        bucket_note = f"{args.bucket_bytes} B"
+
+    cohort = _Cohort(args)
+    cohort.converge()
+    peers, groups = cohort.peers, cohort.groups
+    rng = np.random.default_rng(0)
+
+    def run_rows(algo: str, wire=None, legacy=False):
         # chunked= forces the path: the auto rule (Group.ring_auto) would
         # keep a same-host loopback cohort on the tree, and the bench's job
         # is to measure BOTH algorithms wherever it runs.
-        chunked = algo == "ring"
+        mode = f"{algo}{'+q8' if wire == 'q8' else ''}{' legacy' if legacy else ''}"
+        shape = "grad-tree" if args.grad_tree else "flat array"
+        contract = "owned" if (not legacy and not args.no_owned) else "copying"
         print(
-            f"# rpc {algo} allreduce, {world_size} peers, loopback "
-            f"(max_peer_tx = busiest peer's wire bytes per op; the ring "
-            f"spreads load evenly, the tree root serializes ~2x payloads)"
+            f"# rpc {mode} allreduce, {cohort.world_size} peers, loopback, "
+            f"{shape}, buckets={bucket_note}, {contract} contract "
+            f"(max_peer_tx = busiest peer's LOGICAL payload bytes per op; "
+            f"memfd-multicast shares write them once)"
         )
         print(f"{'elems':>10} {'MB':>8} {'ms':>9} {'MB/s':>10} {'max_peer_tx_MB':>15}")
+        kw = _allreduce_kwargs(algo, wire, legacy, owned=not args.no_owned)
         for size in args.sizes:
-            # One array per local peer (multi-process mode has exactly one).
-            data = [np.random.randn(size).astype(np.float32) for _ in peers]
-            futs = [g.all_reduce("w" + algo, d, chunked=chunked) for g, d in zip(groups, data)]
-            wait(futs)  # warmup round
+            if args.grad_tree:
+                data = [_grad_tree(rng, size) for _ in peers]
+            else:
+                data = [rng.standard_normal(size).astype(np.float32) for _ in peers]
+            futs = [
+                g.all_reduce("w" + mode, d, **kw) for g, d in zip(groups, data)
+            ]
+            cohort.wait(futs)  # warmup op: codec compiles, transport upgrades
             before = [rpc.transport_stats()["tx_bytes"] for rpc, _ in peers]
-            t0 = time.perf_counter()
+            times = []
             for _ in range(args.iters):
-                futs = [g.all_reduce("x" + algo, d, chunked=chunked) for g, d in zip(groups, data)]
-                wait(futs)
+                t0 = time.perf_counter()
+                futs = [
+                    g.all_reduce("x" + mode, d, **kw) for g, d in zip(groups, data)
+                ]
+                cohort.wait(futs)
                 for f in futs:
                     f.result(0)
-            dt = (time.perf_counter() - t0) / args.iters
+                times.append(time.perf_counter() - t0)
+            # Median-of-iters: a straggler iteration (GC pause, page-cache
+            # churn) must not skew a bucket-size sweep.
+            dt = statistics.median(times)
             after = [rpc.transport_stats()["tx_bytes"] for rpc, _ in peers]
             local_max = max(a - b for a, b in zip(after, before)) / args.iters / 1e6
             # The busiest-PEER number must span the whole cohort: in
             # multi-process mode each process sees only its own counters, so
             # max-allreduce the local figure (tiny scalar, tree path).
             mfuts = [
-                g.all_reduce(f"tx{algo}{size}", local_max, op=lambda a, b: max(a, b))
+                g.all_reduce(f"tx{mode}{size}", local_max, op=lambda a, b: max(a, b))
                 for g in groups
             ]
-            wait(mfuts)
+            cohort.wait(mfuts)
             max_tx = max(f.result(0) for f in mfuts)
             mb = size * 4 / 1e6
             print(
@@ -136,12 +262,87 @@ def bench_rpc(args):
 
     run_rows("tree")
     run_rows("ring")
+    if args.wire in ("q8", "both"):
+        run_rows("tree", wire="q8")
+        run_rows("ring", wire="q8")
+    if args.legacy:
+        run_rows("tree", legacy=True)
     # Exit barrier: no rank tears down while another is mid-row.
-    wait([g.all_reduce("bye", 1) for g in groups])
-    for rpc, _ in peers:
-        rpc.close()
-    if broker is not None:
-        broker.close()
+    cohort.wait([g.all_reduce("bye", 1) for g in groups])
+    cohort.close()
+
+
+def bench_smoke(args):
+    """Fast correctness pass for CI: bucketed tree/ring/q8 results must
+    match the legacy path and a numpy reference; prints one bandwidth line.
+
+    Bit-exactness is asserted on integer-valued f32 payloads (exact in any
+    summation order); random payloads additionally assert cross-peer BIT
+    IDENTITY (all peers decode the same root bytes) and closeness to the
+    reference (fold order between tree siblings is arrival-order, exactly
+    like the legacy tree)."""
+    import moolib_tpu.buckets as buckets
+
+    args.world_size = min(args.world_size, 4)
+    cohort = _Cohort(args)
+    cohort.converge()
+    groups = cohort.groups
+    rng = np.random.default_rng(7)
+    n = 200_000
+    ints = [rng.integers(-1000, 1000, n).astype(np.float32) for _ in groups]
+    ref = np.sum(np.stack(ints), axis=0, dtype=np.float64).astype(np.float32)
+    fails = []
+
+    def check(tag, futs, tol=0.0, expect=None):
+        cohort.wait(futs)
+        outs = [np.asarray(f.result(0)) for f in futs]
+        for o in outs[1:]:
+            if o.tobytes() != outs[0].tobytes():
+                fails.append(f"{tag}: peers disagree bit-wise")
+                return outs
+        e = ref if expect is None else expect
+        if tol == 0.0:
+            if not np.array_equal(outs[0], e):
+                fails.append(f"{tag}: not bit-exact vs reference")
+        elif not np.allclose(outs[0], e, atol=tol):
+            fails.append(f"{tag}: out of tolerance {tol}")
+        return outs
+
+    # Bucketed tree, bit-exact vs numpy reference (integer-valued f32).
+    check("tree-bucketed", [g.all_reduce("sa", d, bucketed=True) for g, d in zip(groups, ints)])
+    # Owned contract (the Accumulator's): in-place folds + read-only
+    # memfd-adopted result views must produce the same bits.  Inputs are
+    # copies — owned=True lets the op accumulate into them.
+    check("tree-owned", [g.all_reduce("sa2", d.copy(), bucketed=True, owned=True)
+                         for g, d in zip(groups, ints)])
+    # Legacy tree must agree bit-for-bit with the same reference.
+    check("tree-legacy", [g.all_reduce("sb", d, bucketed=False, chunked=False)
+                          for g, d in zip(groups, ints)])
+    # Ring (bucket-aligned chunks).
+    check("ring", [g.all_reduce("sc", d, chunked=True,
+                                chunk_align=buckets.bucket_bytes() // 4)
+                   for g, d in zip(groups, ints)])
+    # q8 wire: quantization tolerance, plus cross-peer bit identity.
+    tol = max(np.abs(d).max() for d in ints) / 127 * (len(groups) + 1)
+    check("tree-q8", [g.all_reduce("sd", d, bucketed=True, wire="q8")
+                      for g, d in zip(groups, ints)], tol=tol)
+    # Throughput one-liner (tree, 4 MB payload).
+    big = [rng.standard_normal(1_000_000).astype(np.float32) for _ in groups]
+    futs = [g.all_reduce("sw", d, owned=True) for g, d in zip(groups, big)]
+    cohort.wait(futs)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        futs = [g.all_reduce("sx", d, owned=True) for g, d in zip(groups, big)]
+        cohort.wait(futs)
+    dt = (time.perf_counter() - t0) / 3
+    print(f"smoke: loopback {cohort.world_size}-peer tree 4MB: {4.0/dt:.0f} MB/s")
+    cohort.wait([g.all_reduce("bye", 1) for g in groups])
+    cohort.close()
+    if fails:
+        for f in fails:
+            print("SMOKE FAIL:", f)
+        raise SystemExit(1)
+    print("smoke: bucketed/owned/legacy/ring/q8 allreduce results verified")
 
 
 def bench_ici(args):
@@ -187,13 +388,17 @@ def bench_ici(args):
                 out_specs=P("dp"),
             )(x)
 
+        # Warm up once (compile + first dispatch), then median-of-iters —
+        # the old mean-of-total silently absorbed a slow first iteration.
         out = allreduce(x)
         jax.block_until_ready(out)
-        t0 = time.perf_counter()
+        times = []
         for _ in range(args.iters):
+            t0 = time.perf_counter()
             out = allreduce(x)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / args.iters
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        dt = statistics.median(times)
         mb = size * 4 / 1e6
         print(f"{size:>10} {mb:>8.2f} {dt*1e3:>9.2f} {mb/dt:>10.1f}")
 
@@ -205,13 +410,34 @@ def main(argv=None):
     p.add_argument("--broker_addr", default="127.0.0.1:4499")
     p.add_argument("--iters", type=int, default=5)
     p.add_argument(
+        "--bucket_bytes", type=int, default=0,
+        help="flat-bucket size for the sweep; 0 = payload-sized (single "
+        "bucket per op, the single-core loopback optimum)",
+    )
+    p.add_argument("--wire", choices=["none", "q8", "both"], default="none",
+                   help="add int8-compressed rows")
+    p.add_argument("--grad_tree", action="store_true",
+                   help="payloads shaped as a transformer-like gradient "
+                   "pytree instead of one flat array")
+    p.add_argument("--no_owned", action="store_true",
+                   help="measure the copying owned=False public default "
+                        "instead of the Accumulator's owned=True contract "
+                        "(in-place folds, read-only adopted result views)")
+    p.add_argument("--legacy", action="store_true",
+                   help="add rows on the legacy per-leaf tree path")
+    p.add_argument("--smoke", action="store_true",
+                   help="fast correctness pass (CI): bucketed vs legacy vs "
+                   "numpy reference, then one bandwidth line")
+    p.add_argument(
         "--sizes",
         type=int,
         nargs="+",
         default=[400, 10_000, 100_000, 1_000_000, 2_621_440],
     )
     args = p.parse_args(argv)
-    if args.mode == "rpc":
+    if args.smoke:
+        bench_smoke(args)
+    elif args.mode == "rpc":
         bench_rpc(args)
     else:
         bench_ici(args)
@@ -219,3 +445,5 @@ def main(argv=None):
 
 if __name__ == "__main__":
     main()
+
+

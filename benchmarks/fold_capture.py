@@ -213,7 +213,70 @@ def parse_roofline(path):
     return None
 
 
+def parse_allreduce(path):
+    """allreduce_bench rpc stdout: '#' banner lines + fixed-width data rows
+    (and the --smoke mode's 'smoke:' lines).  Anything else — warnings,
+    tracebacks riding 2>&1 — is dropped."""
+    try:
+        with open(path) as f:
+            txt = f.read()
+    except OSError:
+        return None
+    keep = re.compile(r"^(#|smoke:|\s*elems\s|\s*\d+\s)")
+    lines = [l for l in txt.splitlines() if l.strip() and keep.match(l)]
+    return lines if any(re.match(r"\s*\d+\s", l) for l in lines) else None
+
+
+def fold_local(log_path, json_path):
+    """Merge a fresh allreduce_bench capture into BENCH_LOCAL.json: only the
+    ``allreduce_rpc`` section's stdout is replaced; every other section
+    (rpc, envpool, agent, ...) is preserved verbatim — same row-preservation
+    policy as the BENCH_TPU merges above."""
+    if os.path.exists(json_path):
+        # A corrupt record must ABORT, not be clobbered (curated history).
+        with open(json_path) as f:
+            data = json.load(f)
+    else:
+        data = {}
+    lines = parse_allreduce(log_path)
+    if not lines:
+        raise SystemExit(f"no allreduce rows found in {log_path}")
+    sec = dict(data.get("allreduce_rpc", {}))
+    sec.setdefault("cmd", "benchmarks/allreduce_bench.py rpc")
+    sec["rc"] = 0
+    sec["stdout"] = lines
+    sec["stderr"] = []
+    try:
+        sec["captured_when"] = datetime.date.fromtimestamp(
+            os.path.getmtime(log_path)
+        ).isoformat()
+    except OSError:
+        sec["captured_when"] = datetime.date.today().isoformat()
+    data["allreduce_rpc"] = sec
+    tmp = f"{json_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, json_path)
+    print(f"folded allreduce rows -> {json_path} (allreduce_rpc; other sections preserved)")
+
+
 def main():
+    if len(sys.argv) >= 2 and sys.argv[1] == "--local":
+        # fold_capture.py --local <allreduce_log> [bench_local_json]
+        if len(sys.argv) < 3:
+            raise SystemExit(
+                "usage: fold_capture.py --local <allreduce_log> [bench_local_json]"
+            )
+        log = sys.argv[2]
+        out = (
+            sys.argv[3]
+            if len(sys.argv) > 3
+            else os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                              "BENCH_LOCAL.json")
+        )
+        fold_local(log, out)
+        return
     if len(sys.argv) < 2:
         # Required: defaulting to a round-suffixed dir would silently re-fold
         # stale artifacts after the round advances (the battery always passes

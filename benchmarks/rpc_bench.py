@@ -66,7 +66,7 @@ def run_one(backend: str, port: int) -> dict:
     # Same-host path: a fresh ipc://-only pair, where large frames ride
     # memfd + SCM_RIGHTS between native peers (zero socket-buffer copies) —
     # the bench delta vs the TCP number above IS the zero-copy win.
-    ipc_gbs = memfd = None
+    ipc_gbs = memfd = gradtree_gbs = None
     sock = f"/tmp/moolib_bench_{os.getpid()}.sock"
     try:
         host2, client2 = Rpc(), Rpc()
@@ -83,6 +83,20 @@ def run_one(backend: str, port: int) -> dict:
             client2.sync("host", "echo", arr)
         dt = (time.perf_counter() - t0) / iters
         ipc_gbs = 2 * arr.nbytes / dt / 1e9
+        # Gradient-tree-shaped payload (many out-of-band array leaves, the
+        # accumulator's wire shape): measures the serializer's per-leaf
+        # overhead on top of raw byte throughput.
+        rng = np.random.default_rng(1)
+        tree = {f"w{i}": rng.random((256, 512), np.float32) for i in range(60)}
+        tree["bias"] = rng.random(4096, np.float32)
+        nbytes = sum(a.nbytes for a in tree.values())  # ~31.5 MB
+        for _ in range(2):
+            client2.sync("host", "echo", tree)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            client2.sync("host", "echo", tree)
+        dt = (time.perf_counter() - t0) / iters
+        gradtree_gbs = 2 * nbytes / dt / 1e9
         if client2._net is not None:
             memfd = client2._net.memfd_sends
         host2.close()
@@ -103,6 +117,8 @@ def run_one(backend: str, port: int) -> dict:
     if ipc_gbs is not None:
         out["echo_64mb_ipc_gb_per_s"] = round(ipc_gbs, 3)
         out["ipc_memfd_frames"] = memfd
+    if gradtree_gbs is not None:
+        out["echo_gradtree_32mb_ipc_gb_per_s"] = round(gradtree_gbs, 3)
     return out
 
 

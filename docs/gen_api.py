@@ -29,6 +29,7 @@ MODULES = [
     ("moolib_tpu.broker", "Broker"),
     ("moolib_tpu.group", "Group / AllReduce"),
     ("moolib_tpu.accumulator", "Accumulator"),
+    ("moolib_tpu.buckets", "Flat-bucket gradient data plane"),
     ("moolib_tpu.envpool", "EnvPool"),
     ("moolib_tpu.batcher", "Batcher"),
     ("moolib_tpu.replay", "Replay"),

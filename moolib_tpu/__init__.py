@@ -23,6 +23,7 @@ __all__ = [
     "AllReduce",
     "Batcher",
     "Broker",
+    "buckets",
     "EnvPool",
     "EnvRunner",
     "EnvStepper",
@@ -62,6 +63,12 @@ _LAZY = {
 
 
 def __getattr__(name):  # lazy imports keep `import moolib_tpu` light
+    if name == "buckets":  # flat-bucket gradient data plane (submodule)
+        import importlib
+
+        value = importlib.import_module(".buckets", __name__)
+        globals()[name] = value
+        return value
     mod_name = _LAZY.get(name)
     if mod_name is None:
         raise AttributeError(f"module 'moolib_tpu' has no attribute {name!r}")

@@ -47,7 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from . import telemetry, utils
+from . import buckets, telemetry, utils
 from .utils import nest
 from .group import Group
 from .rpc import Rpc, RpcError
@@ -104,6 +104,30 @@ _M_WARM_REJOINS = _REG.counter(
     "accum_warm_rejoins_total",
     "restarts whose checkpoint-restored version matched the leader: synced "
     "with zero model-sync bytes",
+)
+# Flat-bucket gradient data plane (docs/DESIGN.md "Gradient data plane"):
+# per-round bucket counts/bytes, staging (tree-flatten -> flat buffer) time,
+# and how long device-to-host transfer ran overlapped with staging.
+_M_BUCKET_ROUNDS = _REG.counter(
+    "accum_bucket_rounds_total", "gradient rounds shipped via flat buckets",
+    ("plane",),
+)
+_M_BUCKETS = _REG.counter(
+    "accum_buckets_total", "flat buckets shipped (one sub-op each)", ("plane",)
+)
+_M_BUCKET_BYTES = _REG.counter(
+    "accum_bucket_bytes_total",
+    "flat-bucket payload bytes contributed (post-compression, at send time)",
+    ("plane",),
+)
+_M_BUCKET_FILL = _REG.histogram(
+    "accum_bucket_fill_seconds",
+    "gradient tree -> flat bucket staging (copy-in, dtype convert, EF-q8)",
+)
+_M_D2H_OVERLAP = _REG.histogram(
+    "accum_d2h_overlap_seconds",
+    "device-to-host transfer time overlapped with bucket staging (async "
+    "copy_to_host issued for every leaf before the first bucket fills)",
 )
 
 _MODEL_PUSH_INTERVAL = 600.0  # reference: regular model broadcast every 600 s
@@ -302,6 +326,12 @@ class Accumulator:
         # cohort-wide: it is derived from config + the synced model only.
         self._chunked_allreduce: Optional[bool] = None
         self._ring_size_cache: Optional[int] = None
+        # Flat-bucket data plane (docs/DESIGN.md "Gradient data plane"):
+        # layout cache per (treedef, shapes, dtype) — flattening happens
+        # once per model shape, every round reuses the layout and the
+        # refcount-guarded buffer pool in moolib_tpu.buckets.
+        self._flat_layouts: Dict = {}
+        self._bucketed = True  # False = legacy per-leaf dict payloads
         # Debug checksums (reference src/accumulator.cc:324-370): verify the
         # applied gradient result is bit-identical cohort-wide per round.
         self._debug_checksums = False
@@ -575,6 +605,211 @@ class Accumulator:
         return jax.tree_util.tree_map(
             lambda p: np.broadcast_to(np.float32(0.0), p.shape), self._params
         )
+
+    def set_bucketed_allreduce(self, enabled: bool = True) -> None:
+        """Route RPC-plane gradient rounds through the flat-bucket data
+        plane (default ON): the gradient tree is flattened once per
+        (treedef, shapes, dtype) into fixed-size buckets backed by reusable
+        host buffers, each bucket rides the tree/ring as its own pipelined
+        op, and EF-q8 runs once, vectorized on the flat buffer.  Must be set
+        identically on every peer (the payload layout is wire protocol);
+        ``False`` restores the legacy per-leaf dict payloads.  Bucket size:
+        ``moolib_tpu.buckets.set_bucket_bytes`` / ``MOOLIB_BUCKET_BYTES``."""
+        self._bucketed = bool(enabled)
+
+    @staticmethod
+    def _leaf_spec(leaf):
+        """(shape, dtype) of a gradient leaf WITHOUT forcing a device
+        transfer (jax arrays carry both as attributes)."""
+        s = getattr(leaf, "shape", None)
+        d = getattr(leaf, "dtype", None)
+        if s is None or d is None:
+            a = np.asarray(leaf)
+            return a.shape, a.dtype
+        return tuple(s), np.dtype(d)
+
+    def _flat_layout(self, treedef, shapes, dtype):
+        key = (treedef, tuple(shapes), np.dtype(dtype).str, buckets.bucket_bytes())
+        layout = self._flat_layouts.get(key)
+        if layout is None:
+            layout = buckets.BucketLayout(shapes, dtype)
+            self._flat_layouts[key] = layout
+        return layout
+
+    def _flat_stage_dtype(self, treedef, specs, ring: bool,
+                          keep_existing: bool = False):
+        """Staging dtype for the flat-bucket path, or None when the tree is
+        not flat-eligible (mixed leaf dtypes without wire compression).
+        Compressed wire — and the ring, matching its legacy contract —
+        accumulates in f32: the true leaf dtypes are recorded in
+        ``_grad_dtypes`` for the restore (skip rounds keep an existing
+        record, set by the round whose gradients they stand in for)."""
+        if ring or self._wire_dtype is not None:
+            if not (keep_existing and self._grad_dtypes is not None):
+                self._grad_dtypes = jax.tree_util.tree_unflatten(
+                    treedef, [d for _, d in specs]
+                )
+            return np.float32
+        dtypes = {d for _, d in specs}
+        if len(dtypes) != 1:
+            return None
+        return dtypes.pop()
+
+    def _stage_flat(self, gradients, ring: bool):
+        """Flatten a gradient pytree into a pooled flat host buffer.
+
+        Returns ``(flat, layout, treedef)`` or None when the tree is not
+        flat-eligible (see ``_flat_stage_dtype`` — those rounds keep the
+        legacy per-leaf payload, bit-identical to before).
+        Device leaves start their D2H transfer asynchronously for EVERY leaf
+        before the first bucket fills, so transfer overlaps staging (and the
+        staged buckets then overlap the wire via per-bucket ops).  Leaves
+        copy into the flat buffer exactly once — dtype conversion is fused
+        into that copy.  EF-q8 runs here, once, on the flat buffer with one
+        flat residual (see buckets.ef_quantize_flat)."""
+        leaves, treedef = jax.tree_util.tree_flatten(gradients)
+        if not leaves:
+            return None
+        specs = [self._leaf_spec(l) for l in leaves]
+        stage_dtype = self._flat_stage_dtype(treedef, specs, ring)
+        if stage_dtype is None:
+            return None
+        t0 = time.monotonic()
+        d2h = 0
+        for leaf in leaves:
+            # jax.Array: start the device-to-host copy now; np.asarray in
+            # fill() then completes from the landed buffer.
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+                d2h += 1
+        t_fill = time.monotonic()
+        layout = self._flat_layout(treedef, [s for s, _ in specs], stage_dtype)
+        flat = buckets.lease(layout.total, stage_dtype)
+        layout.fill(flat, leaves)
+        if self._wire_q8:
+            residual = self._q_residual if isinstance(self._q_residual, np.ndarray) else None
+            self._q_residual = buckets.ef_quantize_flat(flat, residual, layout.bounds)
+        now = time.monotonic()
+        # fill = pure host staging (copy-in + q8); d2h_overlap = the window
+        # from the first async copy issue to fill completion, during which
+        # the transfers ran hidden under staging (fill blocks per leaf, so
+        # every transfer has landed by `now`).
+        _M_BUCKET_FILL.observe(now - t_fill)
+        if d2h:
+            _M_D2H_OVERLAP.observe(now - t0)
+        return flat, layout, treedef
+
+    def _stage_flat_skip(self, ring: bool):
+        """Skip-round layout from the parameter tree (gradient trees match
+        the param tree by construction — the same assumption the ring
+        template relies on).  Returns ``(None, layout, treedef)`` or None
+        when params are not flat-eligible."""
+        leaves, treedef = jax.tree_util.tree_flatten(self._params)
+        if not leaves:
+            return None
+        specs = [self._leaf_spec(l) for l in leaves]
+        stage_dtype = self._flat_stage_dtype(treedef, specs, ring, keep_existing=True)
+        if stage_dtype is None:
+            return None
+        return None, self._flat_layout(treedef, [s for s, _ in specs], stage_dtype), treedef
+
+    def _start_flat_round(self, kind: str, stats: Dict[str, int], staged,
+                          use_ring: bool, fire_stats=None) -> None:
+        """Issue one flat-bucket gradient round on the RPC plane (tree
+        buckets or bucket-aligned ring chunks).  ``staged`` is the
+        ``(flat, layout, treedef)`` from ``_stage_flat``/``_stage_flat_skip``."""
+        flat, layout, treedef = staged
+        with self._lock:
+            if kind == "full":
+                # Direct contributions obey the wants_gradients contract;
+                # fire ("grad") rounds are issued by the drain itself and
+                # bypass the guards exactly like the legacy fire path.
+                if not self.connected():
+                    utils.log_verbose(
+                        "accumulator %s: dropping gradient contribution (not connected)",
+                        self._name,
+                    )
+                    buckets.release(flat)
+                    return
+                if len(self._inflight) >= self._parallel_gradients:
+                    buckets.release(flat)
+                    raise RpcError(
+                        f"{len(self._inflight)} gradient reductions already in flight "
+                        f"(parallel_gradients={self._parallel_gradients})"
+                    )
+                if self._has_gradients:
+                    buckets.release(flat)
+                    raise RpcError("unconsumed gradients; call zero_gradients() first")
+            template = None
+            if flat is None:
+                template = np.broadcast_to(
+                    np.zeros((), layout.dtype), (layout.total,)
+                )
+            if use_ring:
+                wire = self._ring_wire_locked()
+                fut = self._group.all_reduce(
+                    f"__accum_grad:{self._name}", flat, op="sum",
+                    meta=dict(stats), meta_op=_count_reduce_op,
+                    wire=wire, chunked=True, chunk_align=layout.bucket_elems,
+                    template=template, owned=True,
+                )
+            else:
+                if self._wire_q8:
+                    wire = "q8"
+                elif self._wire_dtype is not None:
+                    wire = np.dtype(self._wire_dtype).name
+                else:
+                    wire = None
+                fut = self._group.all_reduce(
+                    f"__accum_grad:{self._name}", flat, op="sum",
+                    meta=dict(stats), meta_op=_count_reduce_op,
+                    wire=wire, bucketed=True, template=template, owned=True,
+                )
+            round_ = _Round(fut, kind=kind, stats=fire_stats)
+            if flat is not None:
+                item = 1 if wire == "q8" else (
+                    np.dtype(wire).itemsize if wire else layout.dtype.itemsize
+                )
+                nb = layout.total * item
+                self._reduce_bytes["rpc"] += nb
+                _M_REDUCE_BYTES.inc(nb, plane="rpc")
+                _M_BUCKET_BYTES.inc(nb, plane="rpc")
+            _M_BUCKET_ROUNDS.inc(plane="rpc")
+            _M_BUCKETS.inc(layout.n_buckets, plane="rpc")
+            self._inflight.append(round_)
+            # The ring holds chunk views of the staged flat; recycle it when
+            # the round resolves (tree rounds recycle inside the group's
+            # bucket machinery, which took ownership via owned=True).
+            fut.add_done_callback(
+                lambda f, r=round_, td=treedef, lo=layout,
+                fl=(flat if use_ring else None):
+                    self._on_flat_round_done(r, f, td, lo, fl)
+            )
+
+    def _on_flat_round_done(self, round_, fut, treedef, layout, release_flat=None):
+        """Adapter: a flat round resolves to ``(flat_or_None, meta)``;
+        unflatten (views, no copy) and normalize into the payload-dict shape
+        the drain logic consumes."""
+        err = fut.exception()
+        buckets.release(release_flat)
+        norm = None
+        if err is None:
+            value, meta = fut.result(0)
+            grads = None
+            if value is not None:
+                flat = np.asarray(value)
+                grads = jax.tree_util.tree_unflatten(treedef, layout.unflatten(flat))
+            norm = {"grads": grads, "wire": None}
+            norm.update(meta)
+        with self._lock:
+            round_.done = True
+            round_.error = err
+            round_.result = norm
+            if err is None:
+                _M_REDUCE_LATENCY.observe(
+                    time.monotonic() - round_.t0, plane=round_.plane
+                )
+            self._drain_rounds_locked()
 
     def set_ici_backend(self, enabled: bool = True) -> None:
         """Reduce gradients with an XLA collective over the device mesh (ICI
@@ -911,7 +1146,8 @@ class Accumulator:
             return
         if self._virtual_batch_size is not None:
             # Remember the true dtypes so gradients() can restore them (local
-            # accumulation is in f32).
+            # accumulation is in f32).  np.asarray is a no-copy view when the
+            # leaf is already host f32; only genuine dtype changes copy.
             self._grad_dtypes = jax.tree_util.tree_map(
                 lambda g: np.asarray(g).dtype, gradients
             )
@@ -920,7 +1156,19 @@ class Accumulator:
             )
             self._start_round("count", stats, local)
             return
-        if self._use_ring_locked():
+        use_ring = self._use_ring_locked()
+        if self._bucketed:
+            # Flat-bucket data plane (docs/DESIGN.md "Gradient data plane"):
+            # one staging pass into a pooled flat buffer (D2H issued async
+            # per leaf, dtype convert fused into the copy, EF-q8 once on the
+            # flat buffer), then per-bucket pipelined tree ops or
+            # bucket-aligned ring chunks.
+            staged = self._stage_flat(gradients, ring=use_ring)
+            if staged is not None:
+                self._start_flat_round("full", stats, staged, use_ring)
+                return
+            # Mixed leaf dtypes without wire compression: legacy payload.
+        if use_ring:
             # Ring path: contribute f32 (EF-quantized at the source when the
             # wire is int8); bf16/f32 hop transport lives in the ring codec.
             self._grad_dtypes = jax.tree_util.tree_map(
@@ -939,9 +1187,12 @@ class Accumulator:
         if self._wire_q8:
             gradients, self._q_residual = _quantize_q8(gradients, self._q_residual)
         elif self._wire_dtype is not None:
-            wd = self._wire_dtype
+            wd = np.dtype(self._wire_dtype)
+            # Skip the cast copy when a leaf is already in the wire dtype.
             gradients = jax.tree_util.tree_map(
-                lambda g: np.asarray(g).astype(wd), gradients
+                lambda g, _wd=wd: g if getattr(g, "dtype", None) == _wd
+                else np.asarray(g).astype(_wd),
+                gradients,
             )
         self._start_round("full", stats, gradients)
 
@@ -959,8 +1210,15 @@ class Accumulator:
             self._ici_round(stats, zeros)
             return
         if self._virtual_batch_size is not None:
-            kind = "count"
-        elif self._use_ring_locked():
+            self._start_round("count", stats, None)
+            return
+        use_ring = self._use_ring_locked()
+        if self._bucketed:
+            staged = self._stage_flat_skip(use_ring)
+            if staged is not None:
+                self._start_flat_round("full", stats, staged, use_ring)
+                return
+        if use_ring:
             kind = "ring_full"
             if self._grad_dtypes is None:
                 # Ring results come back f32; restore to the param dtypes
@@ -1294,7 +1552,25 @@ class Accumulator:
         peer reaches this decision at the same count-round index (the count
         results are identical cohort-wide), so the op sequence matches."""
         grads = self._fire_accum
-        if self._use_ring_locked():
+        use_ring = self._use_ring_locked()
+        if self._bucketed:
+            # Flat-bucket fire: the locally-accumulated f32 sum stages into
+            # the flat buffer (EF-q8 once, on the flat) and ships as
+            # per-bucket pipelined ops; counts settled in phase 1 ride as
+            # zeros (protocol uniformity, like the legacy paths below).
+            staged = (
+                self._stage_flat(grads, ring=use_ring)
+                if grads is not None
+                else self._stage_flat_skip(use_ring)
+            )
+            if staged is not None:
+                zero = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+                fire_stats = dict(self._fire_stats)
+                self._fire_accum = None
+                self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+                self._start_flat_round("grad", zero, staged, use_ring, fire_stats=fire_stats)
+                return
+        if use_ring:
             # Phase 2 over the chunked ring: the accumulated f32 sum ships
             # directly (EF-quantized at the source when the wire is int8);
             # counts were settled in phase 1 so the meta rides as zeros
@@ -1326,8 +1602,10 @@ class Accumulator:
             if self._wire_q8:
                 grads, self._q_residual = _quantize_q8(grads, self._q_residual)
             elif self._wire_dtype is not None:
-                wd = self._wire_dtype
-                grads = jax.tree_util.tree_map(lambda g: g.astype(wd), grads)
+                wd = np.dtype(self._wire_dtype)
+                grads = jax.tree_util.tree_map(
+                    lambda g, _wd=wd: g if g.dtype == _wd else g.astype(_wd), grads
+                )
         payload = {
             "grads": grads,
             "num_gradients": 0,
@@ -1439,7 +1717,7 @@ class Accumulator:
                 if rg is not None:
                     if self._grad_dtypes is not None:
                         self._result_grads = jax.tree_util.tree_map(
-                            lambda x, dt: (x / n).astype(dt), rg, self._grad_dtypes
+                            lambda x, dt: (x / n).astype(dt, copy=False), rg, self._grad_dtypes
                         )
                     else:
                         self._result_grads = jax.tree_util.tree_map(lambda x: x / n, rg)
@@ -1469,7 +1747,7 @@ class Accumulator:
                     # set whenever leaves were converted on the way in (wire
                     # compression or the ICI f32 staging).
                     self._result_grads = jax.tree_util.tree_map(
-                        lambda x, dt: (np.asarray(x, np.float32) / n).astype(dt),
+                        lambda x, dt: (np.asarray(x, np.float32) / n).astype(dt, copy=False),
                         self._accum_grads,
                         self._grad_dtypes,
                     )
@@ -1648,6 +1926,11 @@ class Accumulator:
                     "tx": self._model_sync_bytes_tx,
                 },
                 "warm_rejoin": self._warm_rejoin,
+                # Flat-bucket data plane: enabled flag + the bucket size the
+                # layouts were built with (wire protocol — must match
+                # cohort-wide, docs/DESIGN.md "Gradient data plane").
+                "bucketed": self._bucketed,
+                "bucket_bytes": buckets.bucket_bytes(),
                 # q8 over the chunked ring rides as contributor-side EF
                 # quantization + bf16 hop transport (set_chunked_allreduce).
                 "ring_q8_mode": (

@@ -1,0 +1,211 @@
+"""Flat-bucket gradient data plane: layout, reusable host buffers, flat EF-q8.
+
+The gradient reduce path (docs/DESIGN.md "Gradient data plane") flattens a
+gradient pytree once per (treedef, shapes, dtype) into fixed-size contiguous
+**buckets** — slices of one flat host buffer — and ships each bucket through
+the Group's tree/ring machinery as an independent in-flight op.  This module
+owns the three pure building blocks:
+
+- :class:`BucketLayout`: the deterministic flat layout (leaf offsets + bucket
+  boundaries) for a list of leaf shapes and one dtype.  Derived only from
+  shapes/dtype/bucket size, so every process with the same model computes the
+  same layout — the layout is wire protocol (each bucket is its own allreduce
+  op; peers must agree on bucket count and boundaries).
+- a **flat buffer pool** (:func:`lease`/:func:`release`): preallocated,
+  reusable host staging buffers.  Reuse is refcount-guarded: a buffer whose
+  memory is still referenced outside the pool (e.g. pinned by an in-flight
+  zero-copy send, or visible to the user through result views) is never
+  handed out again — it is simply dropped and freed by the GC when the last
+  reference dies.  Reuse is an optimization, never a correctness assumption.
+- :func:`ef_quantize_flat`: error-feedback int8 quantization applied ONCE,
+  vectorized on the flat buffer with a single flat residual — per-bucket
+  absmax scales, grid values written back in place so the wire codec's
+  per-hop q8 encode reproduces the exact same ints at the first hop (the
+  quantization happens exactly once, at the source, where the residual
+  lives).
+
+Bucket size defaults to 4 MiB and is configured process-wide with
+``MOOLIB_BUCKET_BYTES`` or :func:`set_bucket_bytes`; like the ring threshold
+it must be set identically on every peer (bucket boundaries are part of the
+op protocol).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import utils
+
+_DEFAULT_BUCKET_BYTES = 4 << 20
+
+_bucket_bytes = int(os.environ.get("MOOLIB_BUCKET_BYTES", _DEFAULT_BUCKET_BYTES))
+
+
+def bucket_bytes() -> int:
+    """Current flat-bucket size in bytes (default 4 MiB,
+    ``MOOLIB_BUCKET_BYTES``).  Must match on every peer of a cohort."""
+    return _bucket_bytes
+
+
+def set_bucket_bytes(n: int) -> None:
+    """Set the flat-bucket size (process-wide).  Pacing/pipelining only at
+    equal settings — but the value IS wire protocol across a cohort: every
+    peer must use the same size, like ``MOOLIB_RING_THRESHOLD``."""
+    global _bucket_bytes
+    if int(n) < 1:
+        raise ValueError("bucket size must be >= 1 byte")
+    _bucket_bytes = int(n)
+
+
+class BucketLayout:
+    """Deterministic flat layout of a list of array leaves in one dtype.
+
+    ``offsets[i]`` is leaf i's element offset into the flat buffer (leaves
+    are packed back to back in tree-flatten order); ``bounds[k]`` is bucket
+    k's ``(start, stop)`` element range.  Buckets are fixed-size element
+    ranges of the flat buffer — a leaf may span bucket boundaries; that is
+    what makes the layout a function of (shapes, dtype, bucket_bytes) alone.
+    """
+
+    __slots__ = (
+        "shapes", "sizes", "offsets", "total", "dtype", "bucket_elems",
+        "n_buckets", "bounds",
+    )
+
+    def __init__(self, shapes: Sequence[Tuple[int, ...]], dtype,
+                 bucket_bytes_: Optional[int] = None):
+        self.dtype = np.dtype(dtype)
+        self.shapes = tuple(tuple(int(d) for d in s) for s in shapes)
+        self.sizes = tuple(
+            int(np.prod(s, dtype=np.int64)) if s else 1 for s in self.shapes
+        )
+        offs, off = [], 0
+        for n in self.sizes:
+            offs.append(off)
+            off += n
+        self.offsets = tuple(offs)
+        self.total = off
+        bb = bucket_bytes() if bucket_bytes_ is None else int(bucket_bytes_)
+        self.bucket_elems = max(1, bb // self.dtype.itemsize)
+        if self.total == 0:
+            self.n_buckets = 1
+            self.bounds = ((0, 0),)
+        else:
+            self.n_buckets = -(-self.total // self.bucket_elems)
+            self.bounds = tuple(
+                (k * self.bucket_elems, min((k + 1) * self.bucket_elems, self.total))
+                for k in range(self.n_buckets)
+            )
+
+    def signature(self) -> tuple:
+        """Process-independent identity of this layout (the golden-layout
+        test asserts two processes at the same model produce equal ones)."""
+        return (self.dtype.str, self.bucket_elems, self.total, self.shapes)
+
+    def fill(self, flat: np.ndarray, leaves: Sequence) -> None:
+        """Copy ``leaves`` into ``flat`` in layout order — exactly one pass,
+        dtype conversion fused into the copy (no per-leaf staging array)."""
+        for off, n, leaf in zip(self.offsets, self.sizes, leaves):
+            src = np.asarray(leaf)
+            np.copyto(flat[off:off + n], src.reshape(-1), casting="unsafe")
+
+    def unflatten(self, flat: np.ndarray) -> List[np.ndarray]:
+        """Leaf views (no copy) into ``flat`` in layout order."""
+        return [
+            flat[off:off + n].reshape(s)
+            for off, n, s in zip(self.offsets, self.sizes, self.shapes)
+        ]
+
+
+# --------------------------------------------------------------------- pool
+# Freelist of flat staging/result buffers keyed by (elements, dtype).  A
+# popped buffer is handed out only when the freelist held the LAST reference
+# (refcount probe): a buffer still pinned by an in-flight zero-copy send, or
+# still visible through result views, fails the probe and is dropped instead
+# of recycled — the GC frees it once the external references die.
+_POOL_CAP = 16
+_pool_lock = threading.Lock()
+_pool: Dict[Tuple[int, str], List[np.ndarray]] = {}
+
+
+def lease(total: int, dtype) -> np.ndarray:
+    """A flat 1-d buffer of ``total`` elements of ``dtype`` — recycled from
+    the pool when an exclusively-held one is available, else fresh.
+
+    Buffers are released back EAGERLY (at round completion) and may still be
+    aliased at that point — by a pinned zero-copy send, or by result views
+    the user holds; such entries stay in the freelist untouched until their
+    external references die (the refcount probe skips them), so reuse is
+    opportunistic and never aliases live memory."""
+    key = (int(total), np.dtype(dtype).str)
+    with _pool_lock:
+        free = _pool.get(key)
+        if free:
+            for i in range(len(free) - 1, -1, -1):
+                arr = free[i]
+                # refs: freelist slot + `arr` local + getrefcount's argument
+                # == 3 when the pool holds the only reference.
+                if sys.getrefcount(arr) == 3:
+                    del free[i]
+                    return arr
+    return np.empty(int(total), np.dtype(dtype))
+
+
+def release(arr: Optional[np.ndarray]) -> None:
+    """Offer a buffer back to the pool (bounded; excess is dropped).  Views
+    are ignored (only base buffers recycle); double releases of one object
+    are inert (the extra freelist slot inflates its refcount past the
+    exclusivity probe, so it is never handed out twice)."""
+    if arr is None or not isinstance(arr, np.ndarray) or arr.base is not None:
+        return
+    key = (arr.size, arr.dtype.str)
+    with _pool_lock:
+        free = _pool.setdefault(key, [])
+        if len(free) < _POOL_CAP and not any(a is arr for a in free):
+            free.append(arr)
+
+
+# ------------------------------------------------------------------- EF-q8
+def ef_quantize_flat(flat: np.ndarray, residual: Optional[np.ndarray],
+                     bounds: Sequence[Tuple[int, int]]) -> np.ndarray:
+    """Error-feedback int8 quantization, once, on the flat buffer.
+
+    For each bucket ``(s, e)``: fold the carried residual in, quantize with
+    one absmax scale per bucket, write the dequantized GRID values back into
+    ``flat`` in place, and store the new rounding error in ``residual``.
+    Handing the grid values (exact multiples of the bucket scale) to the
+    wire codec means the first per-hop q8 encode reproduces the identical
+    int8 payload — quantization noise enters exactly once, at the source,
+    where the EF residual lives (the EF-SGD contract; hops re-round partial
+    sums without residuals, same as the legacy per-leaf tree path).
+
+    A non-finite bucket (loss-scale overflow) contributes zero this round
+    and resets its residual slice, so one bad step can't poison error
+    feedback forever.  Returns the (possibly freshly allocated) residual.
+    """
+    if residual is None or residual.shape != flat.shape:
+        residual = np.zeros_like(flat)
+    for s, e in bounds:
+        if e <= s:
+            continue
+        f = flat[s:e]
+        r = residual[s:e]
+        np.add(f, r, out=f)
+        amax = float(np.max(np.abs(f)))
+        if amax == 0.0 or not np.isfinite(amax):
+            if amax != 0.0:
+                utils.log_error("buckets: non-finite gradient bucket; q8 zeroed")
+            f[:] = 0.0
+            r[:] = 0.0
+            continue
+        scale = amax / 127.0
+        q = np.clip(np.rint(f / scale), -127, 127)
+        np.multiply(q, np.float32(scale), out=q)
+        np.subtract(f, q, out=r)
+        f[:] = q
+    return residual

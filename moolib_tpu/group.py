@@ -18,16 +18,20 @@ Accumulator's mesh backend.
 
 from __future__ import annotations
 
+import copy
 import os
+import queue
 import threading
 import time
+import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import utils
+from . import buckets, utils
 from .utils import nest
 from .rpc import Future, Rpc, RpcError
+from .rpc.core import adopt_current_frame
 
 _OPS: Dict[str, Callable] = {
     "sum": lambda a, b: a + b,
@@ -46,6 +50,44 @@ def _ring_threshold() -> int:
     chunked ring path.  Read per call so tests can force it; MUST be set
     identically on every peer (path choice is part of the op's protocol)."""
     return int(os.environ.get("MOOLIB_RING_THRESHOLD", 1 << 20))
+
+
+def _bucket_threshold() -> int:
+    """Payload size (bytes) above which a tree ``all_reduce`` auto-selects
+    the flat-bucket path (zero-copy serialization + in-place combine, one
+    sub-op per bucket).  Like the ring threshold it is wire protocol: set it
+    identically on every peer."""
+    return int(os.environ.get("MOOLIB_BUCKET_THRESHOLD", 1 << 20))
+
+
+def _own(value):
+    """Deep-copy any array leaf that does not own writable memory.
+
+    Inline RPC handlers (``__group_reduce``/``__group_ring``/``__group_share``)
+    receive arrays as ZERO-COPY views over the transport's receive buffer,
+    valid only for the duration of the call — anything parked, queued, or
+    otherwise retained past the handler return must take ownership first.
+    Copying non-owning leaves (rather than tracking provenance) also covers
+    values the caller handed us as views; the copy is exactly the one the
+    old copying deserializer used to make, so retention paths cost the same
+    as before while the consume-immediately paths become zero-copy."""
+
+    def f(x):
+        if isinstance(x, np.ndarray):
+            if not x.flags.owndata or not x.flags.writeable:
+                return np.array(x)
+            return x
+        if x is None or isinstance(
+            x, (bool, int, float, complex, str, bytes, np.generic)
+        ):
+            return x
+        if hasattr(x, "copy_to_host_async"):
+            return x  # device array: deserialization always copies jax leaves
+        # Opaque leaf (custom-op payloads): nest.map can't see inside it, but
+        # it may embed borrowed receive-buffer views — deepcopy owns them.
+        return copy.deepcopy(x)
+
+    return nest.map(f, value)
 
 
 def _ring_codec(wire):
@@ -97,6 +139,25 @@ def _ring_nbytes(value) -> int:
     return sum(int(l.size) for l in leaves) * itemsize
 
 
+def _payload_nbytes(value) -> int:
+    """Rough array/bytes payload size of a share result — cheap gate for
+    the memfd-multicast star (small results must stay on tree forwarding:
+    below the memfd threshold the star degrades to O(n) root unicasts)."""
+    total = 0
+    for leaf in nest.flatten(value):
+        if isinstance(leaf, np.ndarray):
+            total += leaf.nbytes
+        elif isinstance(leaf, (bytes, bytearray, memoryview)):
+            total += len(leaf)
+    return total
+
+
+def _memfd_min() -> int:
+    from .rpc.core import _MEMFD_MIN
+
+    return _MEMFD_MIN
+
+
 def _resolve_op(op) -> Callable:
     """Builtin string ops reduce leaf-wise over pytrees; a user callable is
     applied to the *whole* contributed values (so lexicographic tuple compares
@@ -112,10 +173,49 @@ class AllReduce(Future):
     """A future result of an AllReduce operation (same API as reference)."""
 
 
-class _Op:
-    __slots__ = ("key", "value", "op", "finalize", "future", "contribs", "sent_up", "started_at")
+class _Completer:
+    """One lazily-started daemon thread running bucketed-round completions.
 
-    def __init__(self, key, value, op, finalize, future):
+    Completion must leave the transport IO thread (inline handlers run
+    there; user done-callbacks are arbitrary code) but must not queue
+    behind the Rpc executor's handler dispatch either — a round's
+    completion gates the caller's next round, and executor queueing under
+    load costs milliseconds per op on that critical path.
+    """
+
+    def __init__(self):
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def __call__(self, fn, *args) -> None:
+        if self._thread is None:
+            with self._lock:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._run, name="moolib-group-complete",
+                        daemon=True)
+                    self._thread.start()
+        self._q.put((fn, args))
+
+    def _run(self) -> None:
+        while True:
+            fn, args = self._q.get()
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 - callback bugs must not kill the thread
+                utils.log_error(
+                    "allreduce completion callback failed:\n%s",
+                    traceback.format_exc())
+
+
+class _Op:
+    __slots__ = (
+        "key", "value", "op", "finalize", "future", "contribs", "sent_up",
+        "started_at", "eager", "folded", "consume",
+    )
+
+    def __init__(self, key, value, op, finalize, future, eager=False, consume=None):
         self.key = key
         self.value = value
         self.op = op
@@ -124,6 +224,18 @@ class _Op:
         self.contribs: List[Any] = []
         self.sent_up = False
         self.started_at = time.monotonic()
+        # Eager ops (commutative + associative, e.g. the flat-bucket sum)
+        # fold each child contribution the moment it arrives — while the
+        # borrowed receive buffer is still valid — instead of parking it in
+        # ``contribs``.  That is what turns materialize-then-copy into one
+        # in-place ``np.add(acc, view, out=acc)`` pass.
+        self.eager = eager
+        self.folded = 0
+        # Optional share-path hook: consume(result) takes the (borrowed)
+        # shared-down result and returns an OWNED value (the bucketed path
+        # copies straight into its preallocated result buffer).  None means
+        # the generic _own() deep copy.
+        self.consume = consume
 
 
 class _RingOp:
@@ -162,7 +274,7 @@ class _RingOp:
     )
 
     def __init__(self, key, value, op_name, future, members, rank, wire,
-                 meta, meta_op, template):
+                 meta, meta_op, template, chunk_align=None):
         self.key = key
         self.future = future
         self.started_at = time.monotonic()
@@ -199,8 +311,30 @@ class _RingOp:
         self.template = shape_src
         self.leaf_shapes = [l.shape for l in leaves]
         total = sum(l.size for l in leaves)
-        base, rem = divmod(total, self.n)
-        self.chunk_sizes = [base + (1 if c < rem else 0) for c in range(self.n)]
+        if chunk_align and int(chunk_align) > 0 and total > 0:
+            # Bucket-aligned chunking: boundaries fall on multiples of
+            # ``chunk_align`` elements (the accumulator passes its flat
+            # bucket size), so ring chunks coincide with bucket slices of
+            # the flat payload — contiguous zero-copy views end to end.
+            # Same value required on every peer (boundaries are protocol).
+            # Clamp to the even split's granularity for small payloads
+            # (total < n aligned units): full-size alignment would leave
+            # peers with empty chunks and pile the work on the rest.  The
+            # clamp is a pure function of (total, n, align) so every peer
+            # still computes identical boundaries.
+            align = min(int(chunk_align), -(-total // self.n))
+            units = -(-total // align)
+            bu, rem_u = divmod(units, self.n)
+            sizes, off = [], 0
+            for c in range(self.n):
+                u = bu + (1 if c < rem_u else 0)
+                sz = min(u * align, total - off)
+                sizes.append(sz)
+                off += sz
+            self.chunk_sizes = sizes
+        else:
+            base, rem = divmod(total, self.n)
+            self.chunk_sizes = [base + (1 if c < rem else 0) for c in range(self.n)]
         if value is not None:
             flat = np.concatenate([l.ravel() for l in leaves]) if len(leaves) > 1 \
                 else leaves[0].ravel()
@@ -320,6 +454,345 @@ class _RingOp:
         return value
 
 
+class _BucketedReduce:
+    """Parent state of one flat-bucket tree allreduce.
+
+    The payload is flattened once into fixed-size contiguous buckets
+    (``buckets.BucketLayout``); each bucket rides the binary tree as its own
+    EAGER sub-op, so buckets pipeline independently through the engine
+    (serialization of bucket k overlaps the wire/combine of bucket k-1) and
+    every hop folds contributions **in place** off the borrowed receive
+    buffer (``np.add(acc, view, out=acc)``) instead of materialize-then-copy.
+    Buffers come from the refcount-guarded pool in ``moolib_tpu.buckets``:
+
+    - ``stage_flat``: the local contribution, staged once (multi-leaf
+      payloads, or single-leaf ones handed over with ``owned=True``); folds
+      accumulate directly into it.
+    - ``acc_flat``: lazily leased when the local contribution is a borrowed
+      user array (or a skip) — the first fold fuses the legacy materialize
+      copy with the first add.
+    - ``result_flat``: lazily leased on the share-down path; the consume
+      hook copies each bucket result straight off the receive buffer into
+      its slice (one pass, no intermediate array).
+
+    Wire compression reuses the ring's per-chunk codec (``_ring_codec``):
+    contributions and partial sums travel encoded per hop, accumulate in
+    f32, and the root's final encode is what every peer decodes —
+    bit-consistent cohort-wide, same contract as the tree's old finalize.
+    """
+
+    __slots__ = (
+        "template", "layout", "acc_dtype", "wire", "enc", "dec", "meta_op",
+        "has_meta", "owned", "defer", "flat_view", "stage_flat", "stage_owned",
+        "acc_flat", "result_flat", "results", "meta_total", "pending", "done",
+        "future", "key", "started_at", "cleanup", "_lock",
+    )
+
+    def __init__(self, value, meta, meta_op, wire, template, owned, defer):
+        self.wire = wire
+        self.meta_op = meta_op
+        self.has_meta = meta is not None
+        self.enc, self.dec, _ = _ring_codec(wire)
+        shape_src = value if value is not None else template
+        if shape_src is None:
+            raise RpcError("bucketed allreduce with value=None requires template=")
+        leaves = [np.asarray(l) for l in nest.flatten(shape_src)]
+        if not leaves:
+            raise RpcError("bucketed allreduce needs at least one array leaf")
+        dtypes = {l.dtype for l in leaves}
+        if len(dtypes) != 1:
+            raise RpcError(f"bucketed allreduce needs one uniform dtype, got {dtypes}")
+        dtype = leaves[0].dtype
+        self.template = shape_src
+        self.layout = buckets.BucketLayout([l.shape for l in leaves], dtype)
+        self.acc_dtype = np.dtype(np.float32) if wire is not None else dtype
+        self.owned = owned
+        self.defer = defer  # run fn(*args) off the transport IO thread
+        self.stage_flat = None
+        self.stage_owned = False  # True: recycle stage_flat at completion
+        self.acc_flat = None
+        self.result_flat = None
+        self.flat_view = None
+        if value is not None:
+            if len(leaves) == 1 and leaves[0].flags.c_contiguous and (
+                owned or leaves[0].dtype == self.acc_dtype
+            ):
+                # Zero-copy staging: the contribution IS the caller's array.
+                # owned=True additionally lets folds accumulate into it.
+                lf = leaves[0]
+                self.flat_view = lf if lf.ndim == 1 else lf.reshape(-1)
+                if owned and lf.dtype == self.acc_dtype:
+                    self.stage_flat = self.flat_view
+                    self.stage_owned = True
+            else:
+                self.stage_flat = buckets.lease(self.layout.total, self.acc_dtype)
+                self.layout.fill(self.stage_flat, leaves)
+                self.flat_view = self.stage_flat
+                self.stage_owned = True
+        n = self.layout.n_buckets
+        self.results: List[Any] = [None] * n
+        self.meta_total = None
+        self.pending = n
+        self.done = False
+        self.future: Optional[AllReduce] = None
+        # Registered in Group._ops under the PARENT key as a mismatch
+        # sentinel (a legacy tree frame arriving there means the cohort
+        # disagrees on the path) — key/started_at let the timeout sweep
+        # treat it like any other op.
+        self.key = None
+        self.started_at = time.monotonic()
+        # Set by the group: deregisters the sentinel when the round ends
+        # from within (the timeout sweep / epoch change remove it
+        # themselves).  Not a future done-callback on purpose — those mark
+        # the future as having user callbacks, which would force every
+        # completion through the completer-thread hop.
+        self.cleanup: Optional[Callable] = None
+        self._lock = threading.Lock()
+
+    def attach(self, future: AllReduce) -> None:
+        self.future = future
+
+    def _complete(self, fn, *args) -> None:
+        """Run a completion step: deferred to the completer thread when the
+        round future has user done-callbacks (arbitrary code must not run
+        on the transport IO thread), inline otherwise — completing a
+        callback-less future is just an event-set, and a thread hop costs
+        a full scheduler quantum on small boxes.  A callback registered in
+        the instant between the check and the set still runs safely: a
+        done future runs it on the adder's own thread."""
+        if self.future is not None and self.future._callbacks:
+            self.defer(fn, *args)
+        else:
+            fn(*args)
+
+    # -- per-bucket hooks (run under the GROUP lock via _Op machinery) ------
+    def _acc_slice(self, k):
+        s, e = self.layout.bounds[k]
+        if self.stage_flat is not None:
+            return self.stage_flat[s:e]
+        if self.acc_flat is None:
+            self.acc_flat = buckets.lease(self.layout.total, self.acc_dtype)
+        return self.acc_flat[s:e]
+
+    def _result_slice(self, k):
+        s, e = self.layout.bounds[k]
+        if self.result_flat is None:
+            self.result_flat = buckets.lease(self.layout.total, self.acc_dtype)
+        return self.result_flat[s:e]
+
+    def _decode_into(self, dst, b):
+        """dst[:] = decode(b) in ONE pass (no intermediate array for the
+        common uncompressed and q8 cases)."""
+        if self.wire is None:
+            np.copyto(dst, b)
+        elif self.wire == "q8":
+            np.multiply(b["q8"], np.float32(b["s"]), out=dst)
+        else:
+            np.copyto(dst, b, casting="unsafe")
+
+    def _add_into(self, dst, b):
+        """dst += decode(b) in place."""
+        if self.wire is None:
+            np.add(dst, b, out=dst)
+        elif self.wire == "q8":
+            np.add(dst, b["q8"] * np.float32(b["s"]), out=dst)
+        else:
+            np.add(dst, np.asarray(b, np.float32), out=dst)
+
+    def _fold(self, k, total, c):
+        m = c.get("m")
+        if m is not None:
+            total["m"] = m if total.get("m") is None else self.meta_op(total["m"], m)
+        b = c.get("b")
+        if b is None:
+            return total
+        tb = total.get("b")
+        acc = self._acc_slice(k)
+        if tb is None:
+            # Local skip: own the first incoming straight into the bucket.
+            self._decode_into(acc, b)
+            total["b"] = acc
+        elif tb is acc or np.may_share_memory(tb, acc):
+            self._add_into(tb, b)
+        else:
+            # First fold over a borrowed local view: fuse the copy the
+            # legacy receive path used to make with the first add.
+            if tb.dtype != self.acc_dtype:
+                tb = np.asarray(tb, self.acc_dtype)
+            if self.wire is None:
+                np.add(tb, b, out=acc)
+            elif self.wire == "q8":
+                np.add(tb, b["q8"] * np.float32(b["s"]), out=acc)
+            else:
+                np.add(tb, np.asarray(b, np.float32), out=acc)
+            total["b"] = acc
+        return total
+
+    def _fin(self, p):
+        """Per-hop wire encode of a bucket payload (identity without wire)."""
+        b = p.get("b")
+        if b is None or self.wire is None:
+            return p
+        if isinstance(b, dict):
+            return p  # already encoded (defensive; folds keep acc form)
+        out = dict(p)
+        out["b"] = self.enc(b)
+        return out
+
+    def _consume(self, k, val):
+        """Share-path hook: copy the borrowed result straight into the
+        preallocated result slice (one pass off the receive buffer).
+
+        Returns ``(owned, forward)``: the owned decoded value this peer
+        keeps, and the payload to forward down the tree.  Uncompressed they
+        are the same object (slice views — the forward serializes
+        zero-copy); under wire compression the forward keeps the ENCODED
+        bytes (owned copy) so every peer in the subtree decodes identical
+        bytes — the tree-wide bit-consistency contract."""
+        b = val.get("b")
+        m = val.get("m")
+        if b is None:
+            out = {"b": None, "m": m}
+            return out, out
+        if (
+            self.owned
+            and self.wire is None
+            and self.layout.n_buckets == 1
+            and self.result_flat is None
+            and isinstance(b, np.ndarray)
+            and b.size == self.layout.total
+        ):
+            # Zero-copy terminus: adopt the memfd mapping the share arrived
+            # in — the result stays in the shared pages (read-only) instead
+            # of being copied out.  Single-bucket only: multi-bucket results
+            # must land contiguously in one flat.  Gated on owned=True: a
+            # read-only result view is part of that engine-style contract,
+            # while plain all_reduce callers keep writable results.
+            adopted = adopt_current_frame()
+            if adopted is not None:
+                base = adopted.__array_interface__["data"][0]
+                off = b.__array_interface__["data"][0] - base
+                if 0 <= off and off + b.nbytes <= adopted.nbytes:
+                    view = adopted[off:off + b.nbytes].view(self.acc_dtype)
+                    self.result_flat = view
+                    out = {"b": view, "m": m}
+                    return out, out
+        dst = self._result_slice(k)
+        self._decode_into(dst, b)
+        out = {"b": dst, "m": m}
+        if self.wire is None:
+            return out, out
+        return out, {"b": _own(b), "m": m}
+
+    # -- assembly ----------------------------------------------------------
+    def _settled(self, b) -> bool:
+        """Is this bucket result already sitting in one of our flats?"""
+        if not isinstance(b, np.ndarray):
+            return False
+        for f in (self.stage_flat, self.acc_flat, self.result_flat):
+            if f is not None and np.may_share_memory(b, f):
+                return True
+        return False
+
+    def _child_done(self, k, fut):
+        err = fut.exception()
+        with self._lock:
+            if self.done:
+                return
+            if err is None:
+                r = fut.result(0)
+                b = r.get("b")
+                if b is not None and not self._settled(b):
+                    # Root result under wire compression arrives encoded
+                    # (the finalized form every peer decodes) — decode into
+                    # the result buffer for bit-consistency with the cohort.
+                    dst = self._result_slice(k)
+                    self._decode_into(dst, b)
+                    b = dst
+                self.results[k] = (True, b)
+                if k == 0:
+                    self.meta_total = r.get("m")
+                self.pending -= 1
+                if self.pending > 0:
+                    return
+            self.done = True
+        if err is not None:
+            self._recycle()
+            self._complete(self.future.set_exception, err)
+            return
+
+        def _finish():
+            try:
+                result = self._assemble()
+            except Exception as e:  # noqa: BLE001 - surface assembly bugs
+                self._recycle()
+                self.future.set_exception(e)
+                return
+            # Recycle BEFORE completing: the caller's next round starts the
+            # moment the future resolves, and its leases should find this
+            # round's flats already back in the pool (the result views keep
+            # their buffer alive; aliased freelist entries are skipped).
+            self._recycle()
+            self.future.set_result(result)
+
+        # Assembly + user done-callbacks run on the completer thread, never
+        # on the transport IO thread the inline handlers execute on (inline
+        # only for callback-less futures, where completion is an event-set).
+        self._complete(_finish)
+
+    def _fail(self, err) -> None:
+        """Error the whole bucketed round (protocol mismatch detection);
+        idempotent against racing child completions."""
+        with self._lock:
+            if self.done:
+                return
+            self.done = True
+        self._recycle()
+        self._complete(self.future.set_exception, err)
+
+    def _recycle(self):
+        """Offer the round's flats back to the pool.  Eager by design:
+        entries still aliased (pinned sends, the result views just handed to
+        the caller) sit in the freelist untouched until their references die
+        — lease()'s refcount probe never hands out aliased memory.  Runs on
+        every from-within terminal path, so it also deregisters the group's
+        mismatch sentinel."""
+        if self.cleanup is not None:
+            self.cleanup()
+            self.cleanup = None  # the closure references us: break the cycle
+        if self.stage_owned:
+            buckets.release(self.stage_flat)
+        buckets.release(self.acc_flat)
+        buckets.release(self.result_flat)
+        # Drop our own references immediately: anything still keeping this
+        # object alive (a stray closure, a parked error path) would
+        # otherwise pin every flat at refcount > pool-only and defeat
+        # lease()'s reuse probe for the rest of the process.
+        self.stage_flat = self.acc_flat = self.result_flat = None
+        self.flat_view = None
+        self.results = []
+
+    def _assemble(self):
+        vals = [b for (_, b) in self.results]
+        if all(b is None for b in vals):
+            return (None, self.meta_total) if self.has_meta else None
+        flat = self.result_flat
+        if flat is None:
+            flat = self.acc_flat if self.acc_flat is not None else self.stage_flat
+        for k, b in enumerate(vals):
+            s, e = self.layout.bounds[k]
+            dst = flat[s:e]
+            if b is None:
+                dst[:] = 0
+            elif not np.may_share_memory(b, dst):
+                np.copyto(dst, b)
+        leaves = self.layout.unflatten(flat)
+        if self.acc_dtype != self.layout.dtype:
+            leaves = [l.astype(self.layout.dtype, copy=False) for l in leaves]
+        value = nest.pack_as(self.template, leaves)
+        return (value, self.meta_total) if self.has_meta else value
+
+
 class Group:
     """A group of Rpc peers allowing coordinated AllReduce (reference API:
     update/set_broker_name/set_timeout/set_sort_order/members/sync_id/name/
@@ -350,6 +823,9 @@ class Group:
         from .rpc.core import _boot_id
 
         self._host_key = _boot_id()
+        # Per-group completer: one group's slow user done-callback must not
+        # gate another group's (another Accumulator's) round completion.
+        self._completer = _Completer()
         self._register_handlers()
 
     # ------------------------------------------------------------------ setup
@@ -372,9 +848,14 @@ class Group:
                 return handler
 
             rpc.define("__group_update", dispatch(Group._on_update))
-            rpc.define("__group_reduce", dispatch(Group._on_reduce))
-            rpc.define("__group_share", dispatch(Group._on_share))
-            rpc.define("__group_ring", dispatch(Group._on_ring))
+            # The allreduce data-plane handlers run INLINE on the receiving
+            # IO thread with zero-copy borrowed payload views (Rpc.define):
+            # eager bucket ops fold contributions in place straight off the
+            # receive buffer; anything retained (parked frames, non-eager
+            # contribs, shared results) is copied via _own()/consume hooks.
+            rpc.define("__group_reduce", dispatch(Group._on_reduce), inline=True)
+            rpc.define("__group_share", dispatch(Group._on_share), inline=True)
+            rpc.define("__group_ring", dispatch(Group._on_ring), inline=True)
         if self._name in registry:
             raise RpcError(f"group {self._name!r} already exists on this Rpc")
         registry[self._name] = self
@@ -553,7 +1034,8 @@ class Group:
     # -------------------------------------------------------------- allreduce
     def all_reduce(self, name: str, value, op="sum", finalize=None, *,
                    meta=None, meta_op=None, wire=None, chunked=None,
-                   template=None) -> AllReduce:
+                   template=None, bucketed=None, chunk_align=None,
+                   owned: bool = False) -> AllReduce:
         """Start an allreduce of ``value`` under ``name``; all active members
         must call with the same name (and call order per name).
 
@@ -581,12 +1063,35 @@ class Group:
           (symmetric int8, one scale per chunk).
         - ``value=None`` (sum only) contributes zero at near-zero wire cost;
           ``template`` must then supply the pytree of array shapes.
+        - ``chunk_align``: align ring chunk boundaries to multiples of this
+          many ELEMENTS (the Accumulator passes its flat-bucket size so ring
+          chunks land on bucket boundaries).  Wire protocol: same on every
+          peer.
+
+        Large uniform-dtype ``op="sum"`` payloads that stay on the tree take
+        the **flat-bucket** path (``bucketed=True/False`` forces, ``None``
+        auto-selects above ``MOOLIB_BUCKET_THRESHOLD``): the payload is
+        flattened into fixed-size buckets (``buckets.bucket_bytes()``), each
+        bucket rides the tree as its own pipelined sub-op, contributions are
+        folded IN PLACE off the borrowed receive buffer, and the wire sees
+        memoryviews over the flat buffer end to end (docs/DESIGN.md
+        "Gradient data plane").  ``meta``/``wire``/``template`` compose with
+        ``bucketed=True`` exactly as with the ring.  ``owned=True`` declares
+        that the value's buffers belong to the op until the future resolves
+        (the op may fold partial sums into them in place) and that the
+        caller accepts READ-ONLY result views (the zero-copy share terminus
+        may leave the result in adopted shared pages); without it the
+        caller's arrays are only read and results are always writable.  Like the ring/tree choice, the
+        bucket path choice and bucket size are wire protocol — identical
+        settings on every peer.
         """
         future = AllReduce()
-        if (meta is not None or wire is not None or template is not None) and chunked is not True:
-            # Ring-only kwargs must not silently change meaning with cohort
-            # or payload size: they require the explicit chunked=True path.
-            raise RpcError("meta=/wire=/template= require chunked=True")
+        if (meta is not None or wire is not None or template is not None) and (
+            chunked is not True and bucketed is not True
+        ):
+            # These kwargs must not silently change meaning with cohort or
+            # payload size: they require an explicit path choice.
+            raise RpcError("meta=/wire=/template= require chunked=True or bucketed=True")
         with self._lock:
             # The auto decision MUST be read under the same lock acquisition
             # that assigns the op's sync_id key (RLock — ring_auto re-enters):
@@ -598,6 +1103,7 @@ class Group:
                 use_ring = (
                     meta is None and wire is None and template is None
                     and finalize is None and isinstance(op, str) and value is not None
+                    and bucketed is not True
                     and self.ring_auto(_ring_nbytes(value))
                 )
             if use_ring:
@@ -609,7 +1115,29 @@ class Group:
                     raise RpcError("value=None (skip) only composes with op='sum'")
                 if meta is not None and meta_op is None:
                     raise RpcError("meta= requires meta_op=")
-            reduce_fn = None if use_ring else _resolve_op(op)
+            use_buckets = False
+            if not use_ring:
+                use_buckets = bucketed
+                if use_buckets is None:
+                    # Auto rule, deterministic cohort-wide: same threshold
+                    # env, same payload shapes, same member count.
+                    nb = _ring_nbytes(value) if value is not None else -1
+                    use_buckets = (
+                        meta is None and wire is None and template is None
+                        and finalize is None and op == "sum"
+                        and nb >= _bucket_threshold()
+                        and len(self._members) >= 2
+                    )
+                if use_buckets:
+                    if op != "sum":
+                        raise RpcError("bucketed allreduce only composes with op='sum'")
+                    if finalize is not None:
+                        raise RpcError("bucketed allreduce: use wire= instead of finalize=")
+                    if value is None and template is None:
+                        raise RpcError("bucketed allreduce with value=None requires template=")
+                    if meta is not None and meta_op is None:
+                        raise RpcError("meta= requires meta_op=")
+            reduce_fn = None if (use_ring or use_buckets) else _resolve_op(op)
             if self._sync_id is None or self._rpc.get_name() not in self._members:
                 future.set_exception(RpcError("group not active"))
                 return future
@@ -620,12 +1148,20 @@ class Group:
             if len(self._members) == 1:
                 future.set_result((value, meta) if meta is not None else value)
                 return future
-            if use_ring:
+            if use_buckets:
+                try:
+                    finished = self._bucketed_start_locked(
+                        name, seq, value, future, meta, meta_op, wire, template,
+                        owned)
+                except RpcError as e:
+                    future.set_exception(e)
+                    return future
+            elif use_ring:
                 try:
                     opstate = _RingOp(
                         key, value, op, future, list(self._members),
                         self._members.index(self._rpc.get_name()), wire,
-                        meta, meta_op, template)
+                        meta, meta_op, template, chunk_align)
                 except RpcError as e:
                     future.set_exception(e)
                     return future
@@ -638,6 +1174,12 @@ class Group:
                         "peers disagree on allreduce path: tree contribution "
                         f"received for chunked op {key}"))
                     return future
+                if (self._sync_id, f"{name}\x1f{seq}:0", 0) in self._parked:
+                    del self._ops[key]
+                    future.set_exception(RpcError(
+                        "peers disagree on allreduce path: bucketed "
+                        f"contribution received for chunked op {key}"))
+                    return future
             else:
                 opstate = _Op(key, value, reduce_fn, finalize, future)
                 self._ops[key] = opstate
@@ -649,12 +1191,112 @@ class Group:
                         "peers disagree on allreduce path: ring frame "
                         f"received for tree op {key}"))
                     return future
+                # Bucketed sub-ops address child keys (name\x1f<seq>:<k>,
+                # child seq always 0) — a parked bucket-0 frame means a peer
+                # took the bucketed path for this very round.
+                if (self._sync_id, f"{name}\x1f{seq}:0", 0) in self._parked:
+                    del self._ops[key]
+                    future.set_exception(RpcError(
+                        "peers disagree on allreduce path: bucketed "
+                        f"contribution received for tree op {key}"))
+                    return future
                 action = self._check_op_locked(opstate)
-        if use_ring:
+        if use_buckets:
+            for op_, action_ in finished:
+                self._finish_op(op_, action_)
+        elif use_ring:
             self._ring_pump(opstate)
         else:
             self._finish_op(opstate, action)
         return future
+
+    def _bucketed_start_locked(self, name, pseq, value, future, meta, meta_op,
+                               wire, template, owned):
+        """Create the per-bucket eager sub-ops of one flat-bucket tree
+        allreduce (caller holds the group lock; see ``_BucketedReduce``).
+        Returns ``(op, action)`` pairs to finish outside the lock."""
+        pkey = (self._sync_id, name, pseq)
+        if (
+            self._parked.pop(pkey, None) is not None
+            or self._ring_parked.pop(pkey, None) is not None
+        ):
+            raise RpcError(
+                "peers disagree on allreduce path: legacy frame "
+                f"received for bucketed op {pkey}")
+        parent = _BucketedReduce(
+            value, meta, meta_op, wire, template, owned, self._defer)
+        parent.key = pkey
+        layout = parent.layout
+        finished = []
+        created = []
+        try:
+            for k in range(layout.n_buckets):
+                cname = f"{name}\x1f{pseq}:{k}"
+                cseq_key = (self._sync_id, cname)
+                cseq = self._seq.get(cseq_key, 0)
+                self._seq[cseq_key] = cseq + 1
+                key = (self._sync_id, cname, cseq)
+                s, e = layout.bounds[k]
+                val = {
+                    "b": parent.flat_view[s:e] if parent.flat_view is not None else None,
+                    "m": dict(meta) if (k == 0 and meta is not None) else None,
+                }
+                cf = AllReduce()
+                opstate = _Op(
+                    key, val,
+                    (lambda a, b, k=k: parent._fold(k, a, b)),
+                    parent._fin if wire is not None else None,
+                    cf, eager=True,
+                    consume=(lambda v, k=k: parent._consume(k, v)),
+                )
+                self._ops[key] = opstate
+                created.append(key)
+                # A parked contribution of the wrong length (peers with
+                # mismatched MOOLIB_BUCKET_BYTES) raises here — the except
+                # below turns that into a loud whole-round error.
+                for c in self._parked.pop(key, []):
+                    opstate.value = opstate.op(opstate.value, c)
+                    opstate.folded += 1
+                if self._ring_parked.pop(key, None) is not None:
+                    raise RpcError(
+                        "peers disagree on allreduce path: ring frame "
+                        f"received for bucketed op {key}")
+                cf.add_done_callback(lambda f, k=k: parent._child_done(k, f))
+                finished.append((opstate, self._check_op_locked(opstate)))
+        except Exception as e:
+            # Unwind every child op already registered: an orphaned child
+            # would fire parent._child_done from the timeout sweep with
+            # parent.future never attached.
+            for key in created:
+                self._ops.pop(key, None)
+            parent._recycle()
+            if isinstance(e, RpcError):
+                raise
+            raise RpcError(f"bucketed allreduce setup failed: {e!r}")
+        parent.attach(future)
+        # Mismatch sentinel: a legacy peer addresses this round at the
+        # PARENT key, where no bucketed sub-op lives — register the parent
+        # there so _on_reduce/_on_share error the round loudly (the ring
+        # contract) instead of parking the frame until the timeout sweep.
+        self._ops[pkey] = parent
+
+        def _done(pkey=pkey, parent=parent):
+            with self._lock:
+                if self._ops.get(pkey) is parent:
+                    del self._ops[pkey]
+
+        parent.cleanup = _done
+        return finished
+
+    def _defer(self, fn, *args):
+        """Run ``fn(*args)`` on the completion thread.  Bucketed rounds
+        complete from inline handlers on the transport IO thread; user
+        done-callbacks (arbitrary code, arbitrary locks) must never run
+        there (same contract as plain handler dispatch).  A dedicated
+        thread rather than the Rpc executor: completions gate the caller's
+        next round, and the executor queues them behind handler dispatch
+        (~3 ms under load vs ~0.1 ms here)."""
+        self._completer(fn, *args)
 
     def _on_reduce(self, key, value):
         key = tuple(key) if isinstance(key, list) else key
@@ -663,19 +1305,42 @@ class Group:
                 return None  # contribution from a dead epoch
             op = self._ops.get(key)
             if op is None:
-                self._parked.setdefault(key, []).append(value)
+                # Parked past the handler return: must own the bytes (the
+                # handler runs inline with borrowed receive-buffer views).
+                self._parked.setdefault(key, []).append(_own(value))
                 return None
-            if isinstance(op, _RingOp):
+            if isinstance(op, (_RingOp, _BucketedReduce)):
                 del self._ops[key]
                 mismatch = op
             else:
                 mismatch = None
-                op.contribs.append(value)
-                action = self._check_op_locked(op)
+                fold_err = None
+                if op.eager:
+                    # Fold NOW, while the borrowed view is valid: for the
+                    # flat-bucket sum this is one in-place add straight off
+                    # the receive buffer — no materialize, no copy.  A fold
+                    # failure errors the op instead of wedging it.
+                    try:
+                        op.value = op.op(op.value, value)
+                        op.folded += 1
+                    except Exception as e:  # noqa: BLE001
+                        del self._ops[key]
+                        fold_err = e
+                else:
+                    op.contribs.append(_own(value))
+                action = None if fold_err is not None else self._check_op_locked(op)
         if mismatch is not None:
-            mismatch.future.set_exception(RpcError(
-                "peers disagree on allreduce path: tree contribution "
-                f"received for chunked op {key}"))
+            err = RpcError(
+                "peers disagree on allreduce path: legacy tree contribution "
+                f"received for {'bucketed' if isinstance(mismatch, _BucketedReduce) else 'chunked'} "
+                f"op {key}")
+            if isinstance(mismatch, _BucketedReduce):
+                mismatch._fail(err)
+            else:
+                mismatch.future.set_exception(err)
+            return None
+        if fold_err is not None:
+            op.future.set_exception(fold_err)
             return None
         self._finish_op(op, action)
         return None
@@ -685,11 +1350,18 @@ class Group:
         *outside* the group lock (sends and future completion run caller
         callbacks / take caller locks — lock-order safety), or None."""
         idx, parent, children = self._tree()
-        if op.sent_up or len(op.contribs) < len(children):
-            return None
-        total = op.value
-        for c in op.contribs[: len(children)]:
-            total = op.op(total, c)
+        if op.eager:
+            # Contributions were folded on arrival (_on_reduce); the op is
+            # ready once every tree child has been folded in.
+            if op.sent_up or op.folded < len(children):
+                return None
+            total = op.value
+        else:
+            if op.sent_up or len(op.contribs) < len(children):
+                return None
+            total = op.value
+            for c in op.contribs[: len(children)]:
+                total = op.op(total, c)
         if op.finalize is not None:
             total = op.finalize(total)
         op.sent_up = True
@@ -724,7 +1396,7 @@ class Group:
             parent_name, "__group_reduce", _sent, self._name, op.key, total
         )
 
-    def _on_share(self, key, result):
+    def _on_share(self, key, result, direct: bool = False):
         key = tuple(key) if isinstance(key, list) else key
         with self._lock:
             if self._sync_id is None or key[0] != self._sync_id:
@@ -732,22 +1404,60 @@ class Group:
             op = self._ops.pop(key, None)
             if op is None:
                 return None
-            if isinstance(op, _RingOp):
+            if isinstance(op, (_RingOp, _BucketedReduce)):
                 mismatch = op
             else:
                 mismatch = None
+                # The shared result is retained (future value) and forwarded
+                # down the tree: take ownership of its borrowed buffers.
+                # The bucketed path's consume hook copies straight into the
+                # preallocated result buffer (one pass off the receive
+                # buffer) and keeps the encoded form for the forward;
+                # everything else deep-copies.
+                err = None
+                try:
+                    if op.consume is not None:
+                        result, forward = op.consume(result)
+                    else:
+                        result = forward = _own(result)
+                except Exception as e:  # noqa: BLE001 - must not wedge the op
+                    err = e
                 idx, _, _ = self._tree()
                 members = self._members
         if mismatch is not None:
-            mismatch.future.set_exception(RpcError(
+            share_err = RpcError(
                 "peers disagree on allreduce path: tree share "
-                f"received for chunked op {key}"))
+                f"received for {'bucketed' if isinstance(mismatch, _BucketedReduce) else 'chunked'} "
+                f"op {key}")
+            if isinstance(mismatch, _BucketedReduce):
+                mismatch._fail(share_err)
+            else:
+                mismatch.future.set_exception(share_err)
             return None
-        self._share_down(key, result, idx, members)
+        if err is not None:
+            op.future.set_exception(err)
+            return None
+        if not direct:
+            # direct=True marks a root-star share: the root already reached
+            # every member; receivers must not re-forward down the tree.
+            self._share_down(key, forward, idx, members)
         op.future.set_result(result)
         return None
 
     def _share_down(self, key, result, idx: int, members: List[str]):
+        if idx == 0 and len(members) > 2 and _payload_nbytes(result) >= _memfd_min():
+            others = [m for m in members if m != self._rpc.get_name()]
+            if self._rpc.multicast_ready(others):
+                # Root-star share over same-host memfd multicast: the result
+                # serializes and is written ONCE for the whole cohort (one
+                # memfd, one fd per peer) instead of being re-written at
+                # every tree hop.  direct=True tells receivers not to
+                # forward.  Root-local decision — no cohort agreement
+                # needed: forwarding is purely receiver-side behavior.
+                self._rpc.async_broadcast(
+                    others, "__group_share", self._name, key, result, True
+                )
+                return
         n = len(members)
         for c in (2 * idx + 1, 2 * idx + 2):
             if c < n:
@@ -758,6 +1468,10 @@ class Group:
     # ------------------------------------------------------------ ring path
     def _on_ring(self, key, phase, step, chunk_idx, data, meta):
         key = tuple(key) if isinstance(key, list) else key
+        # Ring frames are retained in ``pending`` until their step comes up
+        # (and ag-phase data is stored + forwarded): own the borrowed
+        # payload views up front — the copy the old deserializer made.
+        data = _own(data)
         with self._lock:
             if self._sync_id is None or key[0] != self._sync_id:
                 return None  # frame from a dead epoch
@@ -776,9 +1490,14 @@ class Group:
             # Complete outside the lock: done-callbacks (the Accumulator's)
             # take their own locks — inline completion would invert the lock
             # order against all_reduce callers (same rule as the timeout sweep).
-            mismatch.future.set_exception(RpcError(
+            ring_err = RpcError(
                 "peers disagree on allreduce path: ring frame "
-                f"received for tree op {key}"))
+                f"received for {'bucketed' if isinstance(mismatch, _BucketedReduce) else 'tree'} "
+                f"op {key}")
+            if isinstance(mismatch, _BucketedReduce):
+                mismatch._fail(ring_err)
+            else:
+                mismatch.future.set_exception(ring_err)
             return None
         self._ring_pump(op)
         return None

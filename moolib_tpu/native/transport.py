@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import weakref
 from typing import Callable, Optional
 
 import numpy as np
@@ -124,6 +125,18 @@ def get_lib():
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_int32,
     ]
+    lib.moolib_net_send_memfd_multi.restype = ctypes.c_int32
+    lib.moolib_net_send_memfd_multi.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int32,
+    ]
+    lib.moolib_net_adopt.restype = ctypes.c_int64
+    lib.moolib_net_adopt.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.moolib_net_unmap.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.moolib_net_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.moolib_net_conn_rx.restype = ctypes.c_uint64
     lib.moolib_net_conn_rx.argtypes = [ctypes.c_void_p, ctypes.c_int64]
@@ -281,6 +294,49 @@ class NativeNet:
         if ok:
             self.memfd_sends += 1
         return ok
+
+    def send_memfd_multi(self, conn_ids, chunks) -> int:
+        """Multicast one frame to several same-host peers: the payload is
+        written into ONE anonymous memfd and a dup of the fd rides to every
+        connection (receivers mmap the same pages).  Returns how many
+        connections the frame was queued to — the caller retries the missed
+        ones individually (receiver-side rid dedup makes that safe).  The
+        write completes synchronously, nothing is pinned."""
+        if not self._ctx or not conn_ids:
+            return 0
+        bufs, lens, keep = _marshal_chunks(chunks)
+        ids = (ctypes.c_int64 * len(conn_ids))(*conn_ids)
+        sent = self._lib.moolib_net_send_memfd_multi(
+            self._ctx, ids, len(conn_ids), bufs, lens, len(chunks)
+        )
+        del keep
+        if sent:
+            self.memfd_sends += sent
+        return int(sent)
+
+    def adopt_frame(self, frame) -> "np.ndarray | None":
+        """Adopt the memfd mapping behind ``frame`` (a zero-copy memoryview
+        delivered by the CURRENT frame callback, on the callback thread):
+        ownership of the pages transfers here, and the returned uint8 array
+        stays valid for its own lifetime — munmap runs when the array is
+        garbage collected.  Returns None when the frame is not an adoptable
+        mapping (small copied frames, TCP frames, asyncio transport)."""
+        if not self._ctx or not isinstance(frame, memoryview):
+            return None
+        obj = frame.obj
+        if not isinstance(obj, ctypes.Array):
+            return None
+        addr = ctypes.addressof(obj)
+        size = self._lib.moolib_net_adopt(self._ctx, ctypes.c_void_p(addr))
+        if size < 0:
+            return None
+        arr_t = (ctypes.c_ubyte * size).from_address(addr)
+        out = np.frombuffer(arr_t, np.uint8)
+        # The mapping is PROT_READ; numpy must not let anyone write into it.
+        out.flags.writeable = False
+        weakref.finalize(arr_t, self._lib.moolib_net_unmap,
+                         ctypes.c_void_p(addr), size)
+        return out
 
     def close_conn(self, conn_id: int) -> None:
         if self._ctx:

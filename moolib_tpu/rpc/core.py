@@ -607,6 +607,7 @@ class _Outgoing:
         "parked",
         "last_probe",
         "acked_at",
+        "peers_pending",
     )
 
     def __init__(self, rid, peer_name, fn_name, chunks, payload_obj, future, deadline):
@@ -624,18 +625,60 @@ class _Outgoing:
         self.parked = False  # already waiting in peer.pending
         self.last_probe = 0.0  # last POKE sent for this rid
         self.acked_at = 0.0  # receiver confirmed it is executing
+        # Broadcast requests (async_broadcast): the peers that have not
+        # responded yet.  One rid + one serialized frame fan out to all of
+        # them (receiver dedup is per (peer, rid), so the shared rid is
+        # unambiguous); None for ordinary single-peer requests.
+        self.peers_pending: Optional[set] = None
 
 
 class _FnDef:
-    __slots__ = ("name", "fn", "kind", "batch_size", "dynamic", "batch_state")
+    __slots__ = ("name", "fn", "kind", "batch_size", "dynamic", "batch_state", "inline")
 
-    def __init__(self, name, fn, kind, batch_size=None, dynamic=False):
+    def __init__(self, name, fn, kind, batch_size=None, dynamic=False, inline=False):
         self.name = name
         self.fn = fn
         self.kind = kind  # "plain" | "deferred" | "queue" | "batched"
         self.batch_size = batch_size
         self.dynamic = dynamic
         self.batch_state: List = []  # collected calls for kind=="batched"
+        # Inline handlers run synchronously on the receiving IO thread with
+        # BORROWED argument arrays (zero-copy views over the receive buffer,
+        # valid only for the duration of the call) — the hot path of the
+        # bucketed gradient combine.  See Rpc.define.
+        self.inline = inline
+
+
+_ADOPT = threading.local()
+_ADOPT.ctx = None
+
+# True while testing.faults.FrameFaults wraps the send_frame seam: the
+# memfd-multicast broadcast fast path (which bypasses per-connection
+# send_frame) steps aside so every frame stays visible to fault injection.
+frame_seam_hooked = False
+
+
+def adopt_current_frame():
+    """Take ownership of the memfd mapping behind the frame currently being
+    delivered on THIS thread (valid only inside an inline RPC handler on the
+    native transport).  Returns a uint8 numpy array over the mapping — alive
+    for the array's own lifetime, munmap'd by a GC finalizer — or None when
+    the current frame is not an adoptable mapping (small copied frames, TCP,
+    asyncio transport).  This is the zero-copy receive terminus of the
+    flat-bucket data plane: the allreduce share result stays in the shared
+    memfd pages instead of being copied out."""
+    ctx = getattr(_ADOPT, "ctx", None)
+    if ctx is None:
+        return None
+    net, frame = ctx
+    if net is None:
+        return None
+    arr = net.adopt_frame(frame)
+    if arr is not None:
+        # One adoption per frame: further calls (other arrays in the same
+        # payload) must go through the first adopter.
+        _ADOPT.ctx = (None, None)
+    return arr
 
 
 _live_rpcs: "weakref.WeakSet[Rpc]" = weakref.WeakSet()
@@ -1007,11 +1050,25 @@ class Rpc:
         self._explicit.append(address)
         self._call_in_loop(lambda: self._loop.create_task(self._reconnect_task(address)))
 
-    def define(self, name: str, fn: Callable, batch_size: Optional[int] = None) -> None:
+    def define(self, name: str, fn: Callable, batch_size: Optional[int] = None,
+               inline: bool = False) -> None:
+        """Register ``fn`` as a callable RPC endpoint.
+
+        ``inline=True`` is a hot-path opt-in for engine-internal handlers
+        (the Group's allreduce combine): the handler runs synchronously on
+        the receiving IO thread and its numpy array arguments are ZERO-COPY
+        read-only views over the receive buffer, valid only for the duration
+        of the call.  The handler must be fast, must not block, and must
+        copy anything it retains past the return.  Regular (non-inline)
+        handlers keep the copying deserialization and run on the thread
+        pool — the safe default for user code.
+        """
         if name in self._functions:
             raise RpcError(f"function {name!r} already defined")
+        if inline and batch_size:
+            raise RpcError("inline handlers cannot be batched")
         kind = "batched" if batch_size else "plain"
-        self._functions[name] = _FnDef(name, fn, kind, batch_size)
+        self._functions[name] = _FnDef(name, fn, kind, batch_size, inline=inline)
 
     def define_deferred(self, name: str, fn: Callable) -> None:
         if name in self._functions:
@@ -1052,6 +1109,93 @@ class Rpc:
     def sync(self, peer_name: str, fn_name: str, *args, **kwargs):
         return self.async_(peer_name, fn_name, *args, **kwargs).result()
 
+    def async_broadcast(self, peer_names: List[str], fn_name: str, *args, **kwargs) -> Future:
+        """Send ONE request to several peers: the payload serializes once,
+        and when every target is a same-host fd-passing peer the frame is
+        written into a single memfd multicast to all of them (the payload
+        bytes leave this process exactly once — the allreduce share-down's
+        fast path).  All targets share one rid (receiver dedup is per peer,
+        so this is unambiguous) and the returned future resolves to None
+        once every peer has responded; per-peer results are discarded.
+        Reliability is the standard poke/resend machinery, applied per
+        pending peer."""
+        future = Future()
+        if not peer_names:
+            future.set_result(None)
+            return future
+        try:
+            sp = serialization.serialize((args, kwargs))
+            body = serialization.pack(sp)
+        except Exception as e:  # noqa: BLE001
+            future.set_exception(RpcError(f"serialization error: {e}"))
+            return future
+        rid = next(self._rid)
+        chunks = _request_chunks(rid, fn_name, body, self._timeout)
+        deadline = time.monotonic() + self._timeout
+        out = _Outgoing(rid, peer_names[0], fn_name, chunks, (args, kwargs), future, deadline)
+        out.timeout_s = self._timeout
+        out.peers_pending = set(peer_names)
+
+        def _done(fut: Future):
+            with self._state:
+                self._outgoing.pop(rid, None)
+
+        future.add_done_callback(_done)
+        with self._state:
+            if not future.done():
+                self._outgoing[rid] = out
+                self._try_send(out)
+        return future
+
+    def _try_send_broadcast(self, out: _Outgoing):
+        """Send (or resend) a broadcast request to every pending peer.
+        Caller holds self._state.  The memfd-multicast fast path covers the
+        peers reachable over same-host fd-passing ipc connections; everyone
+        else gets an ordinary per-connection send of the same chunks."""
+        fast: List[Tuple[_Peer, _NativeConnection]] = []
+        slow: List[Tuple[_Peer, _Connection]] = []
+        big = sum(_chunk_len(c) for c in out.chunks) >= _MEMFD_MIN
+        for name in list(out.peers_pending or ()):
+            peer = self._peers.get(name)
+            conn = peer.best_connection(self._transport_order, big=big) if peer else None
+            if conn is None:
+                if peer is None:
+                    peer = self._peers.setdefault(name, _Peer(name))
+                self._spawn(lambda peer=peer: self._find_peer(peer))
+                continue
+            if (
+                big
+                and not frame_seam_hooked
+                and self._net is not None
+                and isinstance(conn, _NativeConnection)
+                and conn.transport == "ipc"
+                and peer.native_ok
+                and peer.fdp_ok
+            ):
+                fast.append((peer, conn))
+            else:
+                slow.append((peer, conn))
+        if fast:
+            ids = [c.conn_id for _, c in fast]
+            sent = self._net.send_memfd_multi(ids, out.chunks)
+            total = sum(_chunk_len(c) for c in out.chunks)
+            if sent == len(ids):
+                for _, c in fast:
+                    c.send_count += 1
+                    c.bytes_out += total
+                    c._m_tx_frames.inc()
+                    c._m_tx_bytes.inc(total)
+            else:
+                # Unknown subset failed: resend individually; receivers
+                # dedup duplicate rids.
+                slow.extend(fast)
+        for peer, conn in slow:
+            try:
+                conn.send_frame(self._chunks_for(peer, out))
+            except Exception:
+                conn.close()
+        out.sent_at = time.monotonic()
+
     def debug_info(self) -> str:
         with self._state:
             return self._debug_info_locked()
@@ -1073,6 +1217,39 @@ class Rpc:
             f" functions={list(self._functions)}"
         )
         return "\n".join(lines)
+
+    def multicast_ready(self, peer_names: List[str]) -> bool:
+        """True when every named peer is reachable over a live same-host
+        fd-passing ipc connection — i.e. ``async_broadcast`` of a large
+        frame will take the write-once memfd multicast path.  The allreduce
+        share-down uses this to pick root-star (payload written once for the
+        whole cohort) over tree forwarding."""
+        if self._net is None:
+            return False
+        ready = True
+        hunt: List[_Peer] = []
+        with self._state:
+            for name in peer_names:
+                p = self._peers.get(name)
+                if p is None or not any(
+                    not c.closed for c in p.connections.values()
+                ):
+                    # Not even connected yet (tree traffic never needed it):
+                    # start discovery so later rounds can upgrade to the
+                    # multicast star; this round stays on the tree.
+                    p = self._peers.setdefault(name, _Peer(name))
+                    hunt.append(p)
+                    ready = False
+                    continue
+                if not (p.native_ok and p.fdp_ok):
+                    ready = False
+                    continue
+                c = p.connections.get("ipc")
+                if c is None or c.closed or not isinstance(c, _NativeConnection):
+                    ready = False
+        for p in hunt:
+            self._spawn(lambda p=p: self._find_peer(p))
+        return ready
 
     def transport_stats(self) -> Dict[str, int]:
         """Aggregate wire counters across every live/dead-but-tracked
@@ -1164,17 +1341,22 @@ class Rpc:
     def _send_poke(self, out: _Outgoing):
         # Caller holds self._state. Pokes are best-effort: if there is no
         # live connection, the greeting-time resend path owns recovery.
-        peer = self._peers.get(out.peer_name)
-        conn = peer.best_connection(self._transport_order) if peer else None
-        if conn is None:
-            return
-        try:
-            conn.send_frame([struct.pack("<BQ", KIND_POKE, out.rid)])
-        except Exception:
-            conn.close()
+        names = out.peers_pending if out.peers_pending is not None else (out.peer_name,)
+        for name in list(names):
+            peer = self._peers.get(name)
+            conn = peer.best_connection(self._transport_order) if peer else None
+            if conn is None:
+                continue
+            try:
+                conn.send_frame([struct.pack("<BQ", KIND_POKE, out.rid)])
+            except Exception:
+                conn.close()
 
     def _try_send(self, out: _Outgoing):
         # Caller holds self._state.
+        if out.peers_pending is not None:
+            self._try_send_broadcast(out)
+            return
         peer = self._peers.get(out.peer_name)
         big = sum(_chunk_len(c) for c in out.chunks) >= _MEMFD_MIN
         conn = peer.best_connection(self._transport_order, big=big) if peer else None
@@ -1357,7 +1539,15 @@ class Rpc:
             conn.last_recv = time.monotonic()
             conn._m_rx_frames.inc()
             conn._m_rx_bytes.inc(len(frame))
-        self._on_frame(conn, frame)
+        # Publish the frame for adopt_current_frame(): an inline handler may
+        # take ownership of a memfd frame's mapping (zero-copy receive into
+        # a long-lived buffer) while the callback is on this stack.
+        prev = getattr(_ADOPT, "ctx", None)
+        _ADOPT.ctx = (self._net, frame)
+        try:
+            self._on_frame(conn, frame)
+        finally:
+            _ADOPT.ctx = prev
 
     def _net_on_close(self, conn_id: int):
         with self._state:
@@ -1589,7 +1779,14 @@ class Rpc:
                 seen.add(out.rid)
                 self._try_send(out)
         for out in list(self._outgoing.values()):
-            if out.peer_name == name and out.rid not in seen:
+            if out.rid in seen:
+                continue
+            if out.peers_pending is not None:
+                # Broadcast: resend when THIS peer is still pending (the
+                # single peer_name field only names the first target).
+                if name in out.peers_pending:
+                    self._try_send(out)
+            elif out.peer_name == name:
                 self._try_send(out)
         self._maybe_upgrade_transport(peer, info)
 
@@ -1724,6 +1921,23 @@ class Rpc:
                 stage="protocol",
             )
             return
+        if fdef.inline and fdef.kind == "plain":
+            # Inline hot path: borrowed (zero-copy) argument arrays, handler
+            # run right here on the receiving thread — while the frame's
+            # receive buffer is still valid (native transport frames die
+            # when this callback returns).  The handler contract (fast,
+            # non-blocking, copy-on-retention) lives in Rpc.define.
+            try:
+                sp = serialization.unpack(frame, off)
+                args, kwargs = serialization.deserialize(sp, borrow=True)
+            except Exception as e:  # noqa: BLE001
+                respond(None, f"argument deserialization error: {e}", stage="deserialization")
+                return
+            try:
+                respond(fdef.fn(*args, **kwargs), None)
+            except Exception:  # noqa: BLE001
+                respond(None, f"exception in {fdef.name!r}: {traceback.format_exc()}")
+            return
         try:
             sp = serialization.unpack(frame, off)
             args, kwargs = serialization.deserialize(sp)
@@ -1800,9 +2014,25 @@ class Rpc:
     def _on_response(self, conn: _Connection, frame: bytes, is_error: bool):
         (rid,) = struct.unpack_from("<Q", frame, 1)
         with self._state:
-            out = self._outgoing.pop(rid, None)
+            out = self._outgoing.get(rid)
             if out is None:
                 return  # late/duplicate response
+            if out.peers_pending is not None:
+                # Broadcast: track per-peer completion; per-peer results are
+                # discarded (fire-and-forget semantics with reliability).
+                if conn.peer_name is not None:
+                    out.peers_pending.discard(conn.peer_name)
+                if out.peers_pending:
+                    return
+                self._outgoing.pop(rid, None)
+                done_broadcast = out
+            else:
+                done_broadcast = None
+                self._outgoing.pop(rid, None)
+        if done_broadcast is not None:
+            done_broadcast.future.set_result(None)
+            return
+        with self._state:
             if not out.resent:
                 # Resent requests give ambiguous RTTs (which send answered?)
                 rtt = time.monotonic() - out.sent_at

@@ -102,16 +102,17 @@ class _Pickler(pickle.Pickler):
 
 
 class _Unpickler(pickle.Unpickler):
-    def __init__(self, file, arrays: Sequence[ArrayRef]):
+    def __init__(self, file, arrays: Sequence[ArrayRef], borrow: bool = False):
         super().__init__(file)
         self._arrays = arrays
+        self._borrow = borrow
 
     def persistent_load(self, pid):
         tag, idx = pid
         if tag != "__array__":
             raise pickle.UnpicklingError(f"unknown persistent id {pid!r}")
         ref = self._arrays[idx]
-        arr = _materialize(ref)
+        arr = _materialize(ref, borrow=self._borrow)
         return arr
 
 
@@ -149,15 +150,22 @@ def _np_dtype(name: str):
         raise
 
 
-def _materialize(ref: ArrayRef):
+def _materialize(ref: ArrayRef, borrow: bool = False):
     arr = np.frombuffer(ref.data, dtype=_np_dtype(ref.dtype)).reshape(ref.shape)
     if ref.kind == "jax":
         import jax.numpy as jnp
 
         # Copy off the (transient) receive buffer before device_put: jax can
         # zero-copy alias host numpy buffers and keeps only the array object
-        # alive, not the buffer beneath a frombuffer view.
+        # alive, not the buffer beneath a frombuffer view.  borrow never
+        # applies to jax leaves — device_put must own its memory.
         return jnp.asarray(arr.copy())
+    if borrow:
+        # Zero-copy: a read-only view straight over the receive buffer,
+        # valid only as long as that buffer is (for RPC frames: the duration
+        # of the handler call).  Callers opting in own the lifetime problem;
+        # anything retained must be copied first.
+        return arr
     # np.frombuffer gives a read-only view over the receive buffer; copy so
     # callers can mutate (the receive buffer is also about to be recycled).
     return arr.copy()
@@ -202,13 +210,44 @@ def _py_serialize(obj: Any) -> SerializedPayload:
     return SerializedPayload(bio.getvalue(), arrays)
 
 
-def deserialize(sp) -> Any:
+_native_borrow_ok: Any = None  # None = unprobed; codec borrow support cache
+
+
+def _probe_borrow(codec) -> bool:
+    """Does this codec build accept ``loads(payload, arrays, borrow)``?
+    Probed ONCE on a tiny sentinel round-trip — classifying a real
+    payload's decode TypeError as "old codec" would silently disable the
+    zero-copy path for the life of the process and mask the actual error."""
+    global _native_borrow_ok
+    if _native_borrow_ok is None:
+        try:
+            header, arrays = codec.dumps({"p": np.zeros(1, np.float32)})
+            codec.loads(header, arrays, True)
+            _native_borrow_ok = True
+        except Exception:  # noqa: BLE001 - any sentinel failure: copy path
+            _native_borrow_ok = False
+    return _native_borrow_ok
+
+
+def deserialize(sp, borrow: bool = False) -> Any:
+    """Decode a payload back into python objects.
+
+    ``borrow=True`` skips the defensive copy of numpy array leaves: they come
+    back as read-only views straight over the receive buffer (zero payload
+    bytes copied).  Only for callers that fully consume the arrays before the
+    buffer is recycled — i.e. within the RPC handler call that received the
+    frame (the bucketed gradient combine).  The copying default stays for
+    user-facing RPC; jax leaves always copy (device_put must own memory).
+    """
+    global _native_borrow_ok
     if isinstance(sp, NativePayload):
         codec = _native_codec()
         if codec is None:  # built by a peer; we can't decode without it
             raise RuntimeError("native codec payload but codec unavailable")
+        if borrow and _probe_borrow(codec):
+            return codec.loads(sp.payload, sp.np_arrays, True)
         return codec.loads(sp.payload, sp.np_arrays)
-    return _Unpickler(io.BytesIO(sp.payload), sp.arrays).load()
+    return _Unpickler(io.BytesIO(sp.payload), sp.arrays, borrow=borrow).load()
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +353,7 @@ def native_available() -> bool:
     return _native_codec() is not None
 
 
-def loads(buf) -> Any:
-    """One-shot inverse of :func:`dumps`."""
-    return deserialize(unpack(buf))
+def loads(buf, borrow: bool = False) -> Any:
+    """One-shot inverse of :func:`dumps`.  ``borrow=True`` returns numpy
+    leaves as zero-copy views into ``buf`` (see :func:`deserialize`)."""
+    return deserialize(unpack(buf), borrow=borrow)

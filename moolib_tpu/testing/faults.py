@@ -124,12 +124,19 @@ class FrameFaults:
             orig = cls.__dict__["send_frame"]
             self._originals.append((cls, orig))
             cls.send_frame = self._wrap(cls, orig)
+        # Disable the memfd-multicast broadcast fast path while the seam is
+        # hooked: it writes frames without going through send_frame, which
+        # would hide the share-down traffic from fault injection.
+        rpc_core.frame_seam_hooked = True
         return self
 
     def uninstall(self) -> None:
+        from ..rpc import core as rpc_core
+
         for cls, orig in self._originals:
             cls.send_frame = orig
         self._originals = []
+        rpc_core.frame_seam_hooked = False
 
     def __enter__(self) -> "FrameFaults":
         return self.install()

@@ -258,7 +258,7 @@ int encode(PyObject* obj, Writer& w, PyObject* arrays, int depth) {
   return 0;
 }
 
-PyObject* decode(Reader& r, PyObject* arrays, int depth) {
+PyObject* decode(Reader& r, PyObject* arrays, int depth, int borrow) {
   if (depth > 200) {
     PyErr_SetString(PyExc_ValueError, "codec: nesting too deep");
     return nullptr;
@@ -314,7 +314,7 @@ PyObject* decode(Reader& r, PyObject* arrays, int depth) {
       PyObject* out = tag == T_LIST ? PyList_New(n) : PyTuple_New(n);
       if (!out) return nullptr;
       for (uint32_t i = 0; i < n; i++) {
-        PyObject* item = decode(r, arrays, depth + 1);
+        PyObject* item = decode(r, arrays, depth + 1, borrow);
         if (!item) {
           Py_DECREF(out);
           return nullptr;
@@ -332,12 +332,12 @@ PyObject* decode(Reader& r, PyObject* arrays, int depth) {
       PyObject* out = PyDict_New();
       if (!out) return nullptr;
       for (uint32_t i = 0; i < n; i++) {
-        PyObject* key = decode(r, arrays, depth + 1);
+        PyObject* key = decode(r, arrays, depth + 1, borrow);
         if (!key) {
           Py_DECREF(out);
           return nullptr;
         }
-        PyObject* value = decode(r, arrays, depth + 1);
+        PyObject* value = decode(r, arrays, depth + 1, borrow);
         if (!value) {
           Py_DECREF(key);
           Py_DECREF(out);
@@ -396,6 +396,12 @@ PyObject* decode(Reader& r, PyObject* arrays, int depth) {
         return jarr;
       }
       if (kind == 0) {
+        if (borrow) {
+          // Borrowed decode: hand back the read-only zero-copy view over
+          // the receive buffer. Only reachable through loads(..., True) —
+          // callers consume the arrays before the buffer is recycled.
+          return out;
+        }
         // Numpy result must be writable/owned: the receive buffer is
         // transient (the python fallback path copies too).
         PyObject* copy = PyArray_NewCopy((PyArrayObject*)out, NPY_CORDER);
@@ -445,9 +451,11 @@ PyObject* py_dumps(PyObject*, PyObject* obj) {
 PyObject* py_loads(PyObject*, PyObject* args) {
   Py_buffer header;
   PyObject* arrays;
-  if (!PyArg_ParseTuple(args, "y*O", &header, &arrays)) return nullptr;
+  int borrow = 0;
+  if (!PyArg_ParseTuple(args, "y*O|p", &header, &arrays, &borrow))
+    return nullptr;
   Reader r{(const uint8_t*)header.buf, (const uint8_t*)header.buf + header.len};
-  PyObject* out = decode(r, arrays, 0);
+  PyObject* out = decode(r, arrays, 0, borrow);
   PyBuffer_Release(&header);
   return out;
 }
@@ -470,7 +478,9 @@ PyObject* py_register_jax(PyObject*, PyObject* args) {
 PyMethodDef methods[] = {
     {"dumps", py_dumps, METH_O,
      "dumps(obj) -> (header: bytes, arrays: list[np.ndarray])"},
-    {"loads", py_loads, METH_VARARGS, "loads(header, arrays) -> obj"},
+    {"loads", py_loads, METH_VARARGS,
+     "loads(header, arrays, borrow=False) -> obj; borrow skips the numpy "
+     "array copy (zero-copy read-only views over the receive buffers)"},
     {"register_jax", py_register_jax, METH_VARARGS,
      "register_jax(type, to_numpy, from_numpy): accelerator-array hook"},
     {nullptr, nullptr, 0, nullptr},

@@ -188,6 +188,12 @@ struct Engine {
   std::unordered_map<int, Conn*> by_fd;
   std::vector<int> listeners;            // listening fds
   std::unordered_map<int, bool> lis_tcp; // listener fd -> is_tcp
+  // The mmap set of the memfd frames being delivered by the CURRENT frame
+  // callback (epoll thread only, valid only inside flush_burst): a consumer
+  // may ADOPT a mapping during the callback (moolib_net_adopt) — ownership
+  // transfers to the caller, who must moolib_net_unmap it — turning a
+  // received memfd frame into a zero-copy long-lived buffer.
+  std::vector<std::pair<void*, size_t>>* cur_maps = nullptr;
 
   void wake() {
     uint64_t one = 1;
@@ -428,8 +434,13 @@ void handle_readable(Engine* e, Conn* c) {
   // Mappings delivered in the current burst; unmapped after the callback.
   std::vector<std::pair<void*, size_t>> maps;
   auto flush_burst = [&](int& n) {
-    if (n > 0 && !e->stopping.load()) e->on_frame(e->ud, c->id, datas, lens, n);
+    if (n > 0 && !e->stopping.load()) {
+      e->cur_maps = &maps;
+      e->on_frame(e->ud, c->id, datas, lens, n);
+      e->cur_maps = nullptr;
+    }
     n = 0;
+    // Mappings not adopted during the callback die with the burst.
     for (auto& m : maps) munmap(m.first, m.second);
     maps.clear();
   };
@@ -884,9 +895,13 @@ int moolib_net_send_iov(void* ctx, int64_t conn_id, const void* const* bufs,
 // connections only; the caller gates on the peer's capability (greeting).
 // Returns 0 on success, -1 on an I/O error (caller falls back to send_iov),
 // -2 if the conn is unknown/closed (same code as send_iov; nothing went out).
-int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
-                          const uint64_t* lens, int32_t n) {
-  Engine* e = static_cast<Engine*>(ctx);
+// Write the scatter-gather payload into a fresh anonymous memfd and build
+// the 12-byte control header (memfd flag + total length) that rides the
+// unix socket next to the passed fd.  Returns the memfd (caller closes), or
+// -1 on create/write failure.  Shared by the single-target and multicast
+// memfd sends — the memfd frame wire format lives here only.
+static int make_memfd_payload(const void* const* bufs, const uint64_t* lens,
+                              int32_t n, char hdr[12]) {
   uint64_t total = 0;
   for (int32_t i = 0; i < n; ++i) total += lens[i];
   int fd = memfd_create("moolib-frame", MFD_CLOEXEC);
@@ -905,15 +920,23 @@ int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
       left -= static_cast<uint64_t>(w);
     }
   }
-  std::vector<Seg> segs;
-  Seg ctl;
   uint32_t flag = kMemfdFlag | 8u;
-  char hdr[12];
   hdr[0] = static_cast<char>(flag & 0xff);
   hdr[1] = static_cast<char>((flag >> 8) & 0xff);
   hdr[2] = static_cast<char>((flag >> 16) & 0xff);
   hdr[3] = static_cast<char>((flag >> 24) & 0xff);
   memcpy(hdr + 4, &total, 8);
+  return fd;
+}
+
+int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
+                          const uint64_t* lens, int32_t n) {
+  Engine* e = static_cast<Engine*>(ctx);
+  char hdr[12];
+  int fd = make_memfd_payload(bufs, lens, n, hdr);
+  if (fd < 0) return -1;
+  std::vector<Seg> segs;
+  Seg ctl;
   ctl.owned.assign(hdr, sizeof hdr);
   ctl.pass_fd = fd;
   segs.push_back(std::move(ctl));
@@ -922,6 +945,66 @@ int moolib_net_send_memfd(void* ctx, int64_t conn_id, const void* const* bufs,
     return -2;
   }
   return 0;
+}
+
+// Same-host zero-copy MULTICAST: write the frame payload into one anonymous
+// memfd ONCE, then pass dup()s of the fd to every listed unix-domain
+// connection (each receiver mmaps the same pages — the payload is written
+// once no matter how many receivers).  The allreduce share-down uses this:
+// the root serializes + writes the result a single time for the whole
+// cohort.  Returns the number of connections the frame was queued to
+// (receivers missed — dead conns, I/O errors — are the caller's to retry
+// individually; frames carry rpc-layer rids, so duplicate delivery from a
+// retry is deduplicated by the receiver).
+int32_t moolib_net_send_memfd_multi(void* ctx, const int64_t* conn_ids,
+                                    int32_t nconn, const void* const* bufs,
+                                    const uint64_t* lens, int32_t n) {
+  Engine* e = static_cast<Engine*>(ctx);
+  char hdr[12];
+  int fd = make_memfd_payload(bufs, lens, n, hdr);
+  if (fd < 0) return 0;
+  int32_t sent = 0;
+  for (int32_t ci = 0; ci < nconn; ++ci) {
+    int dfd = dup(fd);
+    if (dfd < 0) continue;
+    std::vector<Seg> segs;
+    Seg ctl;
+    ctl.owned.assign(hdr, sizeof hdr);
+    ctl.pass_fd = dfd;
+    segs.push_back(std::move(ctl));
+    if (send_segs(e, conn_ids[ci], std::move(segs))) {
+      ++sent;
+    } else {
+      close(dfd);
+    }
+  }
+  close(fd);
+  return sent;
+}
+
+// Adopt a memfd mapping during the frame callback: `p` must be the data
+// pointer of a memfd frame being delivered by the CURRENT callback on the
+// epoll thread.  On success the mapping is removed from the burst's cleanup
+// list and ownership transfers to the caller (who must eventually call
+// moolib_net_unmap(p, size)); returns the mapping size, or -1 when `p` is
+// not an adoptable mapping of the current burst.
+int64_t moolib_net_adopt(void* ctx, const void* p) {
+  Engine* e = static_cast<Engine*>(ctx);
+  if (e->cur_maps == nullptr) return -1;
+  auto& maps = *e->cur_maps;
+  for (size_t i = 0; i < maps.size(); ++i) {
+    if (maps[i].first == p) {
+      int64_t size = static_cast<int64_t>(maps[i].second);
+      maps.erase(maps.begin() + i);
+      return size;
+    }
+  }
+  return -1;
+}
+
+// Release a mapping previously adopted with moolib_net_adopt. Any thread.
+void moolib_net_unmap(const void* p, uint64_t size) {
+  munmap(const_cast<void*>(p), size);
 }
 
 // Queue one frame (length prefix added here, payload copied). Any thread.

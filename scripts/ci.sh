@@ -61,6 +61,15 @@ step "warm-rejoin plane tests (chunked model sync resume, compile cache)"
 python -m pytest tests/test_accumulator_rejoin.py tests/test_compile_cache.py \
   -q || fail=1
 
+step "flat-bucket data plane (zero-copy serialization, layout golden, bit-exact allreduce)"
+python -m pytest tests/test_buckets.py -q || fail=1
+
+step "allreduce smoke (bucketed vs legacy vs numpy reference: tree + ring + q8, loopback bandwidth)"
+# Correctness gate for the gradient data plane (docs/DESIGN.md §6b): the
+# bucketed tree/ring/q8 results must be bit-consistent cohort-wide and
+# match the legacy path / numpy reference; also prints loopback MB/s.
+python benchmarks/allreduce_bench.py --smoke || fail=1
+
 step "chaos soak (seeded, ~80 s smoke: worker/peer kills + respawn SLO, RPC frame chaos, forced-kill resume)"
 # Exits non-zero if any phase stalls past its watchdog/deadline, or the
 # respawned peer misses its recovery bound (docs/RESILIENCE.md recovery

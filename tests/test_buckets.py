@@ -1,0 +1,464 @@
+"""Flat-bucket gradient data plane (docs/DESIGN.md "Gradient data plane").
+
+Covers the ISSUE-4 contracts:
+- zero payload-byte copies through serialize -> pack -> unpack ->
+  deserialize(borrow=True) (buffer identity via ``memoryview.obj``);
+- bucket-layout determinism (same treedef/shapes/dtype => identical layout,
+  the cross-process golden);
+- bit-exactness of the f32 bucketed allreduce vs a numpy reference and vs
+  the legacy per-leaf tree path;
+- EF-q8 on the flat buffer: quantize-once semantics, residual carry,
+  non-finite reset;
+- the refcount-guarded buffer pool;
+- rpc inline handlers (borrowed views) and memfd-multicast broadcast.
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu import Accumulator, Broker, Group, Rpc, buckets
+from moolib_tpu.rpc import serialization
+
+
+# --------------------------------------------------------------- zero copy
+def test_borrow_deserialize_zero_payload_copies():
+    """serialize->pack->unpack->deserialize(borrow=True) must not copy a
+    single payload byte: every array leaf is a view whose backing buffer IS
+    the packed wire blob (asserted via the memoryview.obj chain)."""
+    payload = {
+        "b": np.arange(4096, dtype=np.float32),
+        "nested": [np.ones((16, 16), np.float64), {"k": np.arange(7, dtype=np.int32)}],
+        "m": {"num_gradients": 3},
+    }
+    sp = serialization._py_serialize(payload)  # force the portable codec
+    buf = serialization.pack_bytes(sp)
+    out = serialization.deserialize(serialization.unpack(buf), borrow=True)
+    flat_buf = np.frombuffer(buf, np.uint8)
+    leaves = [out["b"], out["nested"][0], out["nested"][1]["k"]]
+    for leaf in leaves:
+        assert not leaf.flags.owndata
+        assert not leaf.flags.writeable  # borrowed views are read-only
+        assert np.shares_memory(leaf, flat_buf)
+        # Buffer identity: the view's memory chain bottoms out at `buf`.
+        mv = leaf.base
+        while isinstance(mv, np.ndarray):
+            mv = mv.base
+        assert isinstance(mv, memoryview) and mv.obj is buf
+    np.testing.assert_array_equal(out["b"], payload["b"])
+    # The copying default stays for user-facing RPC.
+    owned = serialization.deserialize(serialization.unpack(buf))
+    assert owned["b"].flags.owndata and owned["b"].flags.writeable
+
+
+def test_borrow_deserialize_native_codec():
+    if not serialization.native_available():
+        pytest.skip("native codec unavailable")
+    payload = {"b": np.arange(100_000, dtype=np.float32)}
+    sp = serialization.serialize(payload)
+    buf = serialization.pack_bytes(sp)
+    out = serialization.loads(buf, borrow=True)
+    assert not out["b"].flags.owndata
+    assert np.shares_memory(out["b"], np.frombuffer(buf, np.uint8))
+    np.testing.assert_array_equal(out["b"], payload["b"])
+    owned = serialization.loads(buf)
+    assert owned["b"].flags.owndata
+
+
+# ------------------------------------------------------------------ layout
+def test_bucket_layout_golden():
+    """Same shapes/dtype/bucket size => identical layout on any process:
+    the layout is wire protocol (each bucket is its own allreduce op)."""
+    shapes = [(512, 256), (256,), (1024, 64), (3,)]
+    a = buckets.BucketLayout(shapes, np.float32, bucket_bytes_=1 << 20)
+    b = buckets.BucketLayout(list(shapes), "float32", bucket_bytes_=1 << 20)
+    assert a.signature() == b.signature()
+    # Golden values: 512*256 + 256 + 1024*64 + 3 = 196867 elems; 1 MiB of
+    # f32 = 262144 elems per bucket => one bucket.
+    assert a.total == 196867
+    assert a.bucket_elems == 262144
+    assert a.n_buckets == 1
+    c = buckets.BucketLayout(shapes, np.float32, bucket_bytes_=1 << 18)
+    assert c.bucket_elems == 65536
+    assert c.n_buckets == 4  # ceil(196867 / 65536)
+    assert c.bounds[0] == (0, 65536)
+    assert c.bounds[3] == (3 * 65536, 196867)
+    # fill + unflatten round-trips leaves bit-exactly through the flat.
+    rng = np.random.default_rng(0)
+    leaves = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+    flat = np.empty(c.total, np.float32)
+    c.fill(flat, leaves)
+    for orig, view in zip(leaves, c.unflatten(flat)):
+        np.testing.assert_array_equal(orig, view)
+
+
+# ------------------------------------------------------------------- pool
+def test_pool_refcount_guard():
+    arr = buckets.lease(1000, np.float32)
+    buckets.release(arr)
+    view = None
+    # An aliased buffer must never be handed out again while the alias lives.
+    with buckets._pool_lock:
+        pass
+    held = arr[10:20]  # alias
+    del arr
+    again = buckets.lease(1000, np.float32)
+    assert not np.shares_memory(again, held)
+    del held, view
+    addr = again.__array_interface__["data"][0]
+    buckets.release(again)
+    del again  # the freelist must hold the ONLY reference to recycle
+    reused = buckets.lease(1000, np.float32)
+    assert reused.__array_interface__["data"][0] == addr  # recycled
+    buckets.release(reused)
+
+
+# ------------------------------------------------------------------- EF-q8
+def test_ef_quantize_flat_once_with_residual():
+    rng = np.random.default_rng(3)
+    g = rng.standard_normal(4096).astype(np.float32)
+    layout = buckets.BucketLayout([(4096,)], np.float32, bucket_bytes_=1 << 12)
+    assert layout.n_buckets == 4
+    flat = g.copy()
+    res = buckets.ef_quantize_flat(flat, None, layout.bounds)
+    # Grid values: exact multiples of each bucket's scale; <1% rel error.
+    np.testing.assert_allclose(flat, g, atol=np.abs(g).max() / 100)
+    np.testing.assert_allclose(res, g - flat, atol=1e-6)
+    # Quantize-once: re-encoding the grid values with a fresh per-bucket
+    # absmax scale reproduces the identical int8 payload (what the wire
+    # codec does per hop), so quantization noise enters exactly once.
+    for s, e in layout.bounds:
+        scale = float(np.max(np.abs(flat[s:e]))) / 127.0
+        q = np.round(flat[s:e] / scale).astype(np.int8)
+        np.testing.assert_array_equal(q.astype(np.float32) * np.float32(scale), flat[s:e])
+    # Error feedback: two rounds average closer than round one alone.
+    flat2 = g.copy()
+    res2 = buckets.ef_quantize_flat(flat2, res, layout.bounds)
+    err1 = np.abs(flat - g).mean()
+    err2 = np.abs((flat + flat2) / 2 - g).mean()
+    assert err2 < err1 * 0.75, (err1, err2)
+    assert res2.shape == g.shape
+    # Non-finite bucket: zero contribution, residual reset.
+    bad = g.copy()
+    bad[0] = np.nan
+    resb = buckets.ef_quantize_flat(bad, None, layout.bounds)
+    s, e = layout.bounds[0]
+    assert (bad[s:e] == 0).all() and (resb[s:e] == 0).all()
+    assert (bad[e:] != 0).any()  # other buckets unaffected
+
+
+# ------------------------------------------------------- cohort helpers
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Cohort:
+    def __init__(self, n):
+        addr = f"127.0.0.1:{_free_port()}"
+        self.broker = Broker()
+        self.broker.set_name("broker")
+        self.broker.listen(addr)
+        self.peers = []
+        for i in range(n):
+            rpc = Rpc()
+            rpc.set_name(f"p{i}")
+            rpc.listen(":0")
+            rpc.connect(addr)
+            g = Group(rpc, "g")
+            g.set_timeout(30)
+            self.peers.append((rpc, g))
+        self.groups = [g for _, g in self.peers]
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            self.pump()
+            if all(g.active() and len(g.members()) == n for g in self.groups):
+                return
+            time.sleep(0.01)
+        raise AssertionError("cohort never converged")
+
+    def pump(self):
+        self.broker.update()
+        for g in self.groups:
+            g.update()
+
+    def wait(self, futs, bound=30):
+        t0 = time.time()
+        while not all(f.done() for f in futs):
+            self.pump()
+            time.sleep(0.002)
+            assert time.time() - t0 < bound, "allreduce hung"
+
+    def close(self):
+        for rpc, _ in self.peers:
+            rpc.close()
+        self.broker.close()
+
+
+# ------------------------------------------------------------ bit-exactness
+def test_bucketed_tree_bit_exact_vs_numpy_and_legacy():
+    """f32 bucketed allreduce: bit-exact vs a numpy reference sum on
+    exactly-representable values (order-independent), bit-identical across
+    peers on random values, and bit-identical to the legacy tree path."""
+    c = _Cohort(4)
+    try:
+        rng = np.random.default_rng(11)
+        ints = [rng.integers(-1000, 1000, 300_000).astype(np.float32)
+                for _ in c.groups]
+        ref = np.sum(np.stack(ints), axis=0, dtype=np.float64).astype(np.float32)
+        futs = [g.all_reduce("bx", d, bucketed=True) for g, d in zip(c.groups, ints)]
+        c.wait(futs)
+        for f in futs:
+            np.testing.assert_array_equal(np.asarray(f.result(0)), ref)
+        futs = [g.all_reduce("lx", d, bucketed=False, chunked=False)
+                for g, d in zip(c.groups, ints)]
+        c.wait(futs)
+        for f in futs:
+            np.testing.assert_array_equal(np.asarray(f.result(0)), ref)
+        # Random payload: all peers must decode the exact same result bytes.
+        rnd = [rng.standard_normal(2_000_000).astype(np.float32) for _ in c.groups]
+        futs = [g.all_reduce("rx", d) for g, d in zip(c.groups, rnd)]  # auto path
+        c.wait(futs)
+        outs = [np.asarray(f.result(0)) for f in futs]
+        for o in outs[1:]:
+            assert o.tobytes() == outs[0].tobytes()
+        np.testing.assert_allclose(outs[0], sum(rnd), rtol=1e-5, atol=1e-5)
+    finally:
+        c.close()
+
+
+def test_bucketed_multi_bucket_pytree_meta_skip():
+    """Multiple buckets + pytree payload + meta + a skip contribution."""
+    buckets.set_bucket_bytes(1 << 14)  # 4096 f32 elems per bucket
+    try:
+        c = _Cohort(3)
+        try:
+            rng = np.random.default_rng(5)
+            trees = [
+                {"w": rng.integers(-50, 50, (100, 180)).astype(np.float32),
+                 "b": rng.integers(-50, 50, 37).astype(np.float32)}
+                for _ in range(2)
+            ]
+            meta_op = lambda a, b: {"n": a["n"] + b["n"]}  # noqa: E731
+            tmpl = {"w": np.zeros((100, 180), np.float32), "b": np.zeros(37, np.float32)}
+            futs = []
+            for i, g in enumerate(c.groups):
+                if i == 2:
+                    futs.append(g.all_reduce(
+                        "mb", None, bucketed=True, meta={"n": 1}, meta_op=meta_op,
+                        template=tmpl))
+                else:
+                    futs.append(g.all_reduce(
+                        "mb", trees[i], bucketed=True, meta={"n": 1}, meta_op=meta_op))
+            c.wait(futs)
+            exp_w = trees[0]["w"] + trees[1]["w"]
+            for f in futs:
+                v, m = f.result(0)
+                assert m == {"n": 3}
+                np.testing.assert_array_equal(v["w"], exp_w)
+                np.testing.assert_array_equal(v["b"], trees[0]["b"] + trees[1]["b"])
+        finally:
+            c.close()
+    finally:
+        buckets.set_bucket_bytes(buckets._DEFAULT_BUCKET_BYTES)
+
+
+def test_ring_chunk_align_on_bucket_boundaries():
+    c = _Cohort(4)
+    try:
+        rng = np.random.default_rng(9)
+        data = [rng.integers(-100, 100, 70_000).astype(np.float32) for _ in c.groups]
+        ref = np.sum(np.stack(data), axis=0, dtype=np.float64).astype(np.float32)
+        futs = [g.all_reduce("ra", d, chunked=True, chunk_align=16384)
+                for g, d in zip(c.groups, data)]
+        c.wait(futs)
+        for f in futs:
+            np.testing.assert_array_equal(np.asarray(f.result(0)), ref)
+        # Alignment larger than total/n: clamped to the even split's
+        # granularity (no empty chunks), boundaries still cohort-identical.
+        futs = [g.all_reduce("rb", d, chunked=True, chunk_align=65536)
+                for g, d in zip(c.groups, data)]
+        c.wait(futs)
+        for f in futs:
+            np.testing.assert_array_equal(np.asarray(f.result(0)), ref)
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------- rpc primitives
+def test_inline_handler_gets_borrowed_views():
+    """define(..., inline=True): the handler runs with zero-copy views and
+    its return value round-trips like a normal call."""
+    a, b = Rpc(), Rpc()
+    try:
+        seen = {}
+
+        def handler(arr):
+            seen["owndata"] = arr.flags.owndata
+            seen["writeable"] = arr.flags.writeable
+            return float(arr.sum())
+
+        a.set_name("a")
+        b.set_name("srv")
+        b.define("probe", handler, inline=True)
+        b.listen(":0")
+        addr = next(x for x in b._listen_addrs if x.startswith("ipc://"))
+        a.connect(addr)
+        payload = np.ones(200_000, np.float32)  # big enough to stay a view
+        out = a.sync("srv", "probe", payload)
+        assert out == 200_000.0
+        assert seen["owndata"] is False  # borrowed, not copied
+    finally:
+        a.close()
+        b.close()
+
+
+def test_async_broadcast_multicast():
+    """async_broadcast: one rid fans out to several peers (memfd multicast
+    when same-host ipc is up), future resolves when all respond."""
+    hub = Rpc()
+    spokes = []
+    try:
+        hub.set_name("hub")
+        hub.listen(":0")
+        hits = []
+        for i in range(3):
+            r = Rpc()
+            r.set_name(f"s{i}")
+            r.define("take", lambda arr, i=i: hits.append((i, float(arr[0]))))
+            r.listen(":0")
+            addr = next(x for x in r._listen_addrs if x.startswith("ipc://"))
+            hub.connect(addr)
+            spokes.append(r)
+        deadline = time.time() + 20
+        names = [f"s{i}" for i in range(3)]
+        while time.time() < deadline and not all(
+            n in hub._peers and hub._peers[n].connections for n in names
+        ):
+            time.sleep(0.02)
+        payload = np.full(600_000, 7.0, np.float32)  # > memfd threshold
+        fut = hub.async_broadcast(names, "take", payload)
+        fut.result(20)
+        assert sorted(i for i, _ in hits) == [0, 1, 2]
+        assert all(v == 7.0 for _, v in hits)
+        assert hub.multicast_ready(names) in (True, False)  # probe is callable
+    finally:
+        hub.close()
+        for r in spokes:
+            r.close()
+
+
+# ------------------------------------------------------- accumulator plane
+def _pump_accs(broker, accs, seconds, until):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        broker.update()
+        for a in accs:
+            a.update()
+            if a.wants_state():
+                a.set_state({})
+        if until():
+            return True
+        time.sleep(0.02)
+    return until()
+
+
+def _accum_round(bucketed, wire=None, n=3):
+    addr = f"127.0.0.1:{_free_port()}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(addr)
+    accs = []
+    params = {"w": np.zeros((64, 32), np.float32), "b": np.zeros(17, np.float32)}
+    for i in range(n):
+        acc = Accumulator("m", dict(params))
+        acc.set_name(f"p{i}")
+        acc.listen()
+        acc.set_bucketed_allreduce(bucketed)
+        if wire is not None:
+            acc.set_wire_dtype(wire)
+        acc.connect(addr)
+        accs.append(acc)
+    try:
+        assert _pump_accs(broker, accs, 30, lambda: all(a.connected() for a in accs))
+        rng = np.random.default_rng(21)
+        gs = [
+            {"w": rng.integers(-30, 30, (64, 32)).astype(np.float32),
+             "b": rng.integers(-30, 30, 17).astype(np.float32)}
+            for _ in range(n)
+        ]
+        for a, g in zip(accs, gs):
+            a.reduce_gradients(1, g)
+        assert _pump_accs(broker, accs, 20, lambda: all(a.has_gradients() for a in accs))
+        outs = [np.asarray(a.gradients()["w"], np.float32) for a in accs]
+        for o in outs[1:]:
+            np.testing.assert_array_equal(o, outs[0])
+        info = accs[0].debug_info()
+        assert info["bucketed"] is bucketed
+        return outs[0], np.mean([g["w"] for g in gs], axis=0)
+    finally:
+        for a in accs:
+            a.close()
+        broker.close()
+
+
+def test_accumulator_bucketed_matches_legacy_f32():
+    got_b, exp = _accum_round(bucketed=True)
+    got_l, _ = _accum_round(bucketed=False)
+    np.testing.assert_array_equal(got_b, exp)  # integer-valued: exact
+    np.testing.assert_array_equal(got_l, exp)
+
+
+def test_accumulator_bucketed_q8_quantizes_once_at_source():
+    got, exp = _accum_round(bucketed=True, wire="int8")
+    tol = np.abs(exp).max() * 3 / 127 * 3
+    np.testing.assert_allclose(got, exp, atol=max(tol, 0.5))
+
+
+# ------------------------------------------------------- path disagreement
+def test_bucketed_vs_legacy_mismatch_errors_loudly():
+    """Peers disagreeing on the allreduce path (bucketed vs legacy tree)
+    must fail with a loud RpcError well before the op timeout — the same
+    contract the ring/tree mismatch already has — in both directions:
+    a legacy frame reaching a bucketed round's parent-key sentinel, and a
+    parked bucketed bucket-0 frame discovered when a legacy op starts."""
+    from moolib_tpu import RpcError
+
+    c = _Cohort(2)
+    try:
+        for g in c.groups:
+            g.set_timeout(60)  # loud detection must beat this by far
+        d = np.ones(300_000, np.float32)
+
+        # Legacy contribution arrives at the bucketed root's parent key.
+        f0 = c.groups[0].all_reduce("mm", d, bucketed=True)
+        c.groups[1].all_reduce("mm", d, bucketed=False, chunked=False)
+        t0 = time.time()
+        while not f0.done():
+            c.pump()
+            time.sleep(0.002)
+            assert time.time() - t0 < 20, "mismatch not detected loudly"
+        with pytest.raises(RpcError, match="disagree"):
+            f0.result(0)
+
+        # Bucketed child frame parks at the legacy root before its op starts.
+        c.groups[1].all_reduce("mm2", d, bucketed=True)
+        t0 = time.time()
+        while time.time() - t0 < 2:  # let the bucket-0 frame land and park
+            c.pump()
+            time.sleep(0.002)
+        f0 = c.groups[0].all_reduce("mm2", d, bucketed=False, chunked=False)
+        t0 = time.time()
+        while not f0.done():
+            c.pump()
+            time.sleep(0.002)
+            assert time.time() - t0 < 20, "parked-frame mismatch not detected"
+        with pytest.raises(RpcError, match="disagree"):
+            f0.result(0)
+    finally:
+        c.close()

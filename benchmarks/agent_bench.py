@@ -3,52 +3,58 @@
 VERDICT round-3 ask #4: ``bench.py`` times the learner step alone, but the
 reference's headline is whole-agent SPS — the flagship loop with EnvPool
 actors, batched inference, and the learner sharing one chip
-(``/root/reference/examples/vtrace/experiment.py`` act/learn overlap at the
-``config.yaml:23-65`` scale: actor_batch 128 x 2 buffers, unroll 20,
-learner batch 32).  This runs OUR flagship agent end to end on synthetic
-Atari-geometry observations (84x84x4 uint8 — no ALE dependency, no env
-compute worth measuring) and prints one JSON line:
+(``/root/reference/examples/vtrace/experiment.py`` act/learn overlap).
 
-    {"metric": "impala_agent_sps", "value": ..., "unit": "env_frames/s", ...}
+Since the device-resident actor pipeline landed (docs/DESIGN.md "Actor data
+plane"), this is an A/B: by default BOTH rollout modes run in one
+invocation — the legacy host-batcher path first, then the device-rollout
+path — and each prints one JSON row:
 
-Scales: ``--scale reference`` (the reference config, for the TPU battery)
-and ``--scale small`` (CPU smoke row for BENCH_LOCAL.json).
+    {"metric": "impala_agent_sps", "rollout": "legacy"|"device",
+     "value": ..., "steady_sps": ..., "host_boundary_bytes_per_frame": ...}
+
+``host_boundary_bytes_per_frame`` comes from the actor-path telemetry
+counters (``actor_h2d/d2h_bytes_total``, ``batcher_h2d/d2h_bytes_total``
+over ``actor_frames_total``), read as per-run deltas — the one-crossing
+uint8 contract as a committed artifact, not a narrative.
+
+Scales:
+
+- ``--scale reference``: the reference config (synthetic Atari geometry,
+  actor_batch 128 x 2 buffers, unroll 20, learner batch 32) for the TPU
+  battery — there the learner is fast and per-dispatch RTT dominates
+  acting, the regime the device pipeline exists for.
+- ``--scale small``: CPU smoke row for BENCH_LOCAL.json.  Uses the
+  ``catch_flat`` MLP env so per-frame model FLOPs are negligible and
+  whole-agent SPS measures the actor data plane itself (on a CPU box the
+  conv learner would otherwise drown the actor plane it is probing);
+  long unrolls + virtual batching keep the shared learner/allreduce floor
+  amortized the same way in both modes.
+
+``--check`` (the ci.sh smoke gate) exits non-zero unless every mode that
+ran reports steady_sps > 0.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("--scale", default="reference", choices=["reference", "small"])
-    p.add_argument("--total_steps", type=int, default=None, help="override step budget")
-    args = p.parse_args(argv)
-
-    if args.scale == "reference":
-        cfg = dict(actor_batch_size=128, num_actor_batches=2, batch_size=32,
-                   virtual_batch_size=32, unroll_length=20, num_env_processes=8)
-    else:
-        cfg = dict(actor_batch_size=16, num_actor_batches=2, batch_size=4,
-                   virtual_batch_size=4, unroll_length=10, num_env_processes=2)
-
-    # Frames per learner batch: the agent must get through a few SGD steps
-    # for the number to mean "overlapped steady state" — default the step
-    # budget to ~12 learner batches.  Wall-clock bounding is the caller's
-    # job (the battery time-boxes the whole invocation).
-    frames_per_batch = cfg["batch_size"] * cfg["unroll_length"]
-    total = args.total_steps or max(24 * frames_per_batch,
-                                    cfg["actor_batch_size"] * cfg["unroll_length"] * 6)
-
-    # The experiment constructs EnvPools before heavy jax init (fork safety);
-    # importing it is cheap, train() owns the ordering.
+def _run_mode(cfg: dict, total: int, device_rollout: bool, port: int):
+    """One train() run; returns (result, bytes_per_frame, seconds) with the
+    boundary bytes read as telemetry deltas so back-to-back runs in one
+    process don't double-count."""
+    from moolib_tpu import telemetry
     from moolib_tpu.examples.vtrace import experiment
 
+    t0 = time.time()
+    reg = telemetry.get_registry()
+    before = reg.counter_values()
     flags = experiment.make_flags([
-        "--env", "synthetic",
+        "--env", cfg["env"],
         "--total_steps", str(total),
         "--actor_batch_size", str(cfg["actor_batch_size"]),
         "--num_actor_batches", str(cfg["num_actor_batches"]),
@@ -56,25 +62,42 @@ def main(argv=None):
         "--virtual_batch_size", str(cfg["virtual_batch_size"]),
         "--unroll_length", str(cfg["unroll_length"]),
         "--num_env_processes", str(cfg["num_env_processes"]),
-        "--log_interval", "10",
+        "--log_interval", str(cfg.get("log_interval", 10)),
         "--stats_interval", "5",
+        "--device_rollout", "true" if device_rollout else "false",
+        # Distinct broker port per mode: the second run must not race the
+        # first run's closing listener.
+        "--address", f"127.0.0.1:{port}",
+        "--quiet",
     ])
-    t0 = time.time()
     out = experiment.train(flags)
-    dt = time.time() - t0
+    after = reg.counter_values()
+    delta = {k: after.get(k, 0.0) - before.get(k, 0.0) for k in after}
+    frames = delta.get("actor_frames_total", 0.0)
+    boundary = (
+        delta.get("actor_h2d_bytes_total", 0.0)
+        + delta.get("actor_d2h_bytes_total", 0.0)
+        + delta.get("batcher_h2d_bytes_total", 0.0)
+        + delta.get("batcher_d2h_bytes_total", 0.0)
+    )
+    bpf = round(boundary / frames, 1) if frames else None
+    return out, bpf, time.time() - t0
 
-    import jax
-    import jax.numpy as jnp
 
-    dev = jax.devices()[0]
-    # Per-dispatch device round-trip floor: every act() pays one dispatch +
-    # scalar fetch.  Through the axon tunnel this is ~65 ms — the dominant
-    # bound on overlapped SPS here; on a colocated TPU host it is sub-ms.
-    # Probed in a daemon thread with a deadline: the tunnel dying right
-    # after a successful train() must not hang the process and discard the
-    # measured SPS row (the probe is garnish, the row is the result).
-    def _probe_rtt(out_list):
+def _probe_rtt():
+    """Per-dispatch device round-trip floor: every act() pays one dispatch +
+    scalar fetch.  Through the axon tunnel this is ~65 ms — the dominant
+    bound on overlapped SPS there; on a colocated host it is sub-ms.
+    Probed in a daemon thread with a deadline: the tunnel dying right after
+    a successful train() must not hang the process and discard the measured
+    SPS rows (the probe is garnish, the rows are the result)."""
+    import threading
+
+    def _probe(out_list):
         try:
+            import jax
+            import jax.numpy as jnp
+
             f = jax.jit(lambda x: x + 1)
             x = jnp.zeros((), jnp.int32)
             float(f(x))  # compile
@@ -87,38 +110,116 @@ def main(argv=None):
         except Exception:  # noqa: BLE001 — dead device -> no RTT row
             pass
 
-    import threading
+    out: list = []
+    t = threading.Thread(target=_probe, args=(out,), daemon=True)
+    t.start()
+    t.join(timeout=60)
+    return out[0] if out else None
 
-    _rtt_out: list = []
-    _t = threading.Thread(target=_probe_rtt, args=(_rtt_out,), daemon=True)
-    _t.start()
-    _t.join(timeout=60)
-    rtt_ms = _rtt_out[0] if _rtt_out else None
-    print(json.dumps({
-        "metric": "impala_agent_sps",
-        "value": round(out["sps"], 1),
-        "steady_sps": out.get("steady_sps"),
-        "act_rtt_floor_ms": None if rtt_ms is None else round(rtt_ms, 2),
-        "unit": "env_frames/s",
-        "scale": args.scale,
-        "steps": out["steps"],
-        "sgd_steps": out["sgd_steps"],
-        "seconds": round(dt, 1),
-        "platform": dev.platform,
-        "device_kind": getattr(dev, "device_kind", dev.platform),
-        "config": (
-            f"synthetic-atari 84x84x4, actor_batch {cfg['actor_batch_size']}"
-            f"x{cfg['num_actor_batches']}, T={cfg['unroll_length']}, "
-            f"B={cfg['batch_size']}, vbs={cfg['virtual_batch_size']}, "
-            f"ImpalaNet, act+step+learn overlapped on one device"
-        ),
-        "baseline": (
-            "reference flagship loop examples/vtrace/experiment.py + "
-            "config.yaml:23-65 (no published number; real-time actor floor "
-            "2*128 envs * 60 fps = 15360 frames/s)"
-        ),
-    }))
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", default="reference", choices=["reference", "small"])
+    p.add_argument("--total_steps", type=int, default=None, help="override step budget")
+    p.add_argument(
+        "--rollout", default="both", choices=["both", "device", "legacy"],
+        help="which actor data plane(s) to measure; 'both' runs legacy "
+        "first, then device, in one process (A/B on identical config)",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="smoke gate (ci.sh): exit non-zero unless every mode that ran "
+        "reports steady_sps > 0",
+    )
+    args = p.parse_args(argv)
+
+    if args.scale == "reference":
+        cfg = dict(env="synthetic", actor_batch_size=128, num_actor_batches=2,
+                   batch_size=32, virtual_batch_size=32, unroll_length=20,
+                   num_env_processes=8, log_interval=10)
+        frames_per_batch = cfg["batch_size"] * cfg["unroll_length"]
+        total = args.total_steps or max(
+            24 * frames_per_batch,
+            cfg["actor_batch_size"] * cfg["unroll_length"] * 6,
+        )
+    else:
+        # Actor-plane regime (see module docstring): MLP env, long unrolls,
+        # virtual batching.  log_interval 1 s so the steady-state window has
+        # samples even on a fast box.
+        cfg = dict(env="catch_flat", actor_batch_size=16, num_actor_batches=2,
+                   batch_size=16, virtual_batch_size=64, unroll_length=40,
+                   num_env_processes=2, log_interval=1)
+        total = args.total_steps or 96_000
+
+    modes = {"both": ("legacy", "device"), "device": ("device",),
+             "legacy": ("legacy",)}[args.rollout]
+    rows = []
+    for i, mode in enumerate(modes):
+        out, bpf, dt = _run_mode(
+            cfg, total, device_rollout=(mode == "device"), port=4431 + 2 * i,
+        )
+        rows.append((mode, out, bpf, dt))
+
+    import jax
+
+    dev = jax.devices()[0]
+    rtt_ms = _probe_rtt()
+    ok = True
+    by_mode = {}
+    for mode, out, bpf, dt in rows:
+        row = {
+            "metric": "impala_agent_sps",
+            "rollout": mode,
+            "value": round(out["sps"], 1),
+            "steady_sps": out.get("steady_sps"),
+            "host_boundary_bytes_per_frame": bpf,
+            "act_rtt_floor_ms": None if rtt_ms is None else round(rtt_ms, 2),
+            "unit": "env_frames/s",
+            "scale": args.scale,
+            "steps": out["steps"],
+            "sgd_steps": out["sgd_steps"],
+            "seconds": round(dt, 1),
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "config": (
+                f"{cfg['env']}, actor_batch {cfg['actor_batch_size']}"
+                f"x{cfg['num_actor_batches']}, T={cfg['unroll_length']}, "
+                f"B={cfg['batch_size']}, vbs={cfg['virtual_batch_size']}, "
+                "act+step+learn overlapped on one device"
+            ),
+            "baseline": (
+                "reference flagship loop examples/vtrace/experiment.py + "
+                "config.yaml:23-65 (no published number; real-time actor "
+                "floor 2*128 envs * 60 fps = 15360 frames/s)"
+            ),
+        }
+        print(json.dumps(row))
+        by_mode[mode] = row
+        if not (row["steady_sps"] and row["steady_sps"] > 0):
+            ok = False
+    if len(by_mode) == 2:
+        leg, dev_row = by_mode["legacy"], by_mode["device"]
+        summary = {
+            "metric": "impala_agent_rollout_ab",
+            "scale": args.scale,
+            "steady_speedup": (
+                round(dev_row["steady_sps"] / leg["steady_sps"], 2)
+                if leg["steady_sps"] and dev_row["steady_sps"] else None
+            ),
+            "bytes_per_frame_reduction": (
+                round(leg["host_boundary_bytes_per_frame"]
+                      / dev_row["host_boundary_bytes_per_frame"], 2)
+                if leg["host_boundary_bytes_per_frame"]
+                and dev_row["host_boundary_bytes_per_frame"] else None
+            ),
+        }
+        print(json.dumps(summary))
+    if args.check and not ok:
+        print("agent_bench --check: a rollout mode is missing steady_sps > 0",
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

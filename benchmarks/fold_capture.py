@@ -163,8 +163,28 @@ def _parse_json_line(path, marker, cpu_gate=True):
 
 
 def parse_agent(path):
-    """agent_bench prints one {'metric': 'impala_agent_sps', ...} JSON line."""
-    return _parse_json_line(path, "impala_agent_sps")
+    """agent_bench prints one {'metric': 'impala_agent_sps', ...} JSON line
+    per rollout mode (device + legacy since the device-resident actor
+    pipeline).  The TPU record keeps the device-rollout row as the
+    headline; the last line wins if 'rollout' is absent (pre-A/B logs)."""
+    row = _parse_json_lines_by(path, "device")
+    return row if row is not None else _parse_json_line(path, "impala_agent_sps")
+
+
+def _parse_json_lines_by(path, rollout):
+    """The impala_agent_sps row for a specific rollout mode (chip-gated)."""
+    try:
+        with open(path) as f:
+            for line in reversed(f.read().splitlines()):
+                if line.startswith("{") and "impala_agent_sps" in line:
+                    row = json.loads(line)
+                    if row.get("platform") == "cpu":
+                        return None
+                    if row.get("rollout") == rollout:
+                        return row
+    except (OSError, json.JSONDecodeError):
+        return None
+    return None
 
 
 def parse_r2d2(path):
@@ -227,22 +247,53 @@ def parse_allreduce(path):
     return lines if any(re.match(r"\s*\d+\s", l) for l in lines) else None
 
 
+def parse_agent_lines(path):
+    """agent_bench stdout: one ``impala_agent_sps`` JSON row per rollout
+    mode plus the ``impala_agent_rollout_ab`` summary.  Anything else
+    (progress prints, tracebacks riding 2>&1) is dropped; garbled JSON
+    lines (killed mid-write) are skipped."""
+    keep = []
+    try:
+        with open(path) as f:
+            for line in f.read().splitlines():
+                if not line.startswith("{"):
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("metric") in ("impala_agent_sps",
+                                         "impala_agent_rollout_ab"):
+                    keep.append(json.dumps(row))
+    except OSError:
+        return None
+    return keep or None
+
+
 def fold_local(log_path, json_path):
-    """Merge a fresh allreduce_bench capture into BENCH_LOCAL.json: only the
-    ``allreduce_rpc`` section's stdout is replaced; every other section
-    (rpc, envpool, agent, ...) is preserved verbatim — same row-preservation
-    policy as the BENCH_TPU merges above."""
+    """Merge a fresh local capture into BENCH_LOCAL.json: only the section
+    the log belongs to — ``allreduce_rpc`` for an allreduce_bench capture,
+    ``agent_small`` for an agent_bench one (detected by content) — has its
+    stdout replaced; every other section (rpc, envpool, ...) is preserved
+    verbatim — same row-preservation policy as the BENCH_TPU merges above."""
     if os.path.exists(json_path):
         # A corrupt record must ABORT, not be clobbered (curated history).
         with open(json_path) as f:
             data = json.load(f)
     else:
         data = {}
-    lines = parse_allreduce(log_path)
-    if not lines:
-        raise SystemExit(f"no allreduce rows found in {log_path}")
-    sec = dict(data.get("allreduce_rpc", {}))
-    sec.setdefault("cmd", "benchmarks/allreduce_bench.py rpc")
+    agent_lines = parse_agent_lines(log_path)
+    if agent_lines:
+        section, cmd, lines = (
+            "agent_small", "benchmarks/agent_bench.py --scale small", agent_lines
+        )
+    else:
+        lines = parse_allreduce(log_path)
+        if not lines:
+            raise SystemExit(f"no allreduce or agent rows found in {log_path}")
+        section, cmd = "allreduce_rpc", "benchmarks/allreduce_bench.py rpc"
+    sec = dict(data.get(section, {}))
+    sec.setdefault("cmd", cmd)
     sec["rc"] = 0
     sec["stdout"] = lines
     sec["stderr"] = []
@@ -252,13 +303,13 @@ def fold_local(log_path, json_path):
         ).isoformat()
     except OSError:
         sec["captured_when"] = datetime.date.today().isoformat()
-    data["allreduce_rpc"] = sec
+    data[section] = sec
     tmp = f"{json_path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1)
         f.write("\n")
     os.replace(tmp, json_path)
-    print(f"folded allreduce rows -> {json_path} (allreduce_rpc; other sections preserved)")
+    print(f"folded {section} rows -> {json_path} ({section}; other sections preserved)")
 
 
 def main():

@@ -32,6 +32,7 @@ MODULES = [
     ("moolib_tpu.buckets", "Flat-bucket gradient data plane"),
     ("moolib_tpu.envpool", "EnvPool"),
     ("moolib_tpu.batcher", "Batcher"),
+    ("moolib_tpu.rollout", "Device-resident actor rollout"),
     ("moolib_tpu.replay", "Replay"),
     ("moolib_tpu.checkpoint", "Checkpointing"),
     ("moolib_tpu.watchdog", "Watchdog (run-loop deadman)"),

@@ -35,6 +35,7 @@ __all__ = [
     "Rpc",
     "RpcDeferredReturn",
     "RpcError",
+    "rollout",
     "Watchdog",
     "WatchdogTimeout",
     "create_uid",
@@ -63,10 +64,10 @@ _LAZY = {
 
 
 def __getattr__(name):  # lazy imports keep `import moolib_tpu` light
-    if name == "buckets":  # flat-bucket gradient data plane (submodule)
+    if name in ("buckets", "rollout"):  # data-plane submodules (jax-heavy)
         import importlib
 
-        value = importlib.import_module(".buckets", __name__)
+        value = importlib.import_module(f".{name}", __name__)
         globals()[name] = value
         return value
     mod_name = _LAZY.get(name)

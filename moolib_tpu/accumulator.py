@@ -222,6 +222,14 @@ def _tree_nbytes(tree) -> int:
     return total
 
 
+def _leaf_dtype(g) -> np.dtype:
+    """Leaf dtype without materializing values: callers now pass DEVICE
+    gradient trees (sharded on mesh runs), where np.asarray would force a
+    cross-device gather + D2H of the whole leaf just to read metadata."""
+    dt = getattr(g, "dtype", None)
+    return np.dtype(dt) if dt is not None else np.asarray(g).dtype
+
+
 class Accumulator:
     """See module docstring. API mirrors the reference's pybind surface."""
 
@@ -1145,12 +1153,18 @@ class Accumulator:
             self._ici_round(stats, gradients)
             return
         if self._virtual_batch_size is not None:
+            # Device gradient trees (the examples pass grads straight from
+            # grad_fn now): issue every leaf's D2H before the first blocking
+            # np.asarray below, so the transfers overlap each other and the
+            # host-side f32 staging instead of serializing leaf by leaf —
+            # the same contract _stage_flat honors for the bucketed plane.
+            for leaf in jax.tree_util.tree_leaves(gradients):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
             # Remember the true dtypes so gradients() can restore them (local
             # accumulation is in f32).  np.asarray is a no-copy view when the
             # leaf is already host f32; only genuine dtype changes copy.
-            self._grad_dtypes = jax.tree_util.tree_map(
-                lambda g: np.asarray(g).dtype, gradients
-            )
+            self._grad_dtypes = jax.tree_util.tree_map(_leaf_dtype, gradients)
             local = jax.tree_util.tree_map(
                 lambda g: np.asarray(g, np.float32), gradients
             )
@@ -1171,9 +1185,7 @@ class Accumulator:
         if use_ring:
             # Ring path: contribute f32 (EF-quantized at the source when the
             # wire is int8); bf16/f32 hop transport lives in the ring codec.
-            self._grad_dtypes = jax.tree_util.tree_map(
-                lambda g: np.asarray(g).dtype, gradients
-            )
+            self._grad_dtypes = jax.tree_util.tree_map(_leaf_dtype, gradients)
             gradients = jax.tree_util.tree_map(
                 lambda g: np.asarray(g, np.float32), gradients
             )
@@ -1181,9 +1193,7 @@ class Accumulator:
             self._start_round("ring_full", stats, gradients)
             return
         if self._wire_dtype is not None:
-            self._grad_dtypes = jax.tree_util.tree_map(
-                lambda g: np.asarray(g).dtype, gradients
-            )
+            self._grad_dtypes = jax.tree_util.tree_map(_leaf_dtype, gradients)
         if self._wire_q8:
             gradients, self._q_residual = _quantize_q8(gradients, self._q_residual)
         elif self._wire_dtype is not None:
@@ -1319,9 +1329,7 @@ class Accumulator:
                     f"{len(self._inflight)} gradient reductions already in flight "
                     f"(parallel_gradients={self._parallel_gradients})"
                 )
-            self._grad_dtypes = jax.tree_util.tree_map(
-                lambda g: np.asarray(g).dtype, gradients
-            )
+            self._grad_dtypes = jax.tree_util.tree_map(_leaf_dtype, gradients)
             if self._ici_executor is None:
                 self._ici_executor = _IciWorker(f"ici-{self._name}")
             # Captured under the lock: a cohort abort on the RPC handler

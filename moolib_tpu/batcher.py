@@ -13,6 +13,23 @@ copying slot-by-slot, items are accumulated as host numpy and the completed
 batch goes to the accelerator in one ``jax.device_put`` of the whole stacked
 pytree (one contiguous host→HBM DMA per leaf; a ``jax.sharding.Sharding``
 may be passed as ``device`` to land the batch pre-sharded across a mesh).
+
+Two assembly paths (docs/DESIGN.md "Actor data plane"):
+
+- **host** (numpy items): leaves accumulate as host numpy — device-array
+  leaves are coerced down (a D2H crossing, counted in
+  ``batcher_d2h_bytes_total``) — and the completed batch crosses up in one
+  ``device_put`` when a device is set (``batcher_h2d_bytes_total``).  This
+  is the legacy rollout data plane: every batch pays a down-and-up round
+  trip.
+- **device** (jax.Array items, e.g. the unrolls a
+  :class:`~moolib_tpu.rollout.DeviceRollout` hands over): leaves stay on
+  the device; stack/cat/split run as XLA ops and the "completed batch" is
+  device-resident already — zero host-boundary bytes.  ``device_put`` still
+  applies a sharding when one was requested (mesh learners).
+
+The path is latched from the first item's leaf type unless forced with the
+``host=`` constructor argument.
 """
 
 from __future__ import annotations
@@ -38,6 +55,27 @@ _M_READY_DEPTH = _REG.gauge("batcher_ready_depth", "completed batches awaiting g
 _M_READY_WAIT = _REG.histogram(
     "batcher_ready_wait_seconds", "batch completion to get()/await"
 )
+# Host-boundary traffic of batch assembly (docs/TELEMETRY.md): the host path
+# pays D2H per coerced device leaf and H2D per completed-batch device_put;
+# the device path pays neither.
+_M_D2H_BYTES = _REG.counter(
+    "batcher_d2h_bytes_total", "device leaves coerced to host during assembly"
+)
+_M_H2D_BYTES = _REG.counter(
+    "batcher_h2d_bytes_total", "completed host batches uploaded by device_put"
+)
+
+
+def _host_stack_leaves(xs, dim):
+    """numpy counterpart of ``nest._stack_leaves`` (same object-leaf
+    fallback) — the host path must never bounce through jnp."""
+    try:
+        return np.stack(xs, axis=dim)
+    except (TypeError, ValueError):
+        out = np.empty(len(xs), dtype=object)
+        for i, x in enumerate(xs):
+            out[i] = x
+        return out
 
 
 def _resolve_device(device):
@@ -59,31 +97,74 @@ class Batcher:
     """See module docstring. API: stack(item), cat(item), empty(), size(),
     get(), plus awaitable batches."""
 
-    def __init__(self, size: int, device: Optional[str] = None, dim: int = 0):
+    def __init__(self, size: int, device: Optional[str] = None, dim: int = 0,
+                 host: Optional[bool] = None):
         if size < 1:
             raise ValueError("batch size must be >= 1")
         self._size = size
         self._dim = dim
         self._device = _resolve_device(device)
+        # None = latch from the first item: jax.Array leaves keep the
+        # device-side path (XLA stack/cat, no crossings), anything else
+        # accumulates as host numpy.  True/False forces a path.
+        self._host = host
         self._lock = threading.Lock()
         self._slots: List[Any] = []
         self._cat_count = 0
         self._ready: collections.deque = collections.deque()
         self._waiters: collections.deque = collections.deque()
 
+    def _latch_path(self, item) -> None:
+        if self._host is None:
+            leaf = next(nest.flatten(item), None)
+            self._host = not isinstance(leaf, jax.Array)
+
+    def _to_host(self, item):
+        """Host-path coercion: device leaves come down (counted D2H)."""
+
+        def _coerce(x):
+            if isinstance(x, jax.Array):
+                out = np.asarray(x)
+                _M_D2H_BYTES.inc(out.nbytes)
+                return out
+            return x
+
+        return nest.map(_coerce, item)
+
+    def _assemble(self, items):
+        """Stack slot items into a batch on the latched path."""
+        if self._host:
+            return nest.map_many(
+                lambda *xs: _host_stack_leaves(xs, self._dim), *items
+            )
+        return nest.stack(items, dim=self._dim)
+
+    def _assemble_cat(self, items):
+        if self._host:
+            return nest.map_many(
+                lambda *xs: np.concatenate(xs, axis=self._dim), *items
+            )
+        return nest.cat(items, dim=self._dim)
+
     # ---------------------------------------------------------------- fill
     def stack(self, item) -> None:
         """Add one item; a batch completes after ``size`` calls (new axis)."""
         with self._lock:
+            self._latch_path(item)
+            if self._host:
+                item = self._to_host(item)
             self._slots.append(item)
             if len(self._slots) >= self._size:
                 items, self._slots = self._slots[: self._size], self._slots[self._size :]
-                self._finish(nest.stack(items, dim=self._dim))
+                self._finish(self._assemble(items))
 
     def cat(self, item) -> None:
         """Add an item whose leaves already have the batch axis; completes
         when ``size`` rows accumulate, splitting oversized items (carry-over)."""
         with self._lock:
+            self._latch_path(item)
+            if self._host:
+                item = self._to_host(item)
             length = self._item_length(item)
             offset = 0
             while offset < length:
@@ -101,7 +182,7 @@ class Batcher:
                     items, self._slots = self._slots, []
                     self._cat_count = 0
                     self._finish(
-                        items[0] if len(items) == 1 else nest.cat(items, dim=self._dim)
+                        items[0] if len(items) == 1 else self._assemble_cat(items)
                     )
 
     def _item_length(self, item) -> int:
@@ -118,6 +199,10 @@ class Batcher:
     def _finish(self, batch) -> None:
         # One device_put of the whole pytree: a single host->HBM hop per leaf.
         if self._device is not None:
+            if self._host:
+                _M_H2D_BYTES.inc(
+                    sum(getattr(x, "nbytes", 0) for x in nest.flatten(batch))
+                )
             batch = jax.device_put(batch, self._device)
         _M_BATCHES.inc()
         _M_ITEMS.inc(self._size)

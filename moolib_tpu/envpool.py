@@ -521,6 +521,13 @@ class EnvStepper:
             raise RuntimeError(
                 f"batch {batch_index} already has a step in flight; call result() first"
             )
+        # Device/async action seam (docs/DESIGN.md "Actor data plane"): a
+        # jax.Array (or rollout.PendingAction) is accepted directly — its
+        # D2H is started async so the blocking np.asarray below completes
+        # from a transfer that overlapped the caller's dispatch work rather
+        # than starting one now.
+        if hasattr(action, "copy_to_host_async"):
+            action.copy_to_host_async()
         act = np.asarray(action)
         av = self._act_views[batch_index]
         if act.shape != av.shape:
@@ -845,6 +852,16 @@ class EnvPool:
     @property
     def batch_size(self) -> int:
         return self._batch_size
+
+    @property
+    def obs_spec(self) -> Dict[str, Tuple[Tuple[int, ...], np.dtype]]:
+        """Per-env observation spec ``{key: (shape, dtype)}`` discovered from
+        worker 0's first reset (reward/done included).  Callers sizing
+        device-side rollout buffers read the env's native dtype here —
+        uint8 frames must cross the host boundary as uint8."""
+        return {
+            k: (v.shape[1:], v.dtype) for k, v in self._obs_views[0].items()
+        }
 
     @property
     def num_batches(self) -> int:

@@ -14,5 +14,5 @@ for gymnasium ids, and an ALE factory (``create_env``) that needs ale_py
 
 from .atari import AtariPreprocessing, GymEnv, create_env  # noqa: F401
 from .cartpole import CartPoleEnv  # noqa: F401
-from .catch import CatchEnv, FrameStack  # noqa: F401
+from .catch import CatchEnv, FlatCatchEnv, FrameStack  # noqa: F401
 from .synthetic import SyntheticAtariEnv  # noqa: F401

@@ -60,6 +60,27 @@ class CatchEnv:
         return self._obs(), reward, done, {}
 
 
+class FlatCatchEnv(CatchEnv):
+    """Catch with the board flattened to a 1-D uint8 vector.
+
+    Routes through the MLP (``ActorCriticNet``) instead of the conv encoder:
+    the per-frame model compute drops to microseconds, which makes this the
+    actor-data-plane benchmark env — at this scale whole-agent SPS measures
+    dispatch/copy overhead per frame, not conv FLOPs, the same regime a TPU
+    learner leaves the actor loop in (``benchmarks/agent_bench.py --scale
+    small``).  Observations stay uint8 so the single-crossing upload
+    contract is exercised end to end.
+    """
+
+    @property
+    def observation_shape(self):
+        h, w, c = super().observation_shape
+        return (h * w * c,)
+
+    def _obs(self):
+        return super()._obs().reshape(-1)
+
+
 class FrameStack:
     """Stack the last ``num_stack`` single-channel frames on the channel axis
     (the reference trains on (84, 84, 4) stacked Atari frames,

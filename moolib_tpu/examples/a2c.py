@@ -172,6 +172,10 @@ def train(flags, on_stats=None) -> dict:
     action = jnp.zeros((B,), jnp.int32)
     prev_action = action
     steps_collected = []
+    # Latest learn-step aux, kept as DEVICE scalars: fetched in one
+    # device_get at the log tick instead of a float() learner-stream sync
+    # on every SGD step.
+    pending_aux = None
     last_log = time.time()
     start = time.time()
     # Loop-phase breakdown: sections export as loop_section_seconds{section=}
@@ -270,15 +274,22 @@ def train(flags, on_stats=None) -> dict:
                     (loss, aux), grads = grad_fn(
                         params, batch=batch, initial_core_state=steps_collected[0]["core"]
                     )
-                    stats["pg_loss"] = float(aux["pg_loss"])
-                    stats["entropy_loss"] = float(aux["entropy_loss"])
-                    accumulator.reduce_gradients(B, jax.device_get(grads))
+                    pending_aux = (aux["pg_loss"], aux["entropy_loss"])
+                    # Device grads straight in: the Accumulator's staging
+                    # overlaps the per-leaf D2H (PR 4) — device_get here
+                    # would block on the whole tree first.
+                    accumulator.reduce_gradients(B, grads)
                 # Carry the last step into the next unroll (overlap of 1);
                 # it still records the LSTM state that entered it.
                 steps_collected = steps_collected[-1:]
 
             if time.time() - last_log > flags.log_interval:
                 last_log = time.time()
+                if pending_aux is not None:
+                    pg_v, ent_v = jax.device_get(pending_aux)
+                    stats["pg_loss"] = float(pg_v)
+                    stats["entropy_loss"] = float(ent_v)
+                    pending_aux = None
                 if window_returns:
                     stats["mean_episode_return"] = float(np.mean(window_returns[-100:]))
                 sps = stats["steps"] / max(time.time() - start, 1e-6)
@@ -293,6 +304,11 @@ def train(flags, on_stats=None) -> dict:
                     )
                 if on_stats is not None:
                     on_stats(dict(stats))
+        if pending_aux is not None:  # tail flush so the returned stats are fresh
+            pg_v, ent_v = jax.device_get(pending_aux)
+            stats["pg_loss"] = float(pg_v)
+            stats["entropy_loss"] = float(ent_v)
+            pending_aux = None
     finally:
         wd.close()
         envs.close()

@@ -171,10 +171,19 @@ class EnvBatchState:
         self.batch_size = batch_size
         self.unroll_length = unroll_length
         self.prev_action = jnp.zeros((batch_size,), jnp.int32)
+        # Host mirror of prev_action for the legacy host-batcher path: the
+        # realized action of the previous step, so the unroll row never
+        # forces an extra device round trip.
+        self.prev_action_host = np.zeros((batch_size,), np.int32)
         self.core_state = model.initial_state(batch_size)
         self.initial_core_state = self.core_state
         self.time_batcher = Batcher(unroll_length + 1, device=None, dim=0)
         self.future = None
+        # Device-rollout mode (moolib_tpu.rollout.DeviceRollout): assigned by
+        # the experiment when --device_rollout is on; owns the on-chip
+        # [T+1, B] buffer, carried core state, and on-device prev_action —
+        # the host fields above then serve only the stats accounting below.
+        self.rollout = None
         self.episode_return = np.zeros(batch_size, np.float64)
         self.episode_step = np.zeros(batch_size, np.int64)
         self.running_reward = np.zeros(batch_size, np.float64)

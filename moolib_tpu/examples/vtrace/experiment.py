@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ... import Accumulator, Batcher, Broker, EnvPool, Group, Rpc, telemetry, utils
+from ... import Accumulator, Batcher, Broker, EnvPool, Group, Rpc, rollout, telemetry, utils
 from ...envs import CartPoleEnv, CatchEnv, SyntheticAtariEnv
 from ...models import ActorCriticNet, ImpalaNet
 from ...ops import entropy_loss, softmax_cross_entropy, vtrace
@@ -49,8 +49,8 @@ def make_flags(argv=None):
     p.add_argument(
         "--env",
         default="catch",
-        help="catch | pixel_catch | cartpole | synthetic | atari:<Game> "
-        "(needs ale_py) | gym:<gymnasium id> (Discrete actions)",
+        help="catch | catch_flat | pixel_catch | cartpole | synthetic | "
+        "atari:<Game> (needs ale_py) | gym:<gymnasium id> (Discrete actions)",
     )
     p.add_argument("--total_steps", type=int, default=500_000)
     p.add_argument("--actor_batch_size", type=int, default=32)
@@ -131,6 +131,17 @@ def make_flags(argv=None):
                    "MOOLIB_COMPILE_CACHE): a restarted peer skips "
                    "recompilation — the dominant cold-restart cost the "
                    "soak's recovery SLO budgets (docs/RESILIENCE.md)")
+    p.add_argument(
+        "--device_rollout",
+        type=_bool_flag,
+        default=True,
+        help="device-resident actor pipeline (docs/DESIGN.md 'Actor data "
+        "plane'): on-chip [T+1, B] rollout buffers written by a fused act "
+        "step, uint8 single-crossing obs upload, async action fetch, "
+        "on-device learner batch assembly.  --device_rollout=false keeps "
+        "the legacy host-batcher path (bit-exact trajectories, 3 float32 "
+        "host-boundary crossings per frame)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--watchdog", type=float, default=0.0,
@@ -141,12 +152,25 @@ def make_flags(argv=None):
     return common.finalize_flags(p, argv)
 
 
+def _bool_flag(v) -> bool:
+    """argparse-friendly bool: ``--device_rollout false`` works (store_true
+    can't express an =false override)."""
+    return str(v).strip().lower() not in ("0", "false", "no", "off", "")
+
+
 def make_env_factory(flags):
     # Envs use OS-entropy seeding (seed=None): a fixed seed here would make
     # every env in every worker replay identical trajectories, silently
     # correlating the whole actor batch. flags.seed still seeds the model.
     if flags.env == "catch":
         return CatchEnv, CatchEnv().num_actions, (10, 5, 1)
+    if flags.env == "catch_flat":
+        # Board flattened to a (50,) uint8 vector -> ActorCriticNet MLP:
+        # per-frame model compute is negligible, so whole-agent SPS measures
+        # the actor data plane itself (agent_bench --scale small).
+        from ...envs import FlatCatchEnv
+
+        return FlatCatchEnv, FlatCatchEnv.num_actions, (50,)
     if flags.env == "pixel_catch":
         # Catch rendered as a frame: the optimal policy requires *reading the
         # pixels* (ball position only exists in the image), so this is the
@@ -185,8 +209,8 @@ def make_env_factory(flags):
         return partial(GymEnv, env_id), n, tuple(shape)
     if flags.env != "synthetic":
         raise ValueError(
-            f"unknown --env {flags.env!r} (catch | pixel_catch | pixel_catch84 "
-            "| cartpole | synthetic | atari:<Game> | gym:<id>)"
+            f"unknown --env {flags.env!r} (catch | catch_flat | pixel_catch "
+            "| pixel_catch84 | cartpole | synthetic | atari:<Game> | gym:<id>)"
         )
     return SyntheticAtariEnv, 6, (84, 84, 4)
 
@@ -380,7 +404,11 @@ def train(flags, on_stats=None) -> dict:
     mesh = None
     batch_sharding = None
     core_sharding = None
-    opt_apply = None
+
+    def _opt_apply(p, o, g):
+        updates, o = opt.update(g, o, p)
+        return optax.apply_updates(p, updates), o
+
     if flags.mesh:
         from ... import parallel
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -409,11 +437,6 @@ def train(flags, on_stats=None) -> dict:
             in_shardings=(param_sh, batch_sharding, core_sharding),
             out_shardings=((rep, rep), param_sh),
         )
-
-        def _opt_apply(p, o, g):
-            updates, o = opt.update(g, o, p)
-            return optax.apply_updates(p, updates), o
-
         # No donation: the Accumulator retains references to the previous
         # params tree for model sync; donating would invalidate them.
         opt_apply = jax.jit(
@@ -423,6 +446,11 @@ def train(flags, on_stats=None) -> dict:
         )
     else:
         grad_fn = jax.jit(raw_grad)
+        # Jitted even unmeshed: the eager optax chain re-dispatches ~100 ops
+        # per apply (~30 ms on a 1-core box vs ~1 ms compiled) and
+        # host-numpy cohort gradients cross in one fused transfer.  Same
+        # no-donation rule as the mesh path.
+        opt_apply = jax.jit(_opt_apply)
 
     # --- cohort wiring ---------------------------------------------------
     broker: Optional[Broker] = None
@@ -507,6 +535,15 @@ def train(flags, on_stats=None) -> dict:
     env_states = [
         common.EnvBatchState(B, T, model) for _ in range(flags.num_actor_batches)
     ]
+    if flags.device_rollout:
+        # Device-resident rollout buffers (docs/DESIGN.md "Actor data
+        # plane"): sized from the pool's discovered spec so the env's native
+        # dtype — uint8 for frames — is what crosses the boundary.
+        env_obs_shape, env_obs_dtype = envs[0].obs_spec["state"]
+        for st in env_states:
+            st.rollout = rollout.DeviceRollout(
+                model, B, T, env_obs_shape, env_obs_dtype, num_actions
+            )
     # With a mesh, the Batcher lands batches pre-sharded (device_put accepts
     # a NamedSharding target): [T+1, B] over (∅, dp).
     learn_batcher = Batcher(
@@ -523,6 +560,20 @@ def train(flags, on_stats=None) -> dict:
         if flags.use_lstm
         else None
     )
+
+    # Learner scalars accumulate as device arrays and are fetched in ONE
+    # device_get per stats/log tick — the per-SGD-step float(loss) sync they
+    # replace stalled the learner stream on every step.
+    pending_learn_stats: list = []
+
+    def _flush_learn_stats() -> None:
+        if not pending_learn_stats:
+            return
+        for loss_v, pg_v, ent_v in jax.device_get(pending_learn_stats):
+            stats["loss"] += float(loss_v)
+            stats["pg_loss"] += float(pg_v)
+            stats["entropy_loss"] += float(ent_v)
+        pending_learn_stats.clear()
 
     last_stats = time.monotonic()
     last_log = time.monotonic()
@@ -586,6 +637,7 @@ def train(flags, on_stats=None) -> dict:
                 print(f"profiler trace written to {flags.trace_dir}")
             if now - last_stats > flags.stats_interval:
                 last_stats = now
+                _flush_learn_stats()  # one fetch; cohort sees fresh loss
                 global_stats.reduce(stats)
             if (
                 flags.checkpoint
@@ -601,11 +653,7 @@ def train(flags, on_stats=None) -> dict:
             if accumulator.has_gradients():
                 with timer.section("apply"), wd.section("apply"):
                     grads = accumulator.gradients()
-                    if opt_apply is not None:
-                        params, opt_state = opt_apply(params, opt_state, grads)
-                    else:
-                        updates, opt_state = opt.update(grads, opt_state, params)
-                        params = optax.apply_updates(params, updates)
+                    params, opt_state = opt_apply(params, opt_state, grads)
                     accumulator.set_parameters(params)
                     accumulator.zero_gradients()
                 stats["sgd_steps"] += 1
@@ -613,53 +661,117 @@ def train(flags, on_stats=None) -> dict:
                 with timer.section("learn"), wd.section("learn"):
                     batch = learn_batcher.get()
                     initial_core = core_batcher.get() if core_batcher is not None else ()
+                    if not flags.device_rollout:
+                        # Legacy host batches cross implicitly at this jit
+                        # call — the third float32 crossing of every frame.
+                        rollout.count_h2d(
+                            sum(
+                                x.nbytes
+                                for x in utils.nest.flatten(batch)
+                                if isinstance(x, np.ndarray)
+                            )
+                        )
                     (loss, aux), grads = grad_fn(params, batch, initial_core)
-                    stats["loss"] += float(loss)
-                    stats["pg_loss"] += float(aux["pg_loss"])
-                    stats["entropy_loss"] += float(aux["entropy_loss"])
-                    accumulator.reduce_gradients(flags.batch_size, jax.device_get(grads))
+                    # Device scalars only: the float() fetch that used to
+                    # live here synced the learner stream every SGD step.
+                    # They accumulate on device and are fetched in one batch
+                    # per stats/log tick (_flush_learn_stats).
+                    pending_learn_stats.append(
+                        (loss, aux["pg_loss"], aux["entropy_loss"])
+                    )
+                    # Device grads go straight in: Accumulator staging
+                    # issues per-leaf copy_to_host_async so D2H overlaps
+                    # the flat fill (PR 4) — a device_get here would
+                    # serialize the whole tree first.
+                    accumulator.reduce_gradients(flags.batch_size, grads)
             else:
                 # --- act ------------------------------------------------
                 st = env_states[cur]
                 with timer.section("env_wait"), wd.section("env_wait"):
                     obs = st.future.result()
                 st.update(obs, stats)
-                inputs = {
-                    "state": jnp.asarray(np.asarray(obs["state"], np.float32))[None],
-                    "reward": jnp.asarray(obs["reward"])[None],
-                    "done": jnp.asarray(obs["done"])[None],
-                    "prev_action": st.prev_action[None],
-                }
-                rng, act_rng = jax.random.split(rng)
-                core_before = st.core_state  # LSTM state entering this step
-                with timer.section("act"), wd.section("act"):
-                    out, new_core = act_step(params, inputs, st.core_state, act_rng)
-                action = out["action"][0]
-                # Queue the next env step immediately (overlaps with learning).
-                st.future = envs[cur].step(0, np.asarray(action))
-                st.time_batcher.stack(
-                    {
-                        "state": inputs["state"][0],
-                        "reward": inputs["reward"][0],
-                        "done": inputs["done"][0],
-                        "prev_action": st.prev_action,
-                        "action": action,
-                        "policy_logits": out["policy_logits"][0],
+                if flags.device_rollout:
+                    # Device-resident path: obs crosses once (native dtype),
+                    # the fused jitted step writes the on-chip [T+1, B]
+                    # buffer, and the action comes back asynchronously.
+                    with timer.section("act"), wd.section("act"):
+                        pending, rng = st.rollout.step(params, obs, rng)
+                    unroll = st.rollout.take_unroll()  # device pytree or None
+                    if unroll is not None:
+                        learn_batcher.cat(unroll)  # on-device cat/split
+                        if core_batcher is not None:
+                            core_batcher.cat(st.rollout.completed_initial_core)
+                    # Realize as late as possible: the D2H issued at
+                    # dispatch overlapped the unroll hand-off above.  A
+                    # separate timer/watchdog section keeps `act` honest —
+                    # it now measures dispatch, this measures the fetch.
+                    with timer.section("act_fetch"), wd.section("act_fetch"):
+                        action_np = pending.realize()
+                    st.future = envs[cur].step(0, action_np)
+                else:
+                    # Legacy host-batcher path (--device_rollout=false):
+                    # float32 staging on the host, three boundary crossings
+                    # per frame — kept bit-exact as the equivalence baseline
+                    # (tests/test_rollout.py), with its crossings counted on
+                    # the same telemetry the device path reports.
+                    # np.array (copy=True): obs are zero-copy shm views the
+                    # env workers overwrite on the next step — the unroll
+                    # rows must own their memory.
+                    state_f32 = np.array(obs["state"], np.float32)
+                    reward_np = np.array(obs["reward"], np.float32)
+                    done_np = np.array(obs["done"], bool)
+                    inputs = {
+                        "state": jnp.asarray(state_f32)[None],
+                        "reward": jnp.asarray(reward_np)[None],
+                        "done": jnp.asarray(done_np)[None],
+                        "prev_action": st.prev_action[None],
                     }
-                )
-                st.prev_action = action
-                st.core_state = new_core
-                if not st.time_batcher.empty():
-                    unroll = st.time_batcher.get()  # [T+1, B, ...]
-                    learn_batcher.cat(unroll)
-                    if core_batcher is not None:
-                        core_batcher.cat(st.initial_core_state)
-                    # Carry the last timestep into the next unroll; its
-                    # initial LSTM state is the state *before* that step.
-                    st.initial_core_state = core_before
-                    st.time_batcher.stack(
-                        {k: v[-1] for k, v in unroll.items()}
+                    rollout.count_h2d(
+                        state_f32.nbytes + reward_np.nbytes + done_np.nbytes
                     )
+                    rollout.count_frames(B)
+                    rng, act_rng = jax.random.split(rng)
+                    core_before = st.core_state  # LSTM state entering this step
+                    with timer.section("act"), wd.section("act"):
+                        out, new_core = act_step(params, inputs, st.core_state, act_rng)
+                    action = out["action"][0]
+                    logits = out["policy_logits"][0]
+                    # Start both D2H transfers before the first blocking
+                    # fetch: two serialized np.asarray round trips would
+                    # otherwise cost this path a second full dispatch RTT
+                    # per frame.
+                    for _x in (action, logits):
+                        if hasattr(_x, "copy_to_host_async"):
+                            _x.copy_to_host_async()
+                    action_np = np.asarray(action)
+                    logits_np = np.asarray(logits)
+                    rollout.count_d2h(action_np.nbytes + logits_np.nbytes)
+                    # Queue the next env step immediately (overlaps with learning).
+                    st.future = envs[cur].step(0, action_np)
+                    st.time_batcher.stack(
+                        {
+                            "state": state_f32,
+                            "reward": reward_np,
+                            "done": done_np,
+                            "prev_action": st.prev_action_host,
+                            "action": action_np,
+                            "policy_logits": logits_np,
+                        }
+                    )
+                    st.prev_action = action
+                    st.prev_action_host = action_np
+                    st.core_state = new_core
+                    if not st.time_batcher.empty():
+                        unroll = st.time_batcher.get()  # [T+1, B, ...] host
+                        learn_batcher.cat(unroll)
+                        if core_batcher is not None:
+                            core_batcher.cat(st.initial_core_state)
+                        # Carry the last timestep into the next unroll; its
+                        # initial LSTM state is the state *before* that step.
+                        st.initial_core_state = core_before
+                        st.time_batcher.stack(
+                            {k: v[-1] for k, v in unroll.items()}
+                        )
                 cur = (cur + 1) % flags.num_actor_batches
 
             if not recovery_written and flags.localdir:
@@ -675,6 +787,7 @@ def train(flags, on_stats=None) -> dict:
 
             if now - last_log > flags.log_interval:
                 last_log = now
+                _flush_learn_stats()
                 sps = stats["steps_done"].value / max(time.time() - start, 1e-6)
                 sps_samples.append((time.time(), stats["steps_done"].value))
                 ret = stats["mean_episode_return"].result()
@@ -727,6 +840,7 @@ def train(flags, on_stats=None) -> dict:
         # finally block below (checkpoint save, env/rpc close) can take
         # tens of seconds with zero step progress and would deflate the
         # steady-state window it exists to measure.
+        _flush_learn_stats()
         sps_samples.append((time.time(), stats["steps_done"].value))
     finally:
         wd.close()

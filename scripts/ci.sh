@@ -64,6 +64,25 @@ python -m pytest tests/test_accumulator_rejoin.py tests/test_compile_cache.py \
 step "flat-bucket data plane (zero-copy serialization, layout golden, bit-exact allreduce)"
 python -m pytest tests/test_buckets.py -q || fail=1
 
+step "actor data plane (device rollout vs legacy host batcher: bit-exactness, async fetch, donation safety)"
+python -m pytest tests/test_rollout.py -q || fail=1
+
+step "agent smoke (whole-agent SPS, both rollout modes; folds the agent row into BENCH_LOCAL.json)"
+# Smoke gate for the device-resident actor pipeline (docs/DESIGN.md "Actor
+# data plane"): both rollout modes must finish with steady_sps > 0, and the
+# fresh A/B rows (SPS + host_boundary_bytes_per_frame) fold into
+# BENCH_LOCAL.json's agent_small section, preserving every other section —
+# the same merge discipline as the allreduce capture below.
+agent_log="${TMPDIR:-/tmp}/moolib_ci_agent_smoke.log"
+python benchmarks/agent_bench.py --scale small --check > "$agent_log" 2>&1
+agent_rc=$?
+cat "$agent_log"
+if [ "$agent_rc" = 0 ]; then
+  python benchmarks/fold_capture.py --local "$agent_log" || fail=1
+else
+  fail=1
+fi
+
 step "allreduce smoke (bucketed vs legacy vs numpy reference: tree + ring + q8, loopback bandwidth)"
 # Correctness gate for the gradient data plane (docs/DESIGN.md §6b): the
 # bucketed tree/ring/q8 results must be bit-consistent cohort-wide and

@@ -10,8 +10,16 @@ plane"), this is an A/B: by default BOTH rollout modes run in one
 invocation — the legacy host-batcher path first, then the device-rollout
 path — and each prints one JSON row:
 
-    {"metric": "impala_agent_sps", "rollout": "legacy"|"device",
+    {"metric": "impala_agent_sps", "rollout": "legacy"|"device"|"jax",
      "value": ..., "steady_sps": ..., "host_boundary_bytes_per_frame": ...}
+
+``--rollout all`` (or ``jax``) adds the zero-crossing arm: ``--env_backend
+jax`` runs the pure-JAX env family jitted into the unroll scan itself
+(docs/DESIGN.md §4c, the Podracer "Anakin" layout), so the whole
+act-frame pipeline is one dispatch per unroll and
+``host_boundary_bytes_per_frame`` must read exactly 0 — enforced by
+``--check``.  That arm uses its own larger env batch (its operating point:
+with the env on device, batch size costs no host bytes).
 
 ``host_boundary_bytes_per_frame`` comes from the actor-path telemetry
 counters (``actor_h2d/d2h_bytes_total``, ``batcher_h2d/d2h_bytes_total``
@@ -43,7 +51,8 @@ import sys
 import time
 
 
-def _run_mode(cfg: dict, total: int, device_rollout: bool, port: int):
+def _run_mode(cfg: dict, total: int, device_rollout: bool, port: int,
+              env_backend: str = "envpool"):
     """One train() run; returns (result, bytes_per_frame, seconds) with the
     boundary bytes read as telemetry deltas so back-to-back runs in one
     process don't double-count."""
@@ -55,6 +64,7 @@ def _run_mode(cfg: dict, total: int, device_rollout: bool, port: int):
     before = reg.counter_values()
     flags = experiment.make_flags([
         "--env", cfg["env"],
+        "--env_backend", env_backend,
         "--total_steps", str(total),
         "--actor_batch_size", str(cfg["actor_batch_size"]),
         "--num_actor_batches", str(cfg["num_actor_batches"]),
@@ -122,14 +132,17 @@ def main(argv=None):
     p.add_argument("--scale", default="reference", choices=["reference", "small"])
     p.add_argument("--total_steps", type=int, default=None, help="override step budget")
     p.add_argument(
-        "--rollout", default="both", choices=["both", "device", "legacy"],
+        "--rollout", default="both",
+        choices=["both", "all", "device", "legacy", "jax"],
         help="which actor data plane(s) to measure; 'both' runs legacy "
-        "first, then device, in one process (A/B on identical config)",
+        "then device in one process (A/B on identical config); 'all' adds "
+        "the jitted on-device env arm ('jax', Anakin plane) as a third row",
     )
     p.add_argument(
         "--check", action="store_true",
         help="smoke gate (ci.sh): exit non-zero unless every mode that ran "
-        "reports steady_sps > 0",
+        "reports steady_sps > 0 (and, for the jax arm, a measured "
+        "host_boundary_bytes_per_frame of exactly 0)",
     )
     args = p.parse_args(argv)
 
@@ -151,14 +164,29 @@ def main(argv=None):
                    num_env_processes=2, log_interval=1)
         total = args.total_steps or 96_000
 
-    modes = {"both": ("legacy", "device"), "device": ("device",),
-             "legacy": ("legacy",)}[args.rollout]
+    # The jax arm ("Anakin") jits the env itself into the unroll dispatch, so
+    # its natural operating point is a much larger env batch than the
+    # host-actor arms can feed — it gets its own config (always the catch
+    # MLP geometry: that is the env family with a pure-JAX twin).  Frames
+    # never cross the host boundary, so the headline pairs a bigger SPS with
+    # a measured 0.0 bytes/frame rather than a smaller nonzero one.
+    jax_cfg = dict(env="catch_flat", actor_batch_size=256, num_actor_batches=2,
+                   batch_size=128, virtual_batch_size=512, unroll_length=40,
+                   num_env_processes=2, log_interval=1)
+    jax_total = args.total_steps or 1_500_000
+
+    modes = {"both": ("legacy", "device"), "all": ("legacy", "device", "jax"),
+             "device": ("device",), "legacy": ("legacy",),
+             "jax": ("jax",)}[args.rollout]
     rows = []
     for i, mode in enumerate(modes):
+        mode_cfg = jax_cfg if mode == "jax" else cfg
         out, bpf, dt = _run_mode(
-            cfg, total, device_rollout=(mode == "device"), port=4431 + 2 * i,
+            mode_cfg, jax_total if mode == "jax" else total,
+            device_rollout=(mode != "legacy"), port=4431 + 2 * i,
+            env_backend="jax" if mode == "jax" else "envpool",
         )
-        rows.append((mode, out, bpf, dt))
+        rows.append((mode, mode_cfg, out, bpf, dt))
 
     import jax
 
@@ -166,7 +194,7 @@ def main(argv=None):
     rtt_ms = _probe_rtt()
     ok = True
     by_mode = {}
-    for mode, out, bpf, dt in rows:
+    for mode, cfg, out, bpf, dt in rows:
         row = {
             "metric": "impala_agent_sps",
             "rollout": mode,
@@ -185,7 +213,10 @@ def main(argv=None):
                 f"{cfg['env']}, actor_batch {cfg['actor_batch_size']}"
                 f"x{cfg['num_actor_batches']}, T={cfg['unroll_length']}, "
                 f"B={cfg['batch_size']}, vbs={cfg['virtual_batch_size']}, "
-                "act+step+learn overlapped on one device"
+                + ("env jitted into the unroll scan (Anakin), "
+                   "act+learn overlapped on one device"
+                   if mode == "jax"
+                   else "act+step+learn overlapped on one device")
             ),
             "baseline": (
                 "reference flagship loop examples/vtrace/experiment.py + "
@@ -197,7 +228,11 @@ def main(argv=None):
         by_mode[mode] = row
         if not (row["steady_sps"] and row["steady_sps"] > 0):
             ok = False
-    if len(by_mode) == 2:
+        if mode == "jax" and row["host_boundary_bytes_per_frame"] != 0:
+            # The zero-crossing contract is the arm's whole point; a nonzero
+            # reading means a host staging path leaked back in.
+            ok = False
+    if "legacy" in by_mode and "device" in by_mode:
         leg, dev_row = by_mode["legacy"], by_mode["device"]
         summary = {
             "metric": "impala_agent_rollout_ab",
@@ -214,6 +249,17 @@ def main(argv=None):
             ),
         }
         print(json.dumps(summary))
+    if "jax" in by_mode and "device" in by_mode:
+        jx, dev_row = by_mode["jax"], by_mode["device"]
+        print(json.dumps({
+            "metric": "impala_agent_jax_vs_device",
+            "scale": args.scale,
+            "steady_speedup": (
+                round(jx["steady_sps"] / dev_row["steady_sps"], 2)
+                if dev_row["steady_sps"] and jx["steady_sps"] else None
+            ),
+            "jax_bytes_per_frame": jx["host_boundary_bytes_per_frame"],
+        }))
     if args.check and not ok:
         print("agent_bench --check: a rollout mode is missing steady_sps > 0",
               file=sys.stderr)

@@ -164,11 +164,14 @@ def _parse_json_line(path, marker, cpu_gate=True):
 
 def parse_agent(path):
     """agent_bench prints one {'metric': 'impala_agent_sps', ...} JSON line
-    per rollout mode (device + legacy since the device-resident actor
-    pipeline).  The TPU record keeps the device-rollout row as the
-    headline; the last line wins if 'rollout' is absent (pre-A/B logs)."""
-    row = _parse_json_lines_by(path, "device")
-    return row if row is not None else _parse_json_line(path, "impala_agent_sps")
+    per rollout mode (legacy/device, plus 'jax' since the Anakin plane).
+    The TPU record keeps the fastest plane that ran as the headline — jax
+    (zero-crossing) over device over whatever a pre-A/B log printed last."""
+    for mode in ("jax", "device"):
+        row = _parse_json_lines_by(path, mode)
+        if row is not None:
+            return row
+    return _parse_json_line(path, "impala_agent_sps")
 
 
 def _parse_json_lines_by(path, rollout):
@@ -263,7 +266,8 @@ def parse_agent_lines(path):
                 except json.JSONDecodeError:
                     continue
                 if row.get("metric") in ("impala_agent_sps",
-                                         "impala_agent_rollout_ab"):
+                                         "impala_agent_rollout_ab",
+                                         "impala_agent_jax_vs_device"):
                     keep.append(json.dumps(row))
     except OSError:
         return None
@@ -285,7 +289,9 @@ def fold_local(log_path, json_path):
     agent_lines = parse_agent_lines(log_path)
     if agent_lines:
         section, cmd, lines = (
-            "agent_small", "benchmarks/agent_bench.py --scale small", agent_lines
+            "agent_small",
+            "benchmarks/agent_bench.py --scale small --rollout all",
+            agent_lines,
         )
     else:
         lines = parse_allreduce(log_path)
@@ -293,7 +299,10 @@ def fold_local(log_path, json_path):
             raise SystemExit(f"no allreduce or agent rows found in {log_path}")
         section, cmd = "allreduce_rpc", "benchmarks/allreduce_bench.py rpc"
     sec = dict(data.get(section, {}))
-    sec.setdefault("cmd", cmd)
+    # The cmd reflects THIS capture (the arm set can grow across rounds);
+    # stale run metadata from the replaced capture is dropped with it.
+    sec["cmd"] = cmd
+    sec.pop("seconds", None)
     sec["rc"] = 0
     sec["stdout"] = lines
     sec["stderr"] = []

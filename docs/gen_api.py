@@ -65,6 +65,7 @@ MODULES = [
     ("moolib_tpu.utils.stats", "Utilities: running stats"),
     ("moolib_tpu.utils.compile_cache", "Utilities: persistent compile cache"),
     ("moolib_tpu.envs.atari", "Envs: Atari preprocessing"),
+    ("moolib_tpu.envs.jax_envs", "Envs: pure-JAX on-device family (Anakin)"),
 ]
 
 

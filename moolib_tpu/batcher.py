@@ -64,6 +64,15 @@ _M_D2H_BYTES = _REG.counter(
 _M_H2D_BYTES = _REG.counter(
     "batcher_h2d_bytes_total", "completed host batches uploaded by device_put"
 )
+# Sebulba (arXiv:2104.06272): when the device path's target sharding lives on
+# a DIFFERENT device set than the incoming leaves (actor submesh -> learner
+# submesh), the batcher IS the inter-mesh queue and its device_put is the
+# trajectory handoff — counted here, never in the host-boundary counters
+# (the bytes ride ICI, not PCIe).
+_M_D2D_BYTES = _REG.counter(
+    "batcher_d2d_bytes_total",
+    "device batches re-placed across device sets (inter-mesh handoff)",
+)
 
 
 def _host_stack_leaves(xs, dim):
@@ -196,6 +205,12 @@ class Batcher:
         idx[self._dim] = slice(offset, offset + take)
         return x[tuple(idx)]
 
+    def _target_devices(self):
+        d = self._device
+        if hasattr(d, "device_set"):  # jax.sharding.Sharding
+            return frozenset(d.device_set)
+        return frozenset((d,))
+
     def _finish(self, batch) -> None:
         # One device_put of the whole pytree: a single host->HBM hop per leaf.
         if self._device is not None:
@@ -203,6 +218,18 @@ class Batcher:
                 _M_H2D_BYTES.inc(
                     sum(getattr(x, "nbytes", 0) for x in nest.flatten(batch))
                 )
+            else:
+                # Device path: a same-device-set put is a no-op/reshard; a
+                # cross-set put is the Sebulba actor->learner handoff.
+                tgt = self._target_devices()
+                moved = sum(
+                    x.nbytes
+                    for x in nest.flatten(batch)
+                    if isinstance(x, jax.Array)
+                    and frozenset(x.sharding.device_set) != tgt
+                )
+                if moved:
+                    _M_D2D_BYTES.inc(moved)
             batch = jax.device_put(batch, self._device)
         _M_BATCHES.inc()
         _M_ITEMS.inc(self._size)

@@ -15,4 +15,10 @@ for gymnasium ids, and an ALE factory (``create_env``) that needs ale_py
 from .atari import AtariPreprocessing, GymEnv, create_env  # noqa: F401
 from .cartpole import CartPoleEnv  # noqa: F401
 from .catch import CatchEnv, FlatCatchEnv, FrameStack  # noqa: F401
+from .jax_envs import (  # noqa: F401
+    JaxCatch,
+    JaxEnv,
+    JaxProcCatch,
+    make_jax_env,
+)
 from .synthetic import SyntheticAtariEnv  # noqa: F401

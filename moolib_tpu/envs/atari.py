@@ -43,6 +43,13 @@ class GymEnv:
             )
         self.num_actions = int(space.n)
 
+    @property
+    def obs_spec(self):
+        """Shared construction surface (``envs.jax_envs.JaxEnv``): gymnasium
+        envs declare shape/dtype on their observation space."""
+        space = self.env.observation_space
+        return tuple(space.shape), np.dtype(space.dtype)
+
     def reset(self):
         obs, _ = self.env.reset(seed=self._seed)
         self._seed = None  # reseed only on the first reset
@@ -109,6 +116,10 @@ class AtariPreprocessing:
     @property
     def observation_shape(self):
         return (self.screen_size, self.screen_size, self.num_stack)
+
+    @property
+    def obs_spec(self):
+        return self.observation_shape, np.dtype(np.uint8)
 
     def _to_gray(self, frame):
         frame = np.asarray(frame)

@@ -24,6 +24,12 @@ class CartPoleEnv:
     num_actions = 2
     observation_shape = (4,)
 
+    @property
+    def obs_spec(self):
+        """``(shape, dtype)`` — the shared construction surface (see
+        ``envs.jax_envs.JaxEnv``)."""
+        return self.observation_shape, np.dtype(np.float32)
+
     def __init__(self, seed: int | None = None, max_episode_steps: int = 500):
         self._rng = np.random.default_rng(seed)
         self._state = np.zeros(4, dtype=np.float32)

@@ -31,6 +31,19 @@ class CatchEnv:
             return (*self._frame_shape, 1)
         return (self.rows, self.columns, 1)
 
+    @property
+    def obs_spec(self):
+        """``(shape, dtype)`` — the construction surface shared with the
+        pure-JAX env family (``envs.jax_envs.JaxEnv``), so benches and the
+        experiment size either backend through one factory."""
+        return self.observation_shape, np.dtype(np.uint8)
+
+    def _sample_column(self) -> int:
+        """Per-episode drop column — THE env's only entropy.  Overridable so
+        a shared-seed harness (``jax_envs.host_catch``) can pin the stream
+        to the on-device derivation for bit-exactness proofs."""
+        return int(self._rng.integers(self.columns))
+
     def _obs(self):
         board = np.zeros((self.rows, self.columns, 1), dtype=np.uint8)
         board[self._ball[0], self._ball[1], 0] = 255
@@ -45,7 +58,7 @@ class CatchEnv:
         return board
 
     def reset(self):
-        self._ball = [0, int(self._rng.integers(self.columns))]
+        self._ball = [0, self._sample_column()]
         self._paddle = self.columns // 2
         return self._obs()
 
@@ -77,6 +90,10 @@ class FlatCatchEnv(CatchEnv):
         h, w, c = super().observation_shape
         return (h * w * c,)
 
+    @property
+    def obs_spec(self):
+        return self.observation_shape, np.dtype(np.uint8)
+
     def _obs(self):
         return super()._obs().reshape(-1)
 
@@ -97,6 +114,11 @@ class FrameStack:
     def observation_shape(self):
         h, w, c = self.env.observation_shape
         return (h, w, c * self.num_stack)
+
+    @property
+    def obs_spec(self):
+        _, dtype = self.env.obs_spec
+        return self.observation_shape, dtype
 
     def _obs(self):
         return np.concatenate(self._frames, axis=-1)

@@ -1,0 +1,324 @@
+"""Pure-JAX on-device environments (the Podracer "Anakin" env family).
+
+The device-resident actor pipeline (PR 5, ``moolib_tpu/rollout.py``) cut the
+actor data plane to one host-boundary crossing per frame — but the crossing
+is still there, because the env steps on the host (and, for EnvPool, in
+another process).  The Podracer paper (arXiv:2104.06272 § Anakin) closes it
+entirely: when ``env.step`` is itself a jittable JAX function, it fuses INTO
+the rollout body — observation, action, and reward never exist on the host
+at all, and a full ``[T+1, B]`` unroll is produced by one ``lax.scan``
+dispatch (:class:`moolib_tpu.rollout.AnakinRollout`).  JaxARC
+(arXiv:2601.17564) shows the same pattern for procedurally-generated
+puzzle suites.
+
+Protocol (:class:`JaxEnv`) — all methods are pure functions of explicit
+state, safe under ``jit``/``vmap``/``scan``:
+
+- ``init(key) -> state``: per-env state pytree for one env (vmap over a
+  batch of keys for a batch of envs).  The state embeds the PRNG key and an
+  episode counter, so the whole env family is **counter-based**: episode
+  ``e`` of the env seeded with ``key`` derives its procedural content from
+  ``fold_in(key, e)``, independent of how the episodes are reached
+  (per-step loop, scan unroll, or a host reimplementation).
+- ``observe(state) -> obs``: the observation for the current state (uint8
+  frames stay uint8 — the same native-dtype contract as the host plane).
+- ``step(state, action) -> (state, timestep)``: one env step with
+  **auto-reset on device**: when the episode ends, the returned timestep
+  carries the terminal reward, ``done=True``, and the *reset* observation
+  of the next episode — exactly the semantics ``EnvPool``'s worker loop
+  gives host envs (``envpool.py _step_env``), so trajectories line up
+  across backends.
+- ``obs_spec -> (shape, dtype)`` and ``num_actions``: the construction
+  surface shared with the host envs (``CatchEnv.obs_spec`` etc.), so
+  ``examples/vtrace/experiment.py --env_backend={envpool,jax}`` builds
+  either backend through one factory.
+
+The timestep is a dict ``{"state", "reward", "done"}`` with the same keys
+as an EnvPool observation batch, so rollout buffers are interchangeable.
+
+Shared seeding contract: :class:`JaxCatch` is a port of
+:class:`~moolib_tpu.envs.catch.FlatCatchEnv` whose only entropy is the
+ball's drop column, drawn per episode as
+``randint(fold_in(key, episode), 0, columns)``.  :func:`host_catch` builds
+a host ``FlatCatchEnv`` whose column stream follows the *same* derivation,
+so ``tests/test_jax_envs.py`` can assert the two backends produce
+bit-identical trajectories — obs, reward, done, across auto-reset
+boundaries — under a shared key.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Protocol, Tuple, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TimeStep = Dict[str, jax.Array]  # {"state": obs, "reward": f32, "done": bool}
+
+
+@runtime_checkable
+class JaxEnv(Protocol):
+    """Structural protocol for on-device envs (see module docstring)."""
+
+    num_actions: int
+
+    @property
+    def obs_spec(self) -> Tuple[Tuple[int, ...], Any]:
+        ...
+
+    def init(self, key) -> Dict[str, jax.Array]:
+        ...
+
+    def observe(self, state) -> jax.Array:
+        ...
+
+    def step(self, state, action) -> Tuple[Dict[str, jax.Array], TimeStep]:
+        ...
+
+
+def _episode_key(key, episode):
+    """THE shared seeding contract: everything procedural about episode
+    ``e`` of an env seeded with ``key`` derives from this fold — the host
+    shim (:func:`host_catch`) and any future backend must use the same
+    derivation to stay trajectory-comparable."""
+    return jax.random.fold_in(key, episode)
+
+
+class JaxCatch:
+    """Catch with the board flattened to a 1-D uint8 vector, on device.
+
+    Pure-JAX port of :class:`~moolib_tpu.envs.catch.FlatCatchEnv`: a ball
+    falls from the top of a rows×columns board, the paddle on the bottom row
+    moves left/stay/right, +1 for catching, -1 for missing.  Flattened uint8
+    observations route through the ``ActorCriticNet`` MLP — the actor-plane
+    benchmark geometry (``agent_bench --scale small``), now with zero
+    host-boundary bytes per frame.
+    """
+
+    num_actions = 3
+
+    def __init__(self, rows: int = 10, columns: int = 5):
+        self.rows = rows
+        self.columns = columns
+
+    @property
+    def obs_spec(self) -> Tuple[Tuple[int, ...], Any]:
+        return ((self.rows * self.columns,), jnp.uint8)
+
+    # ------------------------------------------------------------- episode
+    def _episode_fields(self, key, episode):
+        """Procedural content of episode ``episode`` (the seeding contract):
+        Catch's only entropy is the drop column."""
+        col = jax.random.randint(
+            _episode_key(key, episode), (), 0, self.columns, dtype=jnp.int32
+        )
+        return {
+            "ball_row": jnp.zeros((), jnp.int32),
+            "ball_col": col,
+            "paddle": jnp.full((), self.columns // 2, jnp.int32),
+        }
+
+    def init(self, key) -> Dict[str, jax.Array]:
+        episode = jnp.zeros((), jnp.int32)
+        return {"key": key, "episode": episode, **self._episode_fields(key, episode)}
+
+    def observe(self, state) -> jax.Array:
+        # Ball pixel then paddle pixel, same write order as the host env
+        # (identical even when they overlap on the bottom row: both 255).
+        board = jnp.zeros((self.rows, self.columns), jnp.uint8)
+        board = board.at[state["ball_row"], state["ball_col"]].set(255)
+        board = board.at[self.rows - 1, state["paddle"]].set(255)
+        return board.reshape(-1)
+
+    def step(self, state, action) -> Tuple[Dict[str, jax.Array], TimeStep]:
+        action = jnp.asarray(action, jnp.int32)
+        paddle = jnp.clip(state["paddle"] + (action - 1), 0, self.columns - 1)
+        ball_row = state["ball_row"] + 1
+        done = ball_row == self.rows - 1
+        reward = jnp.where(
+            done,
+            jnp.where(state["ball_col"] == paddle, 1.0, -1.0),
+            0.0,
+        ).astype(jnp.float32)
+        # Auto-reset on device: the post-done state is the NEXT episode
+        # (counter-based procedural fields), and the returned observation is
+        # its reset frame — EnvPool's exact worker-loop semantics.
+        next_episode = state["episode"] + done.astype(jnp.int32)
+        fresh = self._episode_fields(state["key"], next_episode)
+        moved = {"ball_row": ball_row, "ball_col": state["ball_col"], "paddle": paddle}
+        new_state = {
+            "key": state["key"],
+            "episode": next_episode,
+            **{
+                k: jnp.where(done, fresh[k], moved[k])
+                for k in ("ball_row", "ball_col", "paddle")
+            },
+        }
+        ts: TimeStep = {
+            "state": self.observe(new_state),
+            "reward": reward,
+            "done": done,
+        }
+        return new_state, ts
+
+
+class JaxProcCatch(JaxCatch):
+    """Procedurally-generated Catch variant for scenario diversity.
+
+    Every episode draws, from the same counter-based contract, a fresh
+    *scenario*: the drop column, a horizontal ball drift in
+    ``[-max_drift, max_drift]`` applied every step (the ball bounces off the
+    walls), and a distractor pixel column that carries no reward signal.
+    The optimal policy must track a moving ball and ignore the distractor —
+    a strictly harder family than :class:`JaxCatch` on the same observation
+    and action spec, generated entirely on device (the JaxARC pattern:
+    procedural scenario parameters live in the state pytree, shapes stay
+    static under jit).
+    """
+
+    def __init__(self, rows: int = 10, columns: int = 5, max_drift: int = 1,
+                 distractor: bool = True):
+        super().__init__(rows, columns)
+        self.max_drift = max_drift
+        self.distractor = distractor
+
+    def _episode_fields(self, key, episode):
+        ek = _episode_key(key, episode)
+        k_col, k_drift, k_dis = jax.random.split(ek, 3)
+        fields = {
+            "ball_row": jnp.zeros((), jnp.int32),
+            "ball_col": jax.random.randint(k_col, (), 0, self.columns, jnp.int32),
+            "paddle": jnp.full((), self.columns // 2, jnp.int32),
+            "drift": jax.random.randint(
+                k_drift, (), -self.max_drift, self.max_drift + 1, jnp.int32
+            ),
+            "distractor_col": jax.random.randint(
+                k_dis, (), 0, self.columns, jnp.int32
+            ),
+        }
+        return fields
+
+    def observe(self, state) -> jax.Array:
+        board = jnp.zeros((self.rows, self.columns), jnp.uint8)
+        if self.distractor:
+            # Dimmer static column: visible structure, no reward relevance.
+            board = board.at[0, state["distractor_col"]].set(128)
+        board = board.at[state["ball_row"], state["ball_col"]].set(255)
+        board = board.at[self.rows - 1, state["paddle"]].set(255)
+        return board.reshape(-1)
+
+    def step(self, state, action) -> Tuple[Dict[str, jax.Array], TimeStep]:
+        action = jnp.asarray(action, jnp.int32)
+        paddle = jnp.clip(state["paddle"] + (action - 1), 0, self.columns - 1)
+        ball_row = state["ball_row"] + 1
+        # Drift with wall bounce: reflect the out-of-range column back in.
+        raw = state["ball_col"] + state["drift"]
+        bounced = jnp.where(
+            raw < 0, -raw, jnp.where(raw >= self.columns, 2 * (self.columns - 1) - raw, raw)
+        )
+        ball_col = jnp.clip(bounced, 0, self.columns - 1)
+        done = ball_row == self.rows - 1
+        reward = jnp.where(
+            done, jnp.where(ball_col == paddle, 1.0, -1.0), 0.0
+        ).astype(jnp.float32)
+        next_episode = state["episode"] + done.astype(jnp.int32)
+        fresh = self._episode_fields(state["key"], next_episode)
+        moved = {
+            "ball_row": ball_row,
+            "ball_col": ball_col,
+            "paddle": paddle,
+            "drift": state["drift"],
+            "distractor_col": state["distractor_col"],
+        }
+        new_state = {
+            "key": state["key"],
+            "episode": next_episode,
+            **{k: jnp.where(done, fresh[k], moved[k]) for k in fresh},
+        }
+        ts: TimeStep = {
+            "state": self.observe(new_state),
+            "reward": reward,
+            "done": done,
+        }
+        return new_state, ts
+
+
+# --------------------------------------------------------------------------
+# Batch helpers (vmap over per-env keys)
+# --------------------------------------------------------------------------
+
+
+def batch_init(env: JaxEnv, key, batch_size: int):
+    """State pytree for ``batch_size`` envs: env ``i`` is seeded with
+    ``fold_in(key, i)`` — the per-env half of the seeding contract."""
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(batch_size))
+    return jax.vmap(env.init)(keys)
+
+
+def batch_observe(env: JaxEnv, state):
+    return jax.vmap(env.observe)(state)
+
+
+def batch_step(env: JaxEnv, state, action):
+    return jax.vmap(env.step)(state, action)
+
+
+# --------------------------------------------------------------------------
+# Host-side shim: the other half of the bit-exactness proof
+# --------------------------------------------------------------------------
+
+
+def host_catch(key, rows: int = 10, columns: int = 5):
+    """A host :class:`~moolib_tpu.envs.catch.FlatCatchEnv` whose per-episode
+    ball columns follow the SAME counter-based derivation as
+    :class:`JaxCatch` seeded with ``key`` — the host half of the shared
+    seeding contract.  Used by ``tests/test_jax_envs.py`` to prove the
+    on-device port bit-exact against the host env it replaces (column
+    values are computed eagerly with the same jax.random calls)."""
+    from .catch import FlatCatchEnv
+
+    class _SharedSeedCatch(FlatCatchEnv):
+        def __init__(self):
+            super().__init__(rows=rows, columns=columns)
+            self._episode = 0
+
+        def _sample_column(self) -> int:
+            col = int(
+                jax.random.randint(
+                    _episode_key(key, self._episode), (), 0, self.columns,
+                    dtype=jnp.int32,
+                )
+            )
+            self._episode += 1
+            return col
+
+    return _SharedSeedCatch()
+
+
+def make_jax_env(name: str, **kwargs) -> JaxEnv:
+    """Factory behind ``--env_backend jax``: map the experiment's ``--env``
+    names onto the on-device family.  ``catch_flat`` is the same geometry as
+    the host env of that name; ``catch_proc`` is the procedurally-generated
+    variant (same spec, harder scenario family)."""
+    if name in ("catch_flat", "jax_catch", "catch"):
+        return JaxCatch(**kwargs)
+    if name in ("catch_proc", "proc_catch", "jax_proc"):
+        return JaxProcCatch(**kwargs)
+    raise ValueError(
+        f"no jax env for --env {name!r} (catch_flat | catch_proc; the other "
+        "env names are host/EnvPool-backed — drop --env_backend jax)"
+    )
+
+
+__all__ = [
+    "JaxEnv",
+    "JaxCatch",
+    "JaxProcCatch",
+    "TimeStep",
+    "batch_init",
+    "batch_observe",
+    "batch_step",
+    "host_catch",
+    "make_jax_env",
+]

@@ -17,6 +17,8 @@ class SyntheticAtariEnv:
     def __init__(self, height: int = 84, width: int = 84, frames: int = 4, seed=None,
                  episode_length: int = 1000):
         self.observation_shape = (height, width, frames)
+        # Shared construction surface (envs.jax_envs.JaxEnv): (shape, dtype).
+        self.obs_spec = (self.observation_shape, np.dtype(np.uint8))
         self._rng = np.random.default_rng(seed)
         self._episode_length = episode_length
         self._t = 0

@@ -142,6 +142,25 @@ def make_flags(argv=None):
         "the legacy host-batcher path (bit-exact trajectories, 3 float32 "
         "host-boundary crossings per frame)",
     )
+    p.add_argument(
+        "--env_backend",
+        default="envpool",
+        choices=["envpool", "jax"],
+        help="envpool: host envs in worker processes (the EnvPool plane); "
+        "jax: pure-JAX on-device envs (envs.jax_envs) fused into the rollout "
+        "— the Podracer 'Anakin' architecture, zero host-boundary bytes per "
+        "frame.  jax supports --env catch_flat/catch and catch_proc",
+    )
+    p.add_argument(
+        "--actor_mesh",
+        type=int,
+        default=0,
+        help="Sebulba split (requires --mesh and --env_backend jax): carve "
+        "the first N mesh devices into a dedicated actor submesh running "
+        "the fused rollout; the remainder is the learner mesh and completed "
+        "unrolls hop between them device-to-device through the Batcher "
+        "(batcher_d2d_bytes_total)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--watchdog", type=float, default=0.0,
@@ -156,6 +175,20 @@ def _bool_flag(v) -> bool:
     """argparse-friendly bool: ``--device_rollout false`` works (store_true
     can't express an =false override)."""
     return str(v).strip().lower() not in ("0", "false", "no", "off", "")
+
+
+# Sebulba control-plane traffic: how many bytes of params the actor submesh
+# pulls per learner version bump (docs/TELEMETRY.md).
+_M_PARAM_SYNC = telemetry.get_registry().counter(
+    "actor_param_sync_bytes_total",
+    "Sebulba actor-submesh param refreshes (learner -> actor devices)",
+)
+
+
+def _actor_rep_sharding(actor_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(actor_mesh, P())
 
 
 def make_env_factory(flags):
@@ -334,17 +367,33 @@ def train(flags, on_stats=None) -> dict:
             num_processes=flags.num_processes,
             process_id=flags.process_id,
         )
-    env_factory, num_actions, obs_shape = make_env_factory(flags)
-    # Fork env workers before jax device state exists in this process.
-    envs = [
-        EnvPool(
-            env_factory,
-            num_processes=flags.num_env_processes,
-            batch_size=flags.actor_batch_size,
-            num_batches=1,
+    if flags.actor_mesh and (not flags.mesh or flags.env_backend != "jax"):
+        raise ValueError(
+            "--actor_mesh is the Sebulba split: it needs --mesh (devices to "
+            "split) and --env_backend jax (the actor submesh runs on-device "
+            "envs)"
         )
-        for _ in range(flags.num_actor_batches)
-    ]
+    jax_env = None
+    if flags.env_backend == "jax":
+        # Anakin: the env lives on the device; no worker processes at all.
+        from ...envs import make_jax_env
+
+        jax_env = make_jax_env(flags.env)
+        num_actions = jax_env.num_actions
+        obs_shape = tuple(jax_env.obs_spec[0])
+        envs = []
+    else:
+        env_factory, num_actions, obs_shape = make_env_factory(flags)
+        # Fork env workers before jax device state exists in this process.
+        envs = [
+            EnvPool(
+                env_factory,
+                num_processes=flags.num_env_processes,
+                batch_size=flags.actor_batch_size,
+                num_batches=1,
+            )
+            for _ in range(flags.num_actor_batches)
+        ]
 
     model = make_model(flags, num_actions, obs_shape)
     B = flags.actor_batch_size
@@ -409,11 +458,17 @@ def train(flags, on_stats=None) -> dict:
         updates, o = opt.update(g, o, p)
         return optax.apply_updates(p, updates), o
 
+    actor_mesh = None
     if flags.mesh:
         from ... import parallel
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = parallel.parse_mesh_spec(flags.mesh)
+        if flags.actor_mesh:
+            # Sebulba: the actor submesh runs the fused rollout, the rest of
+            # the devices (below, as `mesh`) form the learner; trajectories
+            # hop between them through the Batcher's device_put.
+            actor_mesh, mesh = parallel.split_mesh(mesh, flags.actor_mesh)
         if flags.batch_size % mesh.shape.get("dp", 1):
             raise ValueError("the dp mesh axis size must divide --batch_size")
         sp = mesh.shape.get("sp", 1)
@@ -532,18 +587,56 @@ def train(flags, on_stats=None) -> dict:
         except Exception as e:  # noqa: BLE001 — gated: package absent or offline
             utils.log_error("wandb requested but unavailable: %s", e)
 
-    env_states = [
-        common.EnvBatchState(B, T, model) for _ in range(flags.num_actor_batches)
-    ]
-    if flags.device_rollout:
-        # Device-resident rollout buffers (docs/DESIGN.md "Actor data
-        # plane"): sized from the pool's discovered spec so the env's native
-        # dtype — uint8 for frames — is what crosses the boundary.
-        env_obs_shape, env_obs_dtype = envs[0].obs_spec["state"]
-        for st in env_states:
-            st.rollout = rollout.DeviceRollout(
-                model, B, T, env_obs_shape, env_obs_dtype, num_actions
-            )
+    anakin = None
+    actor_params = None
+    actor_params_version = -1
+    anakin_frames_seen = 0
+    anakin_prev = {"episodes": 0, "return_sum": 0.0, "len_sum": 0.0}
+    if jax_env is not None:
+        # Anakin: ONE fused rollout over all the envs the envpool config
+        # would have spread across actor batches — double buffering exists
+        # to hide host env latency, and there is none to hide.
+        roll_B = B * flags.num_actor_batches
+        rng, env_rng, act_key = jax.random.split(rng, 3)
+        anakin = rollout.AnakinRollout(
+            model, jax_env, roll_B, T,
+            env_key=env_rng, act_rng=act_key, mesh=actor_mesh,
+        )
+        env_states = []
+    else:
+        env_states = [
+            common.EnvBatchState(B, T, model) for _ in range(flags.num_actor_batches)
+        ]
+        if flags.device_rollout:
+            # Device-resident rollout buffers (docs/DESIGN.md "Actor data
+            # plane"): sized from the pool's discovered spec so the env's
+            # native dtype — uint8 for frames — is what crosses the boundary.
+            env_obs_shape, env_obs_dtype = envs[0].obs_spec["state"]
+            for st in env_states:
+                st.rollout = rollout.DeviceRollout(
+                    model, B, T, env_obs_shape, env_obs_dtype, num_actions
+                )
+
+    def _sync_anakin_stats() -> None:
+        """Fold the device-side episode aggregates into the stats dict (the
+        deltas since the last snapshot).  This is the Anakin plane's only
+        D2H, and it runs per stats/log tick, not per frame."""
+        if anakin is None:
+            return
+        snap = anakin.stats()
+        de = snap["episodes"] - anakin_prev["episodes"]
+        stats["mean_episode_return"] += common.StatMean(
+            snap["return_sum"] - anakin_prev["return_sum"], de
+        )
+        stats["mean_episode_step"] += common.StatMean(
+            snap["len_sum"] - anakin_prev["len_sum"], de
+        )
+        stats["episodes_done"] += de
+        anakin_prev.update(
+            episodes=snap["episodes"],
+            return_sum=snap["return_sum"],
+            len_sum=snap["len_sum"],
+        )
     # With a mesh, the Batcher lands batches pre-sharded (device_put accepts
     # a NamedSharding target): [T+1, B] over (∅, dp).
     learn_batcher = Batcher(
@@ -638,6 +731,7 @@ def train(flags, on_stats=None) -> dict:
             if now - last_stats > flags.stats_interval:
                 last_stats = now
                 _flush_learn_stats()  # one fetch; cohort sees fresh loss
+                _sync_anakin_stats()
                 global_stats.reduce(stats)
             if (
                 flags.checkpoint
@@ -684,6 +778,36 @@ def train(flags, on_stats=None) -> dict:
                     # the flat fill (PR 4) — a device_get here would
                     # serialize the whole tree first.
                     accumulator.reduce_gradients(flags.batch_size, grads)
+            elif anakin is not None:
+                # --- act: Anakin/Sebulba ---------------------------------
+                # One lax.scan dispatch = one completed [T+1, B] unroll.
+                # Env, model, auto-reset, and episode accounting all run on
+                # device; zero host-boundary bytes per frame.
+                if actor_mesh is not None:
+                    if actor_params_version != accumulator.model_version():
+                        # Refresh the actor submesh's param replica only when
+                        # the learner actually stepped (device-to-device).
+                        with timer.section("param_sync"), wd.section("param_sync"):
+                            actor_params = jax.device_put(
+                                params, _actor_rep_sharding(actor_mesh)
+                            )
+                        _M_PARAM_SYNC.inc(
+                            sum(
+                                x.nbytes
+                                for x in jax.tree_util.tree_leaves(actor_params)
+                            )
+                        )
+                        actor_params_version = accumulator.model_version()
+                    act_params = actor_params
+                else:
+                    act_params = params
+                with timer.section("act"), wd.section("act"):
+                    unroll = anakin.unroll(act_params)
+                learn_batcher.cat(unroll)  # Sebulba: the inter-mesh handoff
+                if core_batcher is not None:
+                    core_batcher.cat(anakin.completed_initial_core)
+                stats["steps_done"] += anakin.frames_done - anakin_frames_seen
+                anakin_frames_seen = anakin.frames_done
             else:
                 # --- act ------------------------------------------------
                 st = env_states[cur]
@@ -788,6 +912,7 @@ def train(flags, on_stats=None) -> dict:
             if now - last_log > flags.log_interval:
                 last_log = now
                 _flush_learn_stats()
+                _sync_anakin_stats()
                 sps = stats["steps_done"].value / max(time.time() - start, 1e-6)
                 sps_samples.append((time.time(), stats["steps_done"].value))
                 ret = stats["mean_episode_return"].result()
@@ -841,6 +966,7 @@ def train(flags, on_stats=None) -> dict:
         # tens of seconds with zero step progress and would deflate the
         # steady-state window it exists to measure.
         _flush_learn_stats()
+        _sync_anakin_stats()
         sps_samples.append((time.time(), stats["steps_done"].value))
     finally:
         wd.close()

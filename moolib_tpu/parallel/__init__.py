@@ -15,11 +15,15 @@ from .mesh import (  # noqa: F401
     parse_mesh_spec,
     replicated,
     shard_batch_spec,
+    split_mesh,
 )
 from .collectives import (  # noqa: F401
     all_gather_axis,
+    axis_size,
+    pcast,
     reduce_scatter_axis,
     ring_permute,
+    shard_map,
     tree_pmean,
     tree_psum,
 )

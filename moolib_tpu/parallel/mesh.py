@@ -109,6 +109,38 @@ def parse_mesh_spec(spec: str) -> Optional[Mesh]:
     return make_mesh(axes, devices=jax.devices()[:need])
 
 
+def split_mesh(mesh: Mesh, actor_devices: int) -> Tuple[Mesh, Mesh]:
+    """Carve a Podracer "Sebulba" split out of one device cohort: the first
+    ``actor_devices`` devices become a pure-dp **actor mesh** (inference +
+    on-device envs), the remainder keep the original axis layout as the
+    **learner mesh** (arXiv:2104.06272 § Sebulba — actors and learner on
+    disjoint device subsets, trajectories handed over device-to-device).
+
+    Returns ``(actor_mesh, learner_mesh)``.  The learner keeps every axis of
+    the input mesh whose size still divides the remaining device count; axes
+    that no longer fit collapse into dp (the common case is a pure-dp input
+    mesh, where the learner is simply the dp remainder).
+    """
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    if not (0 < actor_devices < n):
+        raise ValueError(
+            f"actor_devices must be in (0, {n}) to leave the learner at "
+            f"least one device; got {actor_devices}"
+        )
+    actor = make_mesh({"dp": actor_devices}, devices[:actor_devices])
+    remaining = devices[actor_devices:]
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    non_dp = {k: v for k, v in axes.items() if k != "dp" and v > 1}
+    tail = math.prod(non_dp.values()) if non_dp else 1
+    if non_dp and len(remaining) % tail == 0:
+        learner_axes = {"dp": len(remaining) // tail, **non_dp}
+    else:
+        learner_axes = {"dp": len(remaining)}
+    learner = make_mesh(learner_axes, remaining)
+    return actor, learner
+
+
 def named(mesh: Mesh, *spec) -> NamedSharding:
     """Shorthand: ``named(mesh, "dp", None)`` → NamedSharding over P(dp, ∅)."""
     return NamedSharding(mesh, P(*spec))

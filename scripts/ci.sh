@@ -67,14 +67,19 @@ python -m pytest tests/test_buckets.py -q || fail=1
 step "actor data plane (device rollout vs legacy host batcher: bit-exactness, async fetch, donation safety)"
 python -m pytest tests/test_rollout.py -q || fail=1
 
-step "agent smoke (whole-agent SPS, both rollout modes; folds the agent row into BENCH_LOCAL.json)"
-# Smoke gate for the device-resident actor pipeline (docs/DESIGN.md "Actor
-# data plane"): both rollout modes must finish with steady_sps > 0, and the
-# fresh A/B rows (SPS + host_boundary_bytes_per_frame) fold into
-# BENCH_LOCAL.json's agent_small section, preserving every other section —
-# the same merge discipline as the allreduce capture below.
+step "zero-crossing actor plane (jitted on-device envs: backend bit-exactness, scan==per-step, Sebulba handoff)"
+python -m pytest tests/test_jax_envs.py -q || fail=1
+
+step "agent smoke (whole-agent SPS, all three rollout planes; folds the agent rows into BENCH_LOCAL.json)"
+# Smoke gate for the actor data planes (docs/DESIGN.md "Actor data plane" +
+# §4c): every plane must finish with steady_sps > 0, the jax (Anakin) arm
+# must additionally measure host_boundary_bytes_per_frame == 0 (both
+# enforced by --check), and the fresh rows (SPS + bytes/frame + the A/B
+# summaries) fold into BENCH_LOCAL.json's agent_small section, preserving
+# every other section — the same merge discipline as the allreduce capture
+# below.
 agent_log="${TMPDIR:-/tmp}/moolib_ci_agent_smoke.log"
-python benchmarks/agent_bench.py --scale small --check > "$agent_log" 2>&1
+python benchmarks/agent_bench.py --scale small --rollout all --check > "$agent_log" 2>&1
 agent_rc=$?
 cat "$agent_log"
 if [ "$agent_rc" = 0 ]; then

@@ -1,0 +1,262 @@
+"""Pure-JAX env family + Anakin rollout (moolib_tpu/envs/jax_envs.py,
+moolib_tpu/rollout.py AnakinRollout).
+
+The contracts (docs/DESIGN.md §4c, the Podracer "Anakin" layout):
+
+1. **Bit-exactness across backends**: under the shared counter-based seeding
+   contract (episode e of key k draws from fold_in(k, e)), the on-device
+   JaxCatch produces obs/reward/done streams bit-identical to the host
+   FlatCatchEnv it replaces — including across auto-reset boundaries.
+2. **vmap batching**: env i of a batch seeded with key k behaves exactly
+   like a single env seeded with fold_in(k, i).
+3. **Scan == per-step**: AnakinRollout's one-dispatch lax.scan unroll is
+   bit-identical to its per-step donated-buffer mode over the same seeds.
+4. **Zero crossings**: neither Anakin mode moves a single byte across the
+   host boundary per frame — the actor_h2d/d2h and batcher_h2d/d2h
+   counters must not advance; device episode stats leave only through the
+   explicit stats() snapshot (actor_stats_d2h_bytes_total).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moolib_tpu import rollout, telemetry
+from moolib_tpu.envs import jax_envs
+from moolib_tpu.envs.catch import CatchEnv, FlatCatchEnv
+from moolib_tpu.models import ActorCriticNet
+
+BOUNDARY = (
+    "actor_h2d_bytes_total",
+    "actor_d2h_bytes_total",
+    "batcher_h2d_bytes_total",
+    "batcher_d2h_bytes_total",
+)
+
+
+def _counters():
+    return dict(telemetry.get_registry().counter_values())
+
+
+# --------------------------------------------------------------------------
+# Env family
+# --------------------------------------------------------------------------
+
+
+def test_jax_catch_bit_exact_vs_host():
+    """Same key -> bit-identical obs/reward/done streams on both backends,
+    across several auto-reset boundaries."""
+    key = jax.random.key(7)
+    env = jax_envs.JaxCatch()
+    host = jax_envs.host_catch(key)
+
+    state = env.init(key)
+    host_obs = host.reset()
+    np.testing.assert_array_equal(np.asarray(env.observe(state)), host_obs)
+
+    step = jax.jit(env.step)
+    for t in range(40):  # 10-row catch: > 4 full episodes
+        action = t % 3
+        state, ts = step(state, jnp.int32(action))
+        h_obs, h_rew, h_done, _ = host.step(action)
+        if h_done:
+            # EnvPool worker-loop semantics the device env bakes in: the
+            # done step carries the terminal reward and the NEXT episode's
+            # reset observation.
+            h_obs = host.reset()
+        assert bool(ts["done"]) == h_done, f"done diverged at t={t}"
+        assert float(ts["reward"]) == h_rew, f"reward diverged at t={t}"
+        np.testing.assert_array_equal(
+            np.asarray(ts["state"]), h_obs, err_msg=f"obs diverged at t={t}"
+        )
+
+
+def test_obs_spec_parity_with_host_envs():
+    """Satellite: one construction surface across backends — the host envs
+    expose the same (shape, dtype) obs_spec + num_actions the JaxEnv
+    protocol requires, with matching values for the shared geometry."""
+    jenv = jax_envs.JaxCatch()
+    henv = FlatCatchEnv()
+    assert isinstance(jenv, jax_envs.JaxEnv)
+    assert jenv.num_actions == henv.num_actions
+    j_shape, j_dtype = jenv.obs_spec
+    h_shape, h_dtype = henv.obs_spec
+    assert tuple(j_shape) == tuple(h_shape)
+    assert np.dtype(j_dtype) == np.dtype(h_dtype) == np.uint8
+
+    for env in (CatchEnv(), FlatCatchEnv(), jax_envs.JaxProcCatch()):
+        shape, dtype = env.obs_spec
+        assert all(int(d) > 0 for d in shape)
+        assert np.dtype(dtype) == np.uint8
+        assert env.num_actions == 3
+
+
+def test_batch_step_matches_single():
+    """vmap batching is just fold_in(key, i) per env: batched env i equals a
+    single env seeded with that fold."""
+    key = jax.random.key(3)
+    env = jax_envs.JaxCatch()
+    B = 5
+    bstate = jax_envs.batch_init(env, key, B)
+    singles = [env.init(jax.random.fold_in(key, i)) for i in range(B)]
+
+    np.testing.assert_array_equal(
+        np.asarray(jax_envs.batch_observe(env, bstate)),
+        np.stack([np.asarray(env.observe(s)) for s in singles]),
+    )
+    for t in range(12):
+        actions = jnp.arange(B, dtype=jnp.int32) % 3
+        bstate, bts = jax_envs.batch_step(env, bstate, actions)
+        for i in range(B):
+            singles[i], ts = env.step(singles[i], actions[i])
+            assert bool(bts["done"][i]) == bool(ts["done"])
+            assert float(bts["reward"][i]) == float(ts["reward"])
+            np.testing.assert_array_equal(
+                np.asarray(bts["state"][i]), np.asarray(ts["state"])
+            )
+
+
+def test_auto_reset_on_device():
+    """Episode boundary: done fires on the bottom row with +/-1 reward, the
+    returned obs is already the NEXT episode's reset frame, and the episode
+    counter advances — all inside jit, no host involvement."""
+    env = jax_envs.JaxCatch()
+    state = env.init(jax.random.key(11))
+    step = jax.jit(env.step)
+    for t in range(1, 19):  # two full 9-step episodes
+        state, ts = step(state, jnp.int32(1))
+        if t % (env.rows - 1) == 0:
+            assert bool(ts["done"])
+            assert float(ts["reward"]) in (1.0, -1.0)
+            # Reset frame of the next episode: ball back on the top row.
+            board = np.asarray(ts["state"]).reshape(env.rows, env.columns)
+            assert board[0].max() == 255
+            assert int(state["episode"]) == t // (env.rows - 1)
+        else:
+            assert not bool(ts["done"])
+            assert float(ts["reward"]) == 0.0
+
+
+def test_proc_catch_scenarios():
+    """Procedural variant: per-episode scenario draws (column, drift,
+    distractor) vary across episodes, the drifting ball stays on the board,
+    and the distractor pixel renders at half intensity."""
+    env = jax_envs.JaxProcCatch()
+    state = env.init(jax.random.key(5))
+    step = jax.jit(env.step)
+    scenarios = []
+    for _ in range(5):  # five episodes
+        scenarios.append(
+            (int(state["ball_col"]), int(state["drift"]), int(state["distractor_col"]))
+        )
+        for _ in range(env.rows - 1):
+            state, ts = step(state, jnp.int32(1))
+            col = int(state["ball_col"])
+            assert 0 <= col < env.columns
+        assert bool(ts["done"])
+    assert len(set(scenarios)) > 1, "every episode drew the same scenario"
+
+    obs = np.asarray(env.observe(env.init(jax.random.key(6))))
+    assert 128 in obs  # distractor pixel
+    assert obs.dtype == np.uint8
+
+
+def test_make_jax_env_factory():
+    assert isinstance(jax_envs.make_jax_env("catch_flat"), jax_envs.JaxCatch)
+    assert isinstance(jax_envs.make_jax_env("catch_proc"), jax_envs.JaxProcCatch)
+    with pytest.raises(ValueError, match="env_backend"):
+        jax_envs.make_jax_env("synthetic")
+
+
+# --------------------------------------------------------------------------
+# Anakin rollout
+# --------------------------------------------------------------------------
+
+
+def _make_rollout(B, T, seed=0, **kwargs):
+    env = jax_envs.JaxCatch()
+    model = ActorCriticNet(num_actions=env.num_actions, use_lstm=False)
+    roll = rollout.AnakinRollout(
+        model, env, B, T,
+        env_key=jax.random.key(100 + seed), act_rng=jax.random.key(200 + seed),
+        **kwargs,
+    )
+    obs_shape, _ = env.obs_spec
+    dummy = {
+        "state": jnp.zeros((1, B, *obs_shape), jnp.float32),
+        "reward": jnp.zeros((1, B), jnp.float32),
+        "done": jnp.zeros((1, B), bool),
+        "prev_action": jnp.zeros((1, B), jnp.int32),
+    }
+    params = model.init(jax.random.key(0), dummy, model.initial_state(B))
+    return roll, params
+
+
+def test_anakin_scan_equals_per_step():
+    """The one-dispatch lax.scan fast path is bit-identical to the per-step
+    donated-buffer mode over two consecutive unrolls (bootstrap + carried
+    last row)."""
+    B, T = 4, 6
+    scan_roll, params = _make_rollout(B, T, seed=1)
+    step_roll, _ = _make_rollout(B, T, seed=1)
+
+    scan_bufs = [jax.device_get(scan_roll.unroll(params)) for _ in range(2)]
+
+    step_bufs = []
+    for n_steps in (T + 1, T):  # bootstrap unroll, then steady state
+        for _ in range(n_steps):
+            step_roll.step(params)
+        step_bufs.append(jax.device_get(step_roll.take_unroll()))
+
+    for k in scan_bufs[0]:
+        for i in range(2):
+            np.testing.assert_array_equal(
+                scan_bufs[i][k], step_bufs[i][k],
+                err_msg=f"unroll {i} key {k} diverged between modes",
+            )
+    assert scan_roll.frames_done == step_roll.frames_done == B * (2 * T + 1)
+
+
+def test_anakin_zero_crossing_and_stats():
+    """Zero-crossing assertion: whole unrolls advance no host-boundary
+    counter; device episode aggregates leave only via stats() on their own
+    counter, and the arithmetic matches catch's fixed 9-step episodes."""
+    B, T = 4, 40
+    roll, params = _make_rollout(B, T, seed=2)
+
+    before = _counters()
+    for _ in range(2):
+        buf = roll.unroll(params)
+    jax.block_until_ready(buf["done"])
+    after = _counters()
+
+    for name in BOUNDARY:
+        assert after.get(name, 0.0) == before.get(name, 0.0), (
+            f"{name} advanced during an Anakin unroll — a host staging path "
+            "leaked back into the zero-crossing plane"
+        )
+    frames = B * (2 * T + 1)
+    assert after["actor_frames_total"] - before["actor_frames_total"] == frames
+    assert after["actor_unrolls_total"] - before["actor_unrolls_total"] == 2
+
+    snap = roll.stats()
+    ep_len = jax_envs.JaxCatch().rows - 1
+    assert snap["episodes"] == B * ((2 * T + 1) // ep_len)
+    assert snap["len_sum"] == snap["episodes"] * ep_len
+    assert abs(snap["return_sum"]) <= snap["episodes"]  # rewards are +/-1
+    mid = _counters()
+    assert mid["actor_stats_d2h_bytes_total"] > after.get(
+        "actor_stats_d2h_bytes_total", 0.0
+    )
+    for name in BOUNDARY:  # the snapshot itself stays off the frame counters
+        assert mid.get(name, 0.0) == after.get(name, 0.0)
+
+
+def test_anakin_mode_mixing_raises():
+    roll, params = _make_rollout(2, 4, seed=3)
+    roll.step(params)
+    with pytest.raises(RuntimeError, match="mode"):
+        roll.unroll(params)

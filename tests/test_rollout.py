@@ -329,3 +329,97 @@ def test_flags_device_rollout_parse():
     assert experiment.make_flags(
         ["--env", "catch", "--device_rollout", "true"]
     ).device_rollout is True
+
+
+# --------------------------------------------------------------------------
+# Sebulba: split meshes + the Batcher as inter-mesh device queue
+# --------------------------------------------------------------------------
+
+
+def test_split_mesh():
+    """split_mesh carves disjoint actor/learner submeshes out of one cohort:
+    pure-dp actor, learner keeping surviving axes (or collapsing to dp)."""
+    from moolib_tpu import parallel
+
+    mesh = parallel.make_mesh({"dp": 8})
+    actor, learner = parallel.split_mesh(mesh, 2)
+    assert dict(zip(actor.axis_names, actor.devices.shape)) == {"dp": 2}
+    assert dict(zip(learner.axis_names, learner.devices.shape)) == {"dp": 6}
+    a_set, l_set = set(actor.devices.flat), set(learner.devices.flat)
+    assert not (a_set & l_set)
+    assert a_set | l_set == set(mesh.devices.flat)
+
+    # Non-dp axes survive when they still divide the remainder...
+    actor, learner = parallel.split_mesh(parallel.make_mesh({"dp": 4, "tp": 2}), 4)
+    assert dict(zip(learner.axis_names, learner.devices.shape)) == {"dp": 2, "tp": 2}
+    # ...and collapse into dp when they no longer fit.
+    actor, learner = parallel.split_mesh(parallel.make_mesh({"dp": 4, "tp": 2}), 5)
+    assert dict(zip(learner.axis_names, learner.devices.shape)) == {"dp": 3}
+
+    for bad in (0, 8):
+        with pytest.raises(ValueError, match="actor_devices"):
+            parallel.split_mesh(mesh, bad)
+
+
+def test_sebulba_device_queue_handoff():
+    """The Batcher device path as the actor->learner seam: an Anakin unroll
+    produced on the actor submesh re-places onto the learner submesh inside
+    the Batcher (counted as batcher_d2d_bytes_total, NOT as a host
+    crossing), and the learner pops batches already sharded over its dp."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from moolib_tpu import parallel
+    from moolib_tpu.batcher import _M_D2D_BYTES, _M_D2H_BYTES, _M_H2D_BYTES
+    from moolib_tpu.envs import jax_envs
+
+    mesh = parallel.make_mesh({"dp": 4}, jax.devices()[:4])
+    actor_mesh, learner_mesh = parallel.split_mesh(mesh, 2)
+
+    B, T = 4, 5
+    env = jax_envs.JaxCatch()
+    model = ActorCriticNet(num_actions=env.num_actions, use_lstm=False)
+    roll = rollout.AnakinRollout(
+        model, env, B, T,
+        env_key=jax.random.key(1), act_rng=jax.random.key(2), mesh=actor_mesh,
+    )
+    obs_shape, _ = env.obs_spec
+    params = model.init(
+        jax.random.key(0),
+        {
+            "state": jnp.zeros((1, B, *obs_shape), jnp.float32),
+            "reward": jnp.zeros((1, B), jnp.float32),
+            "done": jnp.zeros((1, B), bool),
+            "prev_action": jnp.zeros((1, B), jnp.int32),
+        },
+        model.initial_state(B),
+    )
+
+    unroll = roll.unroll(params)
+    assert set(unroll["state"].sharding.device_set) == set(actor_mesh.devices.flat)
+
+    d2d0 = _M_D2D_BYTES.labels().get()
+    d2h0 = _M_D2H_BYTES.labels().get()
+    h2d0 = _M_H2D_BYTES.labels().get()
+    batch_sharding = NamedSharding(learner_mesh, P(None, "dp"))
+    queue = Batcher(2, device=batch_sharding, dim=1)
+    queue.cat(unroll)  # 4 rows, size 2 -> two complete learner batches
+
+    unroll_bytes = sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(unroll)
+    )
+    assert _M_D2D_BYTES.labels().get() == d2d0 + unroll_bytes
+    assert _M_D2H_BYTES.labels().get() == d2h0  # never via the host
+    assert _M_H2D_BYTES.labels().get() == h2d0
+
+    for _ in range(2):
+        batch = queue.get()
+        assert batch["state"].shape == (T + 1, 2, *obs_shape)
+        assert set(batch["state"].sharding.device_set) == set(
+            learner_mesh.devices.flat
+        )
+
+    # Same-device-set placement stays off the d2d counter: colocated
+    # (non-split) device batching is still zero-cost bookkeeping-wise.
+    colocated = Batcher(2, device=NamedSharding(actor_mesh, P(None, "dp")), dim=1)
+    colocated.cat(roll.unroll(params))
+    assert _M_D2D_BYTES.labels().get() == d2d0 + unroll_bytes

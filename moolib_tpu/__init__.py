@@ -21,6 +21,8 @@ __version__ = "0.1.0"
 __all__ = [
     "Accumulator",
     "AllReduce",
+    "AutoscalePolicy",
+    "Autoscaler",
     "Batcher",
     "Broker",
     "buckets",
@@ -35,6 +37,7 @@ __all__ = [
     "Rpc",
     "RpcDeferredReturn",
     "RpcError",
+    "SubprocessFleet",
     "rollout",
     "Watchdog",
     "WatchdogTimeout",
@@ -48,6 +51,9 @@ __all__ = [
 
 
 _LAZY = {
+    "Autoscaler": "autoscaler",
+    "AutoscalePolicy": "autoscaler",
+    "SubprocessFleet": "autoscaler",
     "Broker": "broker",
     "Group": "group",
     "AllReduce": "group",

@@ -79,6 +79,12 @@ _M_VBATCH_FILL = _REG.gauge(
     "global batch count toward the virtual batch target (fraction)",
     ("accumulator", "peer"),
 )
+_M_RECOVERY_ACTIVE = _REG.gauge(
+    "accum_recovery_active",
+    "1 while this peer is mid-recovery for the current epoch (joining, "
+    "re-electing, or model-syncing) — the autoscaler's scale-hold signal",
+    ("accumulator", "peer"),
+)
 _M_GRADIENTS = _REG.counter(
     "accum_gradients_total", "gradient contributions in applied results"
 )
@@ -321,6 +327,10 @@ class Accumulator:
         self._rec_t_synced: Optional[float] = None
         self._rec_t_first_reduce: Optional[float] = None
         self._rec_phases: Dict[str, float] = {}
+        # Last recovery_active value exported to the gauge (set on change
+        # only); None forces the first update() to export.
+        self._recovery_active_gauge: Optional[bool] = None
+        self._decommissioned = False
 
         # gradient machinery
         self._virtual_batch_size: Optional[int] = None
@@ -451,6 +461,14 @@ class Accumulator:
     def connected(self) -> bool:
         with self._lock:
             return self._group.active() and self._leader is not None and self._epoch_synced
+
+    def recovery_active(self) -> bool:
+        """True while this peer is mid-recovery for the CURRENT epoch:
+        joining, leaderless, or model-unsynced.  Unlike ``recovery_info()``
+        (which keeps the FIRST restart's phase breakdown forever), this
+        re-arms on every membership epoch — it is the scale-hold signal the
+        autoscaler reads so a resize never races a rejoin in progress."""
+        return not self.connected()
 
     def is_leader(self) -> bool:
         return self._is_leader
@@ -1991,6 +2009,16 @@ class Accumulator:
             leader = self._leader
             is_leader = self._is_leader
             synced = self._epoch_synced
+            rec_active = not (
+                self._group.active() and leader is not None and synced
+            )
+            if rec_active != self._recovery_active_gauge:
+                self._recovery_active_gauge = rec_active
+                _M_RECOVERY_ACTIVE.set(
+                    1.0 if rec_active else 0.0,
+                    accumulator=self._name,
+                    peer=self._rpc.get_name(),
+                )
             # Election repair: leaderless past the deadline on an active
             # epoch — learn the result from a member / re-issue the vote.
             if (
@@ -2423,6 +2451,37 @@ class Accumulator:
                 version,
                 buffers,
             )
+
+    def decommissioned(self) -> bool:
+        return self._decommissioned
+
+    def decommission(self, timeout: float = 30.0) -> bool:
+        """Graceful scale-down (autoscaler shrink path).  Two steps:
+
+        1. **Drain**: pump until every in-flight reduction round this peer
+           joined has settled, so contributions other peers already merged
+           aren't abandoned mid-round.  A partial LOCAL virtual-batch sum
+           (``_fire_accum``) that never fired is dropped — it was never on
+           the wire, and the two-phase count protocol keeps the cohort's
+           effective batch size at the configured target regardless.
+        2. **Leave**: explicit ``__broker_leave`` so the cohort's epoch bumps
+           immediately instead of waiting out the ping-eviction timeout.
+
+        Returns True if the broker acked the leave; False means the drain or
+        the leave timed out and the cohort will fall back to ordinary
+        ping eviction (correct, just slow)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.update()
+            with self._lock:
+                if not self._inflight:
+                    break
+            time.sleep(0.01)
+        with self._lock:
+            drained = not self._inflight
+            self._decommissioned = True
+        left = self._group.leave(timeout=max(1.0, deadline - time.monotonic()))
+        return left and drained
 
     def close(self) -> None:
         if self._ici_executor is not None:

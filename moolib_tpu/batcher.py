@@ -73,6 +73,20 @@ _M_D2D_BYTES = _REG.counter(
     "batcher_d2d_bytes_total",
     "device batches re-placed across device sets (inter-mesh handoff)",
 )
+# Flow control at the Sebulba seam (ROADMAP item 2): with ``max_outstanding``
+# set, producers block once this many completed batches sit unconsumed —
+# actor lead over the learner is bounded instead of growing without limit.
+# Per-instance label so the autoscaler can tell the learn queue from others.
+_M_QUEUE_DEPTH = _REG.gauge(
+    "batcher_queue_depth",
+    "completed batches held in the (optionally bounded) ready queue",
+    ("batcher",),
+)
+_M_PUT_BLOCKED = _REG.histogram(
+    "batcher_put_blocked_seconds",
+    "producer time spent blocked on a full bounded ready queue",
+    ("batcher",),
+)
 
 
 def _host_stack_leaves(xs, dim):
@@ -107,9 +121,12 @@ class Batcher:
     get(), plus awaitable batches."""
 
     def __init__(self, size: int, device: Optional[str] = None, dim: int = 0,
-                 host: Optional[bool] = None):
+                 host: Optional[bool] = None,
+                 max_outstanding: Optional[int] = None, name: str = "batcher"):
         if size < 1:
             raise ValueError("batch size must be >= 1")
+        if max_outstanding is not None and max_outstanding < 1:
+            raise ValueError("max_outstanding must be >= 1 (or None = unbounded)")
         self._size = size
         self._dim = dim
         self._device = _resolve_device(device)
@@ -117,7 +134,15 @@ class Batcher:
         # device-side path (XLA stack/cat, no crossings), anything else
         # accumulates as host numpy.  True/False forces a path.
         self._host = host
+        # Bounded ready queue: with max_outstanding set, the producer's
+        # stack()/cat() BLOCKS once this many completed batches await get()
+        # — backpressure instead of unbounded actor lead.  None keeps the
+        # legacy unbounded behavior (and can never deadlock single-threaded
+        # fill-then-drain code).
+        self._max_outstanding = max_outstanding
+        self._name = name
         self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
         self._slots: List[Any] = []
         self._cat_count = 0
         self._ready: collections.deque = collections.deque()
@@ -212,6 +237,18 @@ class Batcher:
         return frozenset((d,))
 
     def _finish(self, batch) -> None:
+        # Backpressure BEFORE the device_put: a blocked producer must not keep
+        # uploading batches to device memory.  wait() releases the lock, so
+        # consumers drain (get()/await notify via _pop_ready_locked).  A
+        # waiter present means immediate handoff — no queue growth, no block.
+        if self._max_outstanding is not None:
+            t0 = None
+            while len(self._ready) >= self._max_outstanding and not self._waiters:
+                if t0 is None:
+                    t0 = time.monotonic()
+                self._not_full.wait()
+            if t0 is not None:
+                _M_PUT_BLOCKED.observe(time.monotonic() - t0, batcher=self._name)
         # One device_put of the whole pytree: a single host->HBM hop per leaf.
         if self._device is not None:
             if self._host:
@@ -240,6 +277,7 @@ class Batcher:
         else:
             self._ready.append((batch, time.monotonic()))
             _M_READY_DEPTH.inc()
+            _M_QUEUE_DEPTH.set(len(self._ready), batcher=self._name)
 
     # --------------------------------------------------------------- drain
     def empty(self) -> bool:
@@ -260,7 +298,9 @@ class Batcher:
     def _pop_ready_locked(self):
         batch, done_at = self._ready.popleft()
         _M_READY_DEPTH.dec()
+        _M_QUEUE_DEPTH.set(len(self._ready), batcher=self._name)
         _M_READY_WAIT.observe(time.monotonic() - done_at)
+        self._not_full.notify()
         return batch
 
     def __await__(self):

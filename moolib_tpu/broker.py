@@ -52,6 +52,7 @@ class Broker:
         self._lock = threading.Lock()
         self._rpc.define("__broker_ping", self._on_ping)
         self._rpc.define("__broker_resync", self._on_resync)
+        self._rpc.define("__broker_leave", self._on_leave)
 
     # transparent passthroughs ------------------------------------------------
     def set_name(self, name: str) -> None:
@@ -111,6 +112,43 @@ class Broker:
         return {name: (g.members[name].get("host") if name in g.members else None)
                 for name in members}
 
+    def _bump_locked(self, g: _BrokerGroup, now: float) -> list:
+        """Advance the group's epoch and snapshot the member/host views.
+        Returns the push list to issue OUTSIDE the lock."""
+        g.needs_update = False
+        g.last_update = now
+        g.sync_id += 1
+        g.active_members = sorted(
+            g.members, key=lambda n: (g.members[n]["sort_order"], n)
+        )
+        utils.log_info(
+            "broker: group %s sync_id=%d members=%s",
+            g.name,
+            g.sync_id,
+            g.active_members,
+        )
+        members = list(g.active_members)
+        g.active_hosts = self._hosts_locked(g, members)
+        hosts = dict(g.active_hosts)
+        return [(name, g.name, g.sync_id, members, hosts) for name in members]
+
+    def _on_leave(self, group_name: str, peer_name: str):
+        """Graceful decommission: the peer announces its departure instead of
+        going silent, so the cohort doesn't burn the ping-eviction timeout.
+        The epoch bumps and pushes IMMEDIATELY — bypassing both the update()
+        cadence and the churn rate limit — because a decommission is a planned,
+        already-drained event: remaining members should re-form now."""
+        with self._lock:
+            g = self._groups.get(group_name)
+            if g is None or peer_name not in g.members:
+                return {"left": False}
+            del g.members[peer_name]
+            pushes = self._bump_locked(g, time.monotonic())
+            sync_id = g.sync_id
+        for push in pushes:
+            self._push_to(*push)
+        return {"left": True, "sync_id": sync_id}
+
     def _on_resync(self, group_name: str, peer_name: str):
         """A client whose sync_id went stale asks for the member list again."""
         with self._lock:
@@ -140,23 +178,7 @@ class Broker:
                 # Rate-limit epoch bumps (reference: 2 s; we use 0.5 s so tests
                 # with churn settle fast).
                 if g.needs_update and now - g.last_update > 0.5:
-                    g.needs_update = False
-                    g.last_update = now
-                    g.sync_id += 1
-                    g.active_members = sorted(
-                        g.members, key=lambda n: (g.members[n]["sort_order"], n)
-                    )
-                    utils.log_info(
-                        "broker: group %s sync_id=%d members=%s",
-                        g.name,
-                        g.sync_id,
-                        g.active_members,
-                    )
-                    members = list(g.active_members)
-                    g.active_hosts = self._hosts_locked(g, members)
-                    hosts = dict(g.active_hosts)
-                    for name in members:
-                        pushes.append((name, g.name, g.sync_id, members, hosts))
+                    pushes.extend(self._bump_locked(g, now))
         for push in pushes:
             self._push_to(*push)
 

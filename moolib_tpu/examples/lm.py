@@ -123,6 +123,20 @@ def make_flags(argv=None):
                    help="global batch per optimizer step (0: one reduction "
                    "per contribution)")
     p.add_argument("--wire_dtype", default=None, choices=[None, "bf16", "int8"])
+    p.add_argument("--localdir", default=None,
+                   help="per-peer scratch dir: the autoscaler's decommission "
+                   "flag is polled here (and MOOLIB_TELEMETRY_DIR usually "
+                   "points at it)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="broker-hosting peer only: supervise an elastic lm "
+                   "worker fleet from the workers' telemetry snapshots "
+                   "(moolib_tpu.autoscaler; this peer is not counted)")
+    p.add_argument("--autoscale_min", type=int, default=1,
+                   help="minimum supervised workers under --autoscale")
+    p.add_argument("--autoscale_max", type=int, default=4,
+                   help="maximum supervised workers under --autoscale")
+    p.add_argument("--autoscale_interval", type=float, default=2.0,
+                   help="supervision poll cadence seconds under --autoscale")
     p.add_argument("--checkpoint_dir", default=None,
                    help="Checkpointer directory (manifest-validated "
                    "step_<N>/ dirs); the run resumes from the newest "
@@ -405,6 +419,45 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
         broker.listen(flags.address)
     addr = flags.connect or flags.address
 
+    # Elastic fleet supervision (ROADMAP item 4): the broker-hosting peer
+    # can autoscale lm worker subprocesses into this cohort.
+    scaler = None
+    if getattr(flags, "autoscale", False):
+        if broker is None:
+            raise ValueError("--autoscale requires hosting the broker "
+                             "(pass --address, not --connect)")
+        from .. import autoscaler as autoscaler_mod
+
+        fleet_dir = _os.path.join(flags.localdir or ".", "fleet")
+        worker_args = [
+            "--vocab", str(flags.vocab), "--seq_len", str(flags.seq_len),
+            "--batch_size", str(flags.batch_size),
+            "--d_model", str(flags.d_model), "--layers", str(flags.layers),
+            "--heads", str(flags.heads), "--steps", str(flags.steps),
+            "--virtual_batch_size", str(flags.virtual_batch_size),
+            "--quiet",
+        ]
+        scaler = autoscaler_mod.Autoscaler(
+            autoscaler_mod.AutoscalePolicy(
+                flags.autoscale_min, flags.autoscale_max
+            ),
+            autoscaler_mod.SubprocessFleet(
+                autoscaler_mod.example_spawn(
+                    addr, fleet_dir, "moolib_tpu.examples.lm", worker_args,
+                ),
+                fleet_dir,
+            ),
+            poll_interval=flags.autoscale_interval,
+        )
+    decommission_flag = None
+    if getattr(flags, "localdir", None):
+        from .. import autoscaler as autoscaler_mod
+
+        decommission_flag = _os.path.join(
+            flags.localdir, autoscaler_mod.DECOMMISSION_FLAG
+        )
+    decommissioning = False
+
     acc = Accumulator("lm", params)
     acc.set_name(flags.local_name or f"lm_{_os.getpid()}")
     if start_step:
@@ -431,6 +484,12 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
     loss_v = acc_v = None
     start = time.time()
     last_ckpt = start
+    # Same counter the parallel train loop exports: the autoscaler's
+    # step-rate signal and the soak's progress probe read it from the
+    # JSONL snapshots (registration is idempotent).
+    steps_counter = telemetry.get_registry().counter(
+        "train_steps_total", "train-step invocations"
+    )
     recovery_printed = False  # one-shot per-phase breakdown line
     timer = StepTimer()  # registry-backed section breakdown
     wd = Watchdog(timeout=flags.watchdog, name="lm")
@@ -451,6 +510,12 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
             if broker is not None:
                 broker.update()
             acc.update()
+            if scaler is not None:
+                scaler.step()  # self-rate-limited supervision tick
+            if decommission_flag is not None and not decommissioning:
+                if _os.path.exists(decommission_flag):
+                    decommissioning = True
+                    break  # drain + graceful __broker_leave in finally
             if acc.wants_state():
                 acc.set_state({
                     "opt_state": jax.device_get(opt_state),
@@ -466,12 +531,26 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                 time.sleep(0.02)
                 continue
             if acc.has_gradients():
+                if flags.virtual_batch_size:
+                    # The resize-stability contract (docs/RESILIENCE.md
+                    # "Autoscaling"): every APPLIED result carries at least
+                    # the configured virtual batch no matter how the cohort
+                    # resized mid-accumulation.  Soak harnesses grep for
+                    # this line; it should never print.
+                    stats = acc.get_gradient_stats()
+                    if stats["batch_size"] < flags.virtual_batch_size:
+                        print(
+                            f"vbatch_violation: {stats} "
+                            f"target={flags.virtual_batch_size}",
+                            flush=True,
+                        )
                 with timer.section("apply"), wd.section("apply"):
                     grads = acc.gradients()
                     params, opt_state = japply(acc.parameters(), opt_state, grads)
                     acc.set_parameters(params)
                     acc.zero_gradients()
                 steps_done += 1
+                steps_counter.inc()
                 wd.feed(progress_token)
                 if not recovery_printed:
                     rec = acc.recovery_info()
@@ -513,6 +592,10 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                 save_checkpoint()
             except Exception:  # noqa: BLE001 — teardown must reach close()
                 pass
+        if decommissioning:
+            acc.decommission(timeout=10.0)
+        if scaler is not None:
+            scaler.fleet.terminate_all()
         info = acc.debug_info()
         acc.close()
         if broker is not None:

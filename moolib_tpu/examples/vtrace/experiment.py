@@ -163,6 +163,25 @@ def make_flags(argv=None):
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
+    p.add_argument(
+        "--batcher_max_outstanding", type=int, default=None,
+        help="bound the learn batcher's ready queue: actor-side assembly "
+        "blocks once this many completed batches await the learner "
+        "(Sebulba-seam flow control; default None = legacy unbounded)",
+    )
+    p.add_argument(
+        "--autoscale", action="store_true",
+        help="broker-hosting peer only: supervise an elastic worker fleet — "
+        "poll the workers' telemetry snapshots and grow/shrink the cohort "
+        "between --autoscale_min and --autoscale_max supervised workers "
+        "(moolib_tpu.autoscaler; this peer itself is not counted)",
+    )
+    p.add_argument("--autoscale_min", type=int, default=1,
+                   help="minimum supervised workers under --autoscale")
+    p.add_argument("--autoscale_max", type=int, default=4,
+                   help="maximum supervised workers under --autoscale")
+    p.add_argument("--autoscale_interval", type=float, default=2.0,
+                   help="supervision poll cadence seconds under --autoscale")
     p.add_argument("--watchdog", type=float, default=0.0,
                    help="deadman seconds per loop section (0 = off); expiry "
                    "dumps telemetry + thread stacks and raises "
@@ -517,6 +536,42 @@ def train(flags, on_stats=None) -> dict:
     else:
         broker_addr = flags.connect
 
+    # Elastic fleet supervision (ROADMAP item 4): the broker-hosting peer can
+    # run the telemetry-driven autoscaler, spawning/decommissioning worker
+    # subprocesses that join this same cohort.
+    scaler = None
+    if flags.autoscale:
+        if broker is None:
+            raise ValueError("--autoscale requires hosting the broker "
+                             "(omit --connect)")
+        from ... import autoscaler as autoscaler_mod
+
+        fleet_dir = os.path.join(flags.localdir or ".", "fleet")
+        worker_args = [
+            "--env", flags.env,
+            "--total_steps", str(flags.total_steps),
+            "--batch_size", str(flags.batch_size),
+            "--virtual_batch_size", str(flags.virtual_batch_size),
+            "--actor_batch_size", str(flags.actor_batch_size),
+            "--unroll_length", str(flags.unroll_length),
+            "--num_env_processes", str(flags.num_env_processes),
+            "--train_id", flags.train_id,
+            "--quiet",
+        ]
+        scaler = autoscaler_mod.Autoscaler(
+            autoscaler_mod.AutoscalePolicy(
+                flags.autoscale_min, flags.autoscale_max
+            ),
+            autoscaler_mod.SubprocessFleet(
+                autoscaler_mod.example_spawn(
+                    flags.address, fleet_dir,
+                    "moolib_tpu.examples.vtrace.experiment", worker_args,
+                ),
+                fleet_dir,
+            ),
+            poll_interval=flags.autoscale_interval,
+        )
+
     rpc = Rpc()
     rpc.set_name(flags.local_name or f"impala-{os.getpid()}")
     rpc.listen("127.0.0.1:0")
@@ -640,7 +695,8 @@ def train(flags, on_stats=None) -> dict:
     # With a mesh, the Batcher lands batches pre-sharded (device_put accepts
     # a NamedSharding target): [T+1, B] over (∅, dp).
     learn_batcher = Batcher(
-        flags.batch_size, device=batch_sharding if mesh is not None else device, dim=1
+        flags.batch_size, device=batch_sharding if mesh is not None else device, dim=1,
+        max_outstanding=flags.batcher_max_outstanding, name="learn",
     )
     # Initial LSTM states ride a parallel batcher (batch axis 0) so they
     # split/merge across learner batches exactly like the unrolls do.
@@ -683,6 +739,15 @@ def train(flags, on_stats=None) -> dict:
     # like SIGINT (reference signal handling, examples/vtrace/
     # experiment.py:331-348). Restored on exit so nested runs are clean.
     stop_requested = False
+    # Graceful scale-down: the autoscaler drops this flag file; the loop
+    # drains + __broker_leave's instead of waiting to be ping-evicted.
+    from ... import autoscaler as autoscaler_flagmod
+
+    decommission_flag = (
+        os.path.join(flags.localdir, autoscaler_flagmod.DECOMMISSION_FLAG)
+        if flags.localdir else None
+    )
+    decommissioning = False
 
     def _on_sigterm(signum, frame):
         nonlocal stop_requested
@@ -702,6 +767,15 @@ def train(flags, on_stats=None) -> dict:
                 broker.update()
             rpc_group.update()
             accumulator.update()
+            if scaler is not None:
+                scaler.step()  # self-rate-limited supervision tick
+            if decommission_flag is not None and not decommissioning:
+                if os.path.exists(decommission_flag):
+                    # Supervisor asked this peer to scale out: drain and
+                    # leave gracefully, then exit through the normal
+                    # checkpoint/teardown path.
+                    decommissioning = True
+                    stop_requested = True
 
             if accumulator.wants_state():
                 accumulator.set_state(
@@ -982,8 +1056,14 @@ def train(flags, on_stats=None) -> dict:
                 flags.checkpoint, params, opt_state,
                 stats["steps_done"].value, accumulator.model_version(),
             )
+        if decommissioning:
+            # Drain in-flight contributions, then tell the broker we're gone
+            # so the cohort's epoch bumps now (not after the ping timeout).
+            accumulator.decommission(timeout=15.0)
         for e in envs:
             e.close()
+        if scaler is not None:
+            scaler.fleet.terminate_all()
         accumulator.close()
         rpc.close()
         if broker is not None:

@@ -810,10 +810,12 @@ class Group:
         self._last_ping = 0.0
         self._ping_interval = 1.0
         self._ping_inflight = False
+        self._left = False
         self._stale_since: Optional[float] = None
         self._ops: Dict[Tuple, Any] = {}  # key -> _Op | _RingOp
         self._parked: Dict[Tuple, List[Any]] = {}
         self._ring_parked: Dict[Tuple, List[Tuple]] = {}
+        self._park_t: Dict[Tuple, float] = {}  # park time, swept in update()
         self._seq: Dict[Tuple, int] = {}  # (sync_id, op name) -> next seq
         self._recv_seq: Dict[Tuple, int] = {}
         self._on_change_callbacks: List[Callable] = []
@@ -933,6 +935,52 @@ class Group:
         """Extension over the reference: observe membership epoch changes."""
         self._on_change_callbacks.append(cb)
 
+    def left(self) -> bool:
+        return self._left
+
+    def leave(self, timeout: float = 5.0) -> bool:
+        """Graceful decommission: announce departure to the broker instead of
+        going silent and burning the cohort's ping-eviction timeout.  The
+        broker bumps the membership epoch immediately, so the remaining
+        members re-form in sub-second time.  After this the group stops
+        pinging and stays inactive; returns True once the broker acked the
+        leave (False on timeout/error — the cohort then falls back to the
+        ordinary eviction path, which is still correct, just slow)."""
+        with self._lock:
+            if self._left:
+                return True
+            self._left = True
+            # Our own in-flight ops can never complete: we stop receiving
+            # epoch pushes, so nothing would ever cancel them (the remaining
+            # members' copies die with the leave's epoch bump).  Membership
+            # state clears so active() turns False; change callbacks do NOT
+            # fire — leaving is this peer's own decision, not a cohort event
+            # it must re-elect over.
+            ops, self._ops = list(self._ops.values()), {}
+            self._parked.clear()
+            self._ring_parked.clear()
+            self._park_t.clear()
+            self._seq.clear()
+            self._recv_seq.clear()
+            self._members = []
+            self._member_hosts = {}
+        for op in ops:
+            op.future.set_exception(RpcError("left group"))
+        done = threading.Event()
+        acked = []
+
+        def _reply(result, error):
+            if error is None and isinstance(result, dict) and result.get("left"):
+                acked.append(True)
+            done.set()
+
+        self._rpc.async_callback(
+            self._broker_name, "__broker_leave", _reply,
+            self._name, self._rpc.get_name(),
+        )
+        done.wait(timeout)
+        return bool(acked)
+
     def update(self) -> None:
         """Pump: ping the broker, request resync when stale, sweep op timeouts.
 
@@ -940,7 +988,8 @@ class Group:
         (``src/group.h:394-490``); call it regularly from the train loop.
         """
         now = time.monotonic()
-        if now - self._last_ping >= self._ping_interval and not self._ping_inflight:
+        if (now - self._last_ping >= self._ping_interval and not self._ping_inflight
+                and not self._left):
             self._last_ping = now
             self._ping_inflight = True
             self._rpc.async_callback(
@@ -959,6 +1008,18 @@ class Group:
             ]
             for op in expired:
                 del self._ops[op.key]
+            # Parked frames whose op never materialized (epoch never adopted,
+            # or the local op consumed them — all_reduce pops the frame lists
+            # but not the timestamps) age out on the same clock as ops.
+            stale = [
+                k for k, t in self._park_t.items()
+                if now - t > self._timeout
+                or (k not in self._parked and k not in self._ring_parked)
+            ]
+            for k in stale:
+                del self._park_t[k]
+                self._parked.pop(k, None)
+                self._ring_parked.pop(k, None)
         # Futures complete outside the group lock: done-callbacks (e.g. the
         # Accumulator's) take their own locks, and completing inline would
         # invert the lock order against all_reduce callers.
@@ -1004,9 +1065,16 @@ class Group:
             self._stale_since = None
             # Cancel everything in flight: the tree changed under it
             # (reference cancels with "group change", src/group.h:453-460).
+            # Frames parked FOR this very epoch survive — a fast peer's
+            # first op raced ahead of our broker push (see _on_reduce);
+            # everything else died with its epoch.
             ops, self._ops = list(self._ops.values()), {}
-            self._parked.clear()
-            self._ring_parked.clear()
+            self._parked = {k: v for k, v in self._parked.items()
+                            if k[0] == sync_id}
+            self._ring_parked = {k: v for k, v in self._ring_parked.items()
+                                 if k[0] == sync_id}
+            self._park_t = {k: t for k, t in self._park_t.items()
+                            if k in self._parked or k in self._ring_parked}
             self._seq.clear()
             self._recv_seq.clear()
         for op in ops:
@@ -1301,13 +1369,23 @@ class Group:
     def _on_reduce(self, key, value):
         key = tuple(key) if isinstance(key, list) else key
         with self._lock:
-            if self._sync_id is None or key[0] != self._sync_id:
+            if self._sync_id is None or key[0] > self._sync_id:
+                # An epoch this peer hasn't learned yet: the sender's broker
+                # push beat ours and its first op raced ahead.  Dropping
+                # would wedge that op (and the sender's election) until the
+                # timeout sweep — the re_elect stall — so park; _on_update
+                # keeps frames addressed to the epoch it installs.
+                self._parked.setdefault(key, []).append(_own(value))
+                self._park_t.setdefault(key, time.monotonic())
+                return None
+            if key[0] < self._sync_id:
                 return None  # contribution from a dead epoch
             op = self._ops.get(key)
             if op is None:
                 # Parked past the handler return: must own the bytes (the
                 # handler runs inline with borrowed receive-buffer views).
                 self._parked.setdefault(key, []).append(_own(value))
+                self._park_t.setdefault(key, time.monotonic())
                 return None
             if isinstance(op, (_RingOp, _BucketedReduce)):
                 del self._ops[key]
@@ -1473,12 +1551,19 @@ class Group:
         # payload views up front — the copy the old deserializer made.
         data = _own(data)
         with self._lock:
-            if self._sync_id is None or key[0] != self._sync_id:
+            if self._sync_id is None or key[0] > self._sync_id:
+                # Not-yet-learned epoch: park, same rule as _on_reduce.
+                self._ring_parked.setdefault(key, []).append(
+                    (phase, step, chunk_idx, data, meta))
+                self._park_t.setdefault(key, time.monotonic())
+                return None
+            if key[0] < self._sync_id:
                 return None  # frame from a dead epoch
             op = self._ops.get(key)
             if op is None:
                 self._ring_parked.setdefault(key, []).append(
                     (phase, step, chunk_idx, data, meta))
+                self._park_t.setdefault(key, time.monotonic())
                 return None
             if not isinstance(op, _RingOp):
                 del self._ops[key]

@@ -210,6 +210,27 @@ class FaultPlan:
         os.kill(pool._procs[index].pid, signal.SIGCONT)
 
     # ----------------------------------------------------------- cohort peers
+    def poisson_kills(self, rate: float, window: float) -> List[float]:
+        """Rolling peer-kill schedule: kill times (seconds from start) drawn
+        from a Poisson arrival process with ``rate`` kills/second over
+        ``window`` seconds — the standard preemptible/spot churn model
+        (exponential inter-arrivals on this plan's ``poisson`` stream, so
+        the schedule is fully determined by the seed).
+
+        The consumer (``scripts/chaos_soak.py`` or the autoscaler soak)
+        sleeps toward each time and kills whichever peer its own ``kills``
+        stream picks then; the schedule itself is just the arrival clock."""
+        if rate <= 0 or window <= 0:
+            return []
+        rng = self.rng("poisson")
+        times: List[float] = []
+        t = rng.expovariate(rate)
+        while t < window:
+            times.append(round(t, 3))
+            t += rng.expovariate(rate)
+        self._record("poisson_kills", rate, window, tuple(times))
+        return times
+
     def kill_process(self, proc, sig: int = signal.SIGKILL) -> None:
         """Kill a peer process (``subprocess.Popen`` or bare pid): broker
         eviction, epoch churn, and leader re-election on the survivors."""

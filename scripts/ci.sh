@@ -103,6 +103,17 @@ step "chaos soak (seeded, ~80 s smoke: worker/peer kills + respawn SLO, RPC fram
 MOOLIB_COMPILE_CACHE="${TMPDIR:-/tmp}/moolib_ci_jax_cache" \
   python scripts/chaos_soak.py --smoke --recovery_bound_s 60 || fail=1
 
+step "autoscaler tests (policy decisions, graceful leave, vbatch stability across resize)"
+python -m pytest tests/test_autoscaler.py -q || fail=1
+
+step "autoscale soak (Poisson preemption: respawn SLO, sub-second graceful decommission, vbatch stability)"
+# Exits non-zero on any unrecovered kill (replacement not contributing
+# within --recovery_bound_s), a graceful decommission that burned the
+# ping-eviction timeout instead of __broker_leave, or any vbatch_violation
+# in a worker log (docs/RESILIENCE.md "Autoscaling").
+MOOLIB_COMPILE_CACHE="${TMPDIR:-/tmp}/moolib_ci_jax_cache" \
+  python scripts/autoscale_soak.py --smoke --recovery_bound_s 90 || fail=1
+
 step "sanitizer matrix (skips where the runtime is missing)"
 python -m pytest tests/test_native_sanitizers.py -q || fail=1
 

@@ -100,3 +100,79 @@ def test_await_batches():
 
     out = asyncio.run(main())
     assert np.asarray(out).shape == (2, 1)
+
+
+def test_max_outstanding_blocks_producer():
+    """A bounded ready queue applies backpressure: the producer thread blocks
+    once max_outstanding completed batches are waiting, and each consumer
+    get() releases exactly one slot."""
+    import threading
+    import time
+
+    from moolib_tpu.telemetry import get_registry
+
+    b = Batcher(1, dim=0, max_outstanding=2, name="bounded")
+    produced = []
+
+    def producer():
+        for i in range(5):
+            b.stack(np.full((3,), float(i)))
+            produced.append(i)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    deadline = time.time() + 5.0
+    while len(produced) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.1)  # give the producer a chance to (wrongly) run ahead
+    # Batches 0 and 1 filled the queue; the put of batch 2 is blocked.
+    assert produced == [0, 1], produced
+    snap = get_registry().snapshot()
+    depth = [
+        s["value"]
+        for s in snap["batcher_queue_depth"]["series"]
+        if s["labels"].get("batcher") == "bounded"
+    ]
+    assert depth == [2.0]
+
+    for expect in range(5):
+        waited = time.time() + 5.0
+        while b.empty() and time.time() < waited:
+            time.sleep(0.005)
+        out = b.get()
+        np.testing.assert_allclose(np.asarray(out), np.full((1, 3), float(expect)))
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert produced == [0, 1, 2, 3, 4]
+
+
+def test_max_outstanding_waiter_direct_handoff():
+    """An awaiting consumer means immediate handoff: the completed batch never
+    enters the bounded queue, so the bound never blocks a producer that is
+    feeding a live waiter."""
+    import asyncio
+
+    b = Batcher(1, max_outstanding=1)
+
+    async def main():
+        task = asyncio.ensure_future(_consume(b))
+        await asyncio.sleep(0.05)  # consumer is registered as a waiter
+        b.stack(np.ones(2))  # handed straight to the waiter, queue stays empty
+        first = await task
+        assert b.empty()
+        b.stack(np.zeros(2))  # no waiter now: lands in the (1-slot) queue
+        assert not b.empty()
+        return first, await b
+
+    first, second = asyncio.run(main())
+    np.testing.assert_allclose(np.asarray(first), np.ones((1, 2)))
+    np.testing.assert_allclose(np.asarray(second), np.zeros((1, 2)))
+
+
+async def _consume(b):
+    return await b
+
+
+def test_max_outstanding_validation():
+    with pytest.raises(ValueError):
+        Batcher(2, max_outstanding=0)

@@ -328,6 +328,132 @@ def test_single_member_group(free_port):
         close_all(broker, peers)
 
 
+def test_future_epoch_contribution_parked():
+    """Epoch pushes arrive with skew: a fast peer's first op under the NEW
+    epoch can reach a peer still on the OLD one.  Dropping that frame wedges
+    the sender's op (and the cohort's election) until the timeout sweep —
+    it must be parked and fold once the local push lands."""
+    rpc = Rpc()
+    rpc.set_name("peer0")
+    g = Group(rpc, "g")
+    g.set_timeout(5.0)
+    try:
+        g._on_update(1, ["peer0", "peer1"])
+        # peer1's contribution for epoch 2, which we haven't learned yet.
+        g._on_reduce((2, "op", 0), 5)
+        assert (2, "op", 0) in g._parked
+        # A genuinely dead epoch still drops.
+        g._on_reduce((0, "op", 0), 99)
+        assert (0, "op", 0) not in g._parked
+        # Our push lands: the raced-ahead frame survives into its epoch...
+        g._on_update(2, ["peer0", "peer1"])
+        assert (2, "op", 0) in g._parked
+        # ...and folds into our own round: peer0 is root, so the parked
+        # contribution completes the reduce with no timeout involved.
+        fut = g.all_reduce("op", 1)
+        assert fut.result(5) == 6
+    finally:
+        rpc.close()
+
+
+def test_stale_parked_frames_swept():
+    """Parked frames for an epoch that never gets adopted (e.g. this peer
+    was evicted from it) age out on the op-timeout clock."""
+    rpc = Rpc()
+    rpc.set_name("peer0")
+    g = Group(rpc, "g")
+    g.set_timeout(5.0)
+    try:
+        g._on_update(1, ["peer0", "peer1"])
+        g._on_reduce((7, "op", 0), 1)
+        assert (7, "op", 0) in g._parked
+        g._park_t[(7, "op", 0)] -= 10.0  # age past the 5 s timeout
+        g._last_ping = time.monotonic()  # keep update() off the (absent) broker
+        g.update()
+        assert (7, "op", 0) not in g._parked
+        assert (7, "op", 0) not in g._park_t
+    finally:
+        rpc.close()
+
+
+def test_epoch_storm(free_port):
+    """ISSUE 8 satellite: rapid join/leave bursts.  Invariants: sync_id is
+    strictly monotone cohort-wide, a graceful ``Group.leave`` bumps the
+    epoch on the survivors in < 1 s (no ping-eviction wait — the broker
+    timeout here is 30 s, so only the explicit ``__broker_leave`` can
+    explain a fast bump), no allreduce left in flight across the
+    transitions ever wedges, and the cohort still reduces afterward."""
+    broker, peers = make_cohort(free_port, 3, timeout=30.0)
+    churn_rpcs = []
+    try:
+        groups = [g for _, g in peers]
+        assert pump(broker, groups, 15, until=lambda: all(g.active() for g in groups))
+        addr = f"127.0.0.1:{free_port}"
+        seen_syncs = [groups[0].sync_id()]
+        inflight = []
+        for cycle in range(3):
+            # Reductions started now are keyed to the pre-join epoch; the
+            # join/leave bumps below must cancel them, never strand them.
+            inflight.extend(
+                g.all_reduce(f"storm{cycle}", 1.0) for g in groups
+            )
+            rpc = Rpc()
+            rpc.set_name(f"churn{cycle}")
+            rpc.set_timeout(10)
+            rpc.listen("127.0.0.1:0")
+            rpc.connect(addr)
+            churn_rpcs.append(rpc)
+            gch = Group(rpc, "g")
+            gch.set_timeout(5.0)
+            all_g = groups + [gch]
+            assert pump(
+                broker, all_g, 20,
+                until=lambda: gch.active()
+                and all(len(g.members()) == 4 for g in all_g),
+            ), f"cycle {cycle}: join never converged"
+            seen_syncs.append(groups[0].sync_id())
+            # These wait on a churner contribution that will never come;
+            # the leave's epoch bump must cancel them.
+            inflight.extend(
+                g.all_reduce(f"stranded{cycle}", 1.0) for g in groups
+            )
+            # The leaver's own in-flight op: after leaving it receives no
+            # more epoch pushes, so only leave() itself can cancel it.
+            inflight.append(gch.all_reduce(f"churner{cycle}", 1.0))
+            before = groups[0].sync_id()
+            t0 = time.monotonic()
+            assert gch.leave(), "broker did not ack the graceful leave"
+            assert pump(
+                broker, groups, 5,
+                until=lambda: all(
+                    g.sync_id() is not None and g.sync_id() != before
+                    for g in groups
+                ),
+            ), f"cycle {cycle}: epoch never bumped after leave"
+            bump_s = time.monotonic() - t0
+            assert bump_s < 1.0, (
+                f"graceful leave took {bump_s:.2f}s — fell back to eviction?"
+            )
+            assert not gch.active()
+            seen_syncs.append(groups[0].sync_id())
+        assert all(b > a for a, b in zip(seen_syncs, seen_syncs[1:])), (
+            f"sync_id not strictly monotone across the storm: {seen_syncs}"
+        )
+        # Nothing wedged: every storm-era reduction settled one way or the
+        # other (result or 'group changed' cancellation).
+        assert pump(
+            broker, groups, 15, until=lambda: all(f.done() for f in inflight)
+        ), "a storm-era allreduce wedged (never completed nor cancelled)"
+        # And the surviving cohort still reduces correctly.
+        futs = [g.all_reduce("after_storm", i + 1) for i, g in enumerate(groups)]
+        assert pump(broker, groups, 10, until=lambda: all(f.done() for f in futs))
+        assert all(f.result(5) == 6 for f in futs)
+    finally:
+        for rpc in churn_rpcs:
+            rpc.close()
+        close_all(broker, peers)
+
+
 def test_broker_concurrent_ping_update_hammer():
     """ADVICE round-1 (high): _on_ping/_on_resync run on the Rpc executor pool
     concurrently with update() on the caller thread; without the broker lock

@@ -274,11 +274,36 @@ def parse_agent_lines(path):
     return keep or None
 
 
+def parse_serve_qps(path):
+    """serve_bench --qps stdout: the baseline closed-loop row plus one
+    ``{"metric": "serve_qps", ...}`` line per target (no platform gate —
+    the sustained-QPS record is a local/host capture by design; the chip
+    path stays the closed-loop ``lm_serve`` section above)."""
+    keep = []
+    try:
+        with open(path) as f:
+            for line in f.read().splitlines():
+                if not line.startswith("{"):
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("metric") == "serve_qps" or "p99_ms" in row:
+                    keep.append(json.dumps(row))
+    except OSError:
+        return None
+    # Without at least one serve_qps row this is a closed-loop serve log,
+    # not a --qps capture — let the other detectors claim it.
+    return keep if any('"serve_qps"' in l for l in keep) else None
+
+
 def fold_local(log_path, json_path):
     """Merge a fresh local capture into BENCH_LOCAL.json: only the section
     the log belongs to — ``allreduce_rpc`` for an allreduce_bench capture,
-    ``agent_small`` for an agent_bench one (detected by content) — has its
-    stdout replaced; every other section (rpc, envpool, ...) is preserved
+    ``agent_small`` for an agent_bench one, ``serve_qps`` for a
+    ``serve_bench --qps`` one (detected by content) — has its stdout
+    replaced; every other section (rpc, envpool, ...) is preserved
     verbatim — same row-preservation policy as the BENCH_TPU merges above."""
     if os.path.exists(json_path):
         # A corrupt record must ABORT, not be clobbered (curated history).
@@ -287,16 +312,27 @@ def fold_local(log_path, json_path):
     else:
         data = {}
     agent_lines = parse_agent_lines(log_path)
+    qps_lines = None if agent_lines else parse_serve_qps(log_path)
     if agent_lines:
         section, cmd, lines = (
             "agent_small",
             "benchmarks/agent_bench.py --scale small --rollout all",
             agent_lines,
         )
+    elif qps_lines:
+        targets = [str(json.loads(l)["qps_target"]) for l in qps_lines
+                   if '"serve_qps"' in l]
+        section, cmd, lines = (
+            "serve_qps",
+            "benchmarks/serve_bench.py --qps " + " ".join(targets),
+            qps_lines,
+        )
     else:
         lines = parse_allreduce(log_path)
         if not lines:
-            raise SystemExit(f"no allreduce or agent rows found in {log_path}")
+            raise SystemExit(
+                f"no allreduce, agent, or serve_qps rows found in {log_path}"
+            )
         section, cmd = "allreduce_rpc", "benchmarks/allreduce_bench.py rpc"
     sec = dict(data.get(section, {}))
     # The cmd reflects THIS capture (the arm set can grow across rounds);

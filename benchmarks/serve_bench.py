@@ -10,6 +10,19 @@ this is it.
 One JSON line per config:
     {"clients": 8, "dynamic_batching": true, "kv_heads": 4, "p50_ms": ...,
      "p99_ms": ..., "requests_per_s": ..., "tokens_per_s": ...}
+
+``--qps`` switches to the sustained-load mode for the resilient serving
+plane (``moolib_tpu/serving.py``): the batch-1 two-stage-readiness baseline
+row still runs first (unchanged config, so the record keeps its control),
+then a broker + replica-mode server comes up and paced clients hold each
+target QPS for the window, reporting p50/p99 **and the admission reject
+rate** — the number the old closed-loop rows cannot see (a closed loop
+self-throttles instead of overrunning admission).  One JSON line per
+target:
+    {"metric": "serve_qps", "qps_target": 50, "p50_ms": ..., "p99_ms": ...,
+     "achieved_qps": ..., "reject_rate": ..., ...}
+``fold_capture.py --local`` folds these into BENCH_LOCAL.json
+(``serve_qps`` section).
 """
 
 from __future__ import annotations
@@ -202,6 +215,146 @@ def run_config(args, dynamic: bool, kv_heads: int, batch_size: int):
             pass
 
 
+def run_qps(args):
+    """Sustained-QPS rows against a replica-mode server (admission control
+    on): paced arrivals, per-request deadline, typed rejects counted."""
+    import numpy as np
+
+    from moolib_tpu import Broker
+    from moolib_tpu.serving import ServeClient, is_overload_error
+
+    broker_port = _free_port()
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(f"127.0.0.1:{broker_port}")
+    stop_pump = threading.Event()
+
+    def pump():
+        while not stop_pump.is_set():
+            broker.update()
+            stop_pump.wait(0.05)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    port = _free_port()
+    env = dict(
+        os.environ,
+        PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    cmd = [
+        sys.executable, "-m", "moolib_tpu.examples.lm_serve",
+        "--listen", f"127.0.0.1:{port}",
+        "--broker", f"127.0.0.1:{broker_port}",
+        "--vocab", str(args.vocab),
+        "--seq_len", str(args.seq_len),
+        "--d_model", str(args.d_model),
+        "--layers", str(args.layers),
+        "--heads", str(args.heads),
+        "--kv_heads", str(args.heads),
+        "--batch_size", str(args.batch_sizes[0]),
+        "--max_new_tokens", str(args.max_new_tokens),
+        "--max_queue", str(args.max_queue),
+    ]
+    log_path = f"/tmp/serve_bench_qps_{port}.log"
+    with open(log_path, "w") as log:
+        server = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                  text=True, env=env, cwd=ROOT,
+                                  start_new_session=True)
+    client = None
+    try:
+        _await_line(log_path, server, "precompiling", args.startup_timeout,
+                    "server never came up")
+        _await_line(log_path, server, "serving", args.ready_timeout,
+                    f"server never finished pre-compiling within "
+                    f"{args.ready_timeout:.0f}s")
+        platform = _server_platform(log_path)
+        client = ServeClient(broker=f"127.0.0.1:{broker_port}",
+                             deadline_s=args.deadline_s)
+        client.wait_for_replicas(1, timeout=30.0)
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(2, args.vocab, args.seq_len).astype(np.int32)
+        client.call(prompt)  # warm + prime the server's service-time EMA
+
+        for q in args.qps:
+            latencies: list = []
+            outcomes = {"ok": 0, "reject": 0, "deadline": 0, "error": 0}
+            lock = threading.Lock()
+            pending = []
+
+            def on_done(fut, t0):
+                dt = time.perf_counter() - t0
+                exc = fut.exception()
+                with lock:
+                    if exc is None:
+                        outcomes["ok"] += 1
+                        latencies.append(dt)
+                    elif is_overload_error(exc):
+                        outcomes["reject"] += 1
+                    elif "deadline" in str(exc).lower():
+                        outcomes["deadline"] += 1
+                    else:
+                        outcomes["error"] += 1
+
+            interval = 1.0 / q
+            n = max(1, int(args.seconds * q))
+            t_start = time.perf_counter()
+            for i in range(n):
+                # Paced (open-loop) arrivals: a slow server sees the real
+                # offered load and must shed it through admission, not
+                # through a self-throttling client.
+                target = t_start + i * interval
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                p = rng.integers(2, args.vocab, args.seq_len).astype(np.int32)
+                t0 = time.perf_counter()
+                fut = client.submit(p)
+                fut.add_done_callback(lambda f, t0=t0: on_done(f, t0))
+                pending.append(fut)
+            for fut in pending:
+                try:
+                    fut.result(args.deadline_s + 10.0)
+                except Exception:  # noqa: BLE001 — classified in on_done
+                    pass
+            wall = time.perf_counter() - t_start
+            with lock:
+                lat = np.sort(np.asarray(latencies)) if latencies else None
+                row = {
+                    "metric": "serve_qps",
+                    "platform": platform,
+                    "qps_target": q,
+                    "deadline_s": args.deadline_s,
+                    "requests": n,
+                    "ok": outcomes["ok"],
+                    "rejects": outcomes["reject"],
+                    "deadline_errors": outcomes["deadline"],
+                    "errors": outcomes["error"],
+                    "reject_rate": round(outcomes["reject"] / n, 4),
+                    "achieved_qps": round(outcomes["ok"] / wall, 1),
+                    "p50_ms": (round(float(np.percentile(lat, 50)) * 1e3, 1)
+                               if lat is not None else None),
+                    "p99_ms": (round(float(np.percentile(lat, 99)) * 1e3, 1)
+                               if lat is not None else None),
+                }
+            print(json.dumps(row), flush=True)
+    finally:
+        import signal
+
+        if client is not None:
+            client.close()
+        stop_pump.set()
+        broker.close()
+        try:
+            os.killpg(server.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            server.kill()
+        server.wait()
+        try:
+            os.unlink(log_path)
+        except OSError:
+            pass
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--clients", type=int, default=8)
@@ -221,6 +374,15 @@ def main(argv=None):
                    help="deadline for the server's 'precompiling' proof-of-"
                    "life line (args parsed, jax imported); only THIS "
                    "expiring means 'server never came up'")
+    p.add_argument("--qps", type=float, nargs="+", default=None,
+                   help="sustained-QPS mode: paced open-loop load at each "
+                   "target against a replica-mode server (admission control "
+                   "on); reports p50/p99 + reject rate per target")
+    p.add_argument("--deadline_s", type=float, default=5.0,
+                   help="per-request deadline in --qps mode (drives both "
+                   "client retries and server admission)")
+    p.add_argument("--max_queue", type=int, default=128,
+                   help="server admission queue bound in --qps mode")
     p.add_argument("--ready_timeout", type=float, default=420.0,
                    help="deadline from proof-of-life to the 'serving' line; "
                    "bucketed serving pre-compiles every power-of-2 bucket "
@@ -234,6 +396,13 @@ def main(argv=None):
         f"window={args.seconds}s"
     )
     print(cfg, flush=True)
+    if args.qps:
+        # The batch-1 two-stage-readiness baseline stays the first row (the
+        # control a battery timeout must never truncate away), then the
+        # sustained-QPS rows run against the resilient plane.
+        run_config(args, dynamic=False, kv_heads=args.heads, batch_size=1)
+        run_qps(args)
+        return
     ok: set = set()
     # (dynamic, kv_heads, batch_size): the batch-1 BASELINE runs first
     # (VERDICT r5 weak #2 — the crossover's control row must never be the

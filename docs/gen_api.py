@@ -37,6 +37,7 @@ MODULES = [
     ("moolib_tpu.checkpoint", "Checkpointing"),
     ("moolib_tpu.watchdog", "Watchdog (run-loop deadman)"),
     ("moolib_tpu.autoscaler", "Autoscaler (elastic fleet supervision)"),
+    ("moolib_tpu.serving", "Serving (replicated inference plane)"),
     ("moolib_tpu.testing.faults", "Testing: seeded fault injection"),
     ("moolib_tpu.parallel", "Parallelism (package)"),
     ("moolib_tpu.parallel.mesh", "Parallelism: mesh + shardings"),

@@ -263,6 +263,8 @@ class Accumulator:
 
         # model / election state
         self._model_version = 0
+        self._version_callbacks: list = []
+        self._last_notified_version: Optional[int] = None
         self._leader: Optional[str] = None
         self._is_leader = False
         self._election_future = None
@@ -473,6 +475,12 @@ class Accumulator:
     def is_leader(self) -> bool:
         return self._is_leader
 
+    @property
+    def rpc(self) -> Rpc:
+        """The underlying Rpc (serving-plane publishers ride the learner's
+        existing peer identity and connections)."""
+        return self._rpc
+
     def get_leader(self) -> Optional[str]:
         return self._leader
 
@@ -483,6 +491,30 @@ class Accumulator:
         """Set after restoring a checkpoint so leader election prefers the
         restored peer (reference ``src/moolib.cc:1808-1821``)."""
         self._model_version = int(n)
+        self._notify_version()
+
+    def add_model_version_callback(self, cb) -> None:
+        """Serving-plane hook: ``cb(version)`` fires whenever the model
+        version advances (gradient applies, staged-model commits, checkpoint
+        restores) — from the ``update()`` pump, OUTSIDE the accumulator
+        lock, so the callback may call back into this accumulator.  The lm
+        example uses it to drive ``serving.ModelPublisher.publish`` at a
+        step cadence: the learner announces fresh weights and serving
+        replicas hot-swap with zero downtime (``moolib_tpu.serving``)."""
+        self._version_callbacks.append(cb)
+
+    def _notify_version(self) -> None:
+        if not self._version_callbacks:
+            return
+        v = self._model_version
+        if v == self._last_notified_version:
+            return
+        self._last_notified_version = v
+        for cb in self._version_callbacks:
+            try:
+                cb(v)
+            except Exception:  # noqa: BLE001 — a serving-side hiccup must
+                utils.log_error("model version callback failed")  # not stop training
 
     def set_virtual_batch_size(self, n: int) -> None:
         self._virtual_batch_size = int(n)
@@ -2156,6 +2188,7 @@ class Accumulator:
             elif self._buffers is not None and now - self._last_buffers_push > _BUFFERS_PUSH_INTERVAL:
                 self._last_buffers_push = now
                 self._broadcast_buffers()
+        self._notify_version()
 
     # ------------------------------------------------------------- elections
     def _on_group_change(self):

@@ -22,13 +22,20 @@ from .rpc import Rpc
 
 
 class _BrokerGroup:
-    __slots__ = ("name", "members", "sync_id", "active_members", "active_hosts",
-                 "needs_update", "last_update")
+    __slots__ = ("name", "members", "observers", "sync_id", "active_members",
+                 "active_hosts", "needs_update", "last_update")
 
     def __init__(self, name: str):
         self.name = name
         # peer name -> {"last_ping": t, "sort_order": int, "host": str|None}
         self.members: Dict[str, dict] = {}
+        # Non-contributing members (serving replicas, observers): registered
+        # for liveness + discovery (``__broker_list``) but NEVER part of the
+        # membership epoch — joining, leaving, or dying must not bump
+        # ``sync_id`` (an epoch bump cancels the cohort's in-flight
+        # reductions; a serving replica must not be able to do that).
+        # peer name -> {"last_ping": t, "role": str}
+        self.observers: Dict[str, dict] = {}
         self.sync_id = int(time.time() * 1000) % (1 << 40)
         self.active_members: list = []
         # Host map SNAPSHOTTED at the epoch bump: resync must serve exactly
@@ -53,6 +60,7 @@ class Broker:
         self._rpc.define("__broker_ping", self._on_ping)
         self._rpc.define("__broker_resync", self._on_resync)
         self._rpc.define("__broker_leave", self._on_leave)
+        self._rpc.define("__broker_list", self._on_list)
 
     # transparent passthroughs ------------------------------------------------
     def set_name(self, name: str) -> None:
@@ -75,9 +83,21 @@ class Broker:
 
     # service -----------------------------------------------------------------
     def _on_ping(self, group_name: str, peer_name: str, sort_order: int, client_sync_id,
-                 host: Optional[str] = None):
+                 host: Optional[str] = None, role: str = "member"):
         with self._lock:
             g = self._groups.setdefault(group_name, _BrokerGroup(group_name))
+            if role != "member":
+                # Observer ping: track liveness/role only.  If the peer was
+                # previously a contributing member (role change mid-life),
+                # it leaves the epoch like any other departure.
+                g.observers[peer_name] = {
+                    "last_ping": time.monotonic(), "role": str(role),
+                }
+                if peer_name in g.members:
+                    del g.members[peer_name]
+                    g.needs_update = True
+                return {"sync_id": g.sync_id, "timeout": self._timeout}
+            g.observers.pop(peer_name, None)
             # Stateless restart safety: clients ignore epoch pushes that don't
             # EXCEED their current sync_id, so a freshly-restarted broker must
             # jump past any epoch still alive in the cohort. Wall-clock seeding
@@ -140,7 +160,15 @@ class Broker:
         already-drained event: remaining members should re-form now."""
         with self._lock:
             g = self._groups.get(group_name)
-            if g is None or peer_name not in g.members:
+            if g is None:
+                return {"left": False}
+            if peer_name in g.observers:
+                # Observer decommission: no epoch to bump, just deregister
+                # (so ``__broker_list`` stops advertising it immediately —
+                # the client-visible analogue of the member fast path).
+                del g.observers[peer_name]
+                return {"left": True, "sync_id": g.sync_id}
+            if peer_name not in g.members:
                 return {"left": False}
             del g.members[peer_name]
             pushes = self._bump_locked(g, time.monotonic())
@@ -148,6 +176,22 @@ class Broker:
         for push in pushes:
             self._push_to(*push)
         return {"left": True, "sync_id": sync_id}
+
+    def _on_list(self, group_name: str):
+        """Discovery for non-members (``serving.ServeClient``): the live
+        contributing roster (last epoch snapshot) plus the live observers
+        with their roles.  Observers are a LIVE view — they have no epoch,
+        and a client failing over wants the freshest liveness the broker
+        has, not a rate-limited snapshot."""
+        with self._lock:
+            g = self._groups.get(group_name)
+            if g is None:
+                return {"sync_id": None, "members": [], "observers": {}}
+            return {
+                "sync_id": g.sync_id,
+                "members": list(g.active_members),
+                "observers": {n: m["role"] for n, m in g.observers.items()},
+            }
 
     def _on_resync(self, group_name: str, peer_name: str):
         """A client whose sync_id went stale asks for the member list again."""
@@ -175,6 +219,13 @@ class Broker:
                 for name in evicted:
                     del g.members[name]
                     g.needs_update = True
+                # Observer eviction never bumps the epoch: replicas dying
+                # must not cancel the training cohort's in-flight rounds.
+                for name in [
+                    n for n, m in g.observers.items()
+                    if now - m["last_ping"] > self._timeout
+                ]:
+                    del g.observers[name]
                 # Rate-limit epoch bumps (reference: 2 s; we use 0.5 s so tests
                 # with churn settle fast).
                 if g.needs_update and now - g.last_update > 0.5:

@@ -153,6 +153,13 @@ def make_flags(argv=None):
                    help="persistent XLA compile cache directory (also "
                    "MOOLIB_COMPILE_CACHE): restarts skip recompilation "
                    "(docs/RESILIENCE.md recovery budget)")
+    p.add_argument("--publish_every", type=int, default=0,
+                   help="leader publishes host params as a new model "
+                   "version every N optimizer steps (0 = off): serving "
+                   "replicas subscribed to this peer hot-swap with zero "
+                   "downtime (moolib_tpu.serving.ModelPublisher)")
+    p.add_argument("--publish_channel", default="model",
+                   help="publisher endpoint prefix under --publish_every")
     return common.finalize_flags(p, argv)
 
 
@@ -472,6 +479,21 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
         acc.set_wire_dtype("int8")
     acc.connect(addr)
 
+    publisher = None
+    announced_version = [0]  # latest version the accumulator announced
+    if flags.publish_every:
+        from .. import serving as serving_mod
+
+        # The version-announcement hook drives the serving plane: every
+        # model-version advance (gradient apply, staged commit, restore)
+        # lands here; the loop snapshots+publishes at the step cadence.
+        publisher = serving_mod.ModelPublisher(
+            acc.rpc, name=flags.publish_channel
+        )
+        acc.add_model_version_callback(
+            lambda v: announced_version.__setitem__(0, v)
+        )
+
     jgrad = jax.jit(lambda p, t: jax.value_and_grad(loss_fn, has_aux=True)(p, t))
 
     def apply_fn(p, s, g):
@@ -552,6 +574,12 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                 steps_done += 1
                 steps_counter.inc()
                 wd.feed(progress_token)
+                if (publisher is not None and acc.is_leader()
+                        and announced_version[0]
+                        and steps_done % flags.publish_every == 0):
+                    publisher.publish(
+                        jax.device_get(params), version=announced_version[0]
+                    )
                 if not recovery_printed:
                     rec = acc.recovery_info()
                     if rec["complete"]:

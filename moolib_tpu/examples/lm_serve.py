@@ -12,6 +12,19 @@ Serve:  python -m moolib_tpu.examples.lm_serve --listen 127.0.0.1:4460
 Client: python -m moolib_tpu.examples.lm_serve --connect 127.0.0.1:4460 \\
             --prompts 3 (sends 3 concurrent prompts, prints continuations)
 
+The resilient tier (``moolib_tpu.serving``) layers on top: start N servers
+with ``--broker`` (each registers as a non-contributing cohort observer and
+subscribes to ``--publisher`` for zero-downtime weight hot-swap), and point
+clients at the broker instead of a replica — they discover the fleet,
+spread load, and retry idempotently across replica deaths:
+
+Broker:   python -m moolib_tpu.broker --address 127.0.0.1:4431
+Replica:  python -m moolib_tpu.examples.lm_serve --listen 127.0.0.1:4460 \\
+              --broker 127.0.0.1:4431 --name replica0 [--publisher pusher]
+Client:   python -m moolib_tpu.examples.lm_serve --broker 127.0.0.1:4431
+
+``--connect`` stays the single-shot, no-retry baseline against one server.
+
 Prompts in one batch must share a length (the queue stacks them); pad
 client-side for mixed lengths.
 """
@@ -26,8 +39,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..models.transformer import TransformerLM, generate
 from ..rpc import Rpc
+from ..serving import bucket as _bucket
+from ..serving import bucket_shapes as _bucket_shapes
+
+# Same registry object serving.py binds (registration is idempotent): the
+# legacy serve() loop and ServeService count batch retries into one metric.
+_M_BATCH_RETRY = telemetry.get_registry().counter(
+    "serve_batch_retries_total",
+    "failed batches retried unbatched (blast-radius isolation)",
+)
 
 
 def make_model(flags):
@@ -42,25 +65,6 @@ def make_model(flags):
         pos_embedding="rotary",
         max_len=flags.seq_len + flags.max_new_tokens,
     )
-
-
-def _bucket(n: int, cap: int) -> int:
-    """Next power-of-two >= n, capped: THE bucketing policy — the startup
-    warmup enumerates exactly these shapes, so a policy change here cannot
-    silently desync the two sites (a mid-traffic compile measured as 7
-    req/s with multi-second p50)."""
-    b = 1
-    while b < n:
-        b *= 2
-    return min(b, cap)
-
-
-def _bucket_shapes(cap: int) -> list:
-    shapes, b = [cap], 1
-    while b < cap:
-        shapes.append(b)
-        b *= 2
-    return shapes
 
 
 def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate",
@@ -91,7 +95,8 @@ def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate
     # Service-quality introspection for load benches: queue wait/fill/depth
     # counters plus the server's own iteration count (serve_bench diffs two
     # snapshots around its measurement window).
-    counters = {"served": 0, "iterations": 0, "bucket_pad_rows": 0}
+    counters = {"served": 0, "iterations": 0, "bucket_pad_rows": 0,
+                "batch_retries": 0}
     rpc.define(f"{name}_stats", lambda: {**queue.stats(), **counters,
                                          "batch_size": batch_size if dynamic_batching else 1})
     if mesh is not None:
@@ -134,8 +139,26 @@ def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate
                 batch = prompts
             try:
                 out = np.asarray(jgen(params, jnp.asarray(batch)))[:n]
-            except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
-                ret_cb.error(f"generate failed: {e}")
+            except Exception as e:  # noqa: BLE001 — fail small, keep serving
+                rets = getattr(ret_cb, "rets", None)
+                if rets is None:
+                    # Single caller: the failure is already its own.
+                    ret_cb.error(f"generate failed: {e}")
+                    continue
+                # Blast-radius isolation: one poisoned prompt must not error
+                # every caller stacked into its batch — retry once unbatched
+                # (row i belongs to caller i) so only the offender fails.
+                counters["batch_retries"] += 1
+                _M_BATCH_RETRY.inc()
+                for i, ret in enumerate(rets):
+                    try:
+                        row = np.asarray(
+                            jgen(params, jnp.asarray(prompts[i][None]))
+                        )[0]
+                    except Exception as e2:  # noqa: BLE001
+                        ret.error(f"generate failed: {e2}")
+                        continue
+                    ret(row)
                 continue
             ret_cb(out[0] if single else out)
         return iterations
@@ -146,7 +169,33 @@ def serve(rpc: Rpc, model, params, max_new_tokens: int, *, name: str = "generate
 def main(argv=None):
     p = argparse.ArgumentParser(description="batched LM generation over RPC")
     p.add_argument("--listen", default=None, help="serve on this address")
-    p.add_argument("--connect", default=None, help="request from this address")
+    p.add_argument("--connect", default=None,
+                   help="request from this address (single-shot, no-retry "
+                   "baseline against one server)")
+    p.add_argument("--broker", default=None,
+                   help="broker address: with --listen, register this "
+                   "server as a serving replica (non-contributing cohort "
+                   "observer, ServeClient-discoverable); without --listen, "
+                   "run the resilient client (replica discovery + retry + "
+                   "failover)")
+    p.add_argument("--broker_name", default="broker")
+    p.add_argument("--group", default="serve",
+                   help="broker group replicas register in / clients "
+                   "discover from")
+    p.add_argument("--name", default="lm_server",
+                   help="this server's peer name (replicas need unique "
+                   "names; --connect clients call this name)")
+    p.add_argument("--publisher", default=None,
+                   help="server: subscribe to this peer's ModelPublisher "
+                   "for zero-downtime weight hot-swap")
+    p.add_argument("--model_channel", default="model",
+                   help="publisher endpoint prefix under --publisher")
+    p.add_argument("--max_queue", type=int, default=128,
+                   help="replica admission-queue bound (requests beyond it "
+                   "are rejected immediately with a typed overload error)")
+    p.add_argument("--deadline_s", type=float, default=30.0,
+                   help="client per-request deadline budget (replicas "
+                   "reject requests that cannot meet it)")
     p.add_argument("--prompts", type=int, default=3, help="concurrent client prompts")
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--seq_len", type=int, default=16)
@@ -176,8 +225,10 @@ def main(argv=None):
         help="serve one call per iteration (latency baseline for serve_bench)",
     )
     flags = p.parse_args(argv)
-    if (flags.listen is None) == (flags.connect is None):
-        raise SystemExit("pass exactly one of --listen / --connect")
+    if flags.listen is None and (flags.connect is None) == (flags.broker is None):
+        raise SystemExit("pass --listen, --connect, or --broker (client mode)")
+    if flags.listen is not None and flags.connect is not None:
+        raise SystemExit("--listen and --connect are mutually exclusive")
     from ..utils import apply_platform_env
 
     apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
@@ -191,8 +242,9 @@ def main(argv=None):
         toks = jnp.asarray(rng.integers(0, flags.vocab, (1, flags.seq_len), dtype=np.int32))
         params = model.init(jax.random.key(flags.seed), toks)
         rpc = Rpc()
-        rpc.set_name("lm_server")
+        rpc.set_name(flags.name)
         rpc.listen(flags.listen)
+        replica = None
         try:
             # serve() defines the queue and pre-compiles every bucket shape
             # BEFORE the readiness line prints: clients arriving at
@@ -207,12 +259,43 @@ def main(argv=None):
                 f"[platform={jax.devices()[0].platform}]",
                 flush=True,
             )
-            loop = serve(
-                rpc, model, params, flags.max_new_tokens, mesh=mesh,
-                batch_size=flags.batch_size,
-                dynamic_batching=not flags.no_dynamic_batching,
-                warm_seq_len=flags.seq_len,
-            )
+            if flags.broker or flags.publisher:
+                # Resilient replica: admission control + request dedup +
+                # hot-swap staging (moolib_tpu.serving), with the same
+                # bucket policy and pre-compile contract as serve().
+                from .. import serving as serving_mod
+
+                jgen = jax.jit(
+                    lambda p_, prompts: generate(model, p_, prompts,
+                                                 flags.max_new_tokens)
+                )
+                shapes = (_bucket_shapes(flags.batch_size)
+                          if not flags.no_dynamic_batching else [1])
+                for b in shapes:
+                    np.asarray(jgen(params, jnp.zeros((b, flags.seq_len),
+                                                      jnp.int32)))
+                replica = serving_mod.ServeReplica(
+                    rpc,
+                    lambda p_, batch: np.asarray(jgen(p_, jnp.asarray(batch))),
+                    params,
+                    name="generate",
+                    batch_size=flags.batch_size,
+                    dynamic_batching=not flags.no_dynamic_batching,
+                    max_queue=flags.max_queue,
+                    broker=flags.broker,
+                    broker_name=flags.broker_name,
+                    group=flags.group,
+                    publisher=flags.publisher,
+                    model_channel=flags.model_channel,
+                )
+                loop = replica.loop()
+            else:
+                loop = serve(
+                    rpc, model, params, flags.max_new_tokens, mesh=mesh,
+                    batch_size=flags.batch_size,
+                    dynamic_batching=not flags.no_dynamic_batching,
+                    warm_seq_len=flags.seq_len,
+                )
             print(
                 f"serving 'generate' on {flags.listen} "
                 f"[platform={jax.devices()[0].platform}]",
@@ -220,20 +303,40 @@ def main(argv=None):
             )
             asyncio.run(loop)
         finally:
+            if replica is not None:
+                replica.close()
             rpc.close()
     else:
+        from .. import serving as serving_mod
+
         rpc = Rpc()
         rpc.set_name("lm_client")
-        rpc.set_timeout(60)
-        rpc.connect(flags.connect)
+        if flags.connect:
+            # Single-shot baseline: one static server, no retries, no
+            # metadata (works against the legacy serve() queue).
+            rpc.connect(flags.connect)
+            client = serving_mod.ServeClient(
+                rpc, fn="generate", replicas=[flags.name],
+                deadline_s=flags.deadline_s, max_attempts=1, metadata=False,
+            )
+        else:
+            # Resilient path: broker discovery, load spreading, idempotent
+            # retry with capped exponential backoff across replica deaths.
+            client = serving_mod.ServeClient(
+                rpc, fn="generate", broker=flags.broker,
+                broker_name=flags.broker_name, group=flags.group,
+                deadline_s=flags.deadline_s,
+            )
+            client.wait_for_replicas(1, timeout=flags.deadline_s)
         rng = np.random.default_rng(flags.seed + 1)
         futs = []
         for _ in range(flags.prompts):
             prompt = rng.integers(2, flags.vocab, flags.seq_len).astype(np.int32)
-            futs.append((prompt, rpc.async_("lm_server", "generate", prompt)))
+            futs.append((prompt, client.submit(prompt)))
         for prompt, fut in futs:
-            out = np.asarray(fut.result())
+            out = np.asarray(fut.result(flags.deadline_s + 5.0))
             print(f"prompt={prompt.tolist()}\n  -> {out[len(prompt):].tolist()}")
+        client.close()
         rpc.close()
 
 

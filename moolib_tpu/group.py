@@ -804,6 +804,7 @@ class Group:
         self._broker_name = "broker"
         self._timeout = 60.0
         self._sort_order = 0
+        self._role = "member"
         self._lock = threading.RLock()
         self._sync_id: Optional[int] = None
         self._members: List[str] = []
@@ -871,6 +872,20 @@ class Group:
 
     def set_sort_order(self, order: int) -> None:
         self._sort_order = int(order)
+
+    def set_role(self, role: str) -> None:
+        """Join the broker cohort as a NON-CONTRIBUTING member (any role
+        string other than ``"member"``, e.g. ``"replica"``): the broker
+        tracks this peer's liveness and advertises it via ``__broker_list``
+        (serving-plane discovery), but it never enters the membership epoch
+        — its joins, leaves, and deaths cannot bump ``sync_id`` or cancel
+        the contributing cohort's in-flight reductions.  Observers receive
+        no epoch pushes; ``active()`` stays False and ``all_reduce`` is not
+        available to them.  Set before the first ``update()``."""
+        self._role = str(role)
+
+    def role(self) -> str:
+        return self._role
 
     def members(self) -> List[str]:
         with self._lock:
@@ -1001,6 +1016,7 @@ class Group:
                 self._sort_order,
                 self._sync_id,
                 self._host_key,
+                self._role,
             )
         with self._lock:
             expired = [
@@ -1032,6 +1048,10 @@ class Group:
             utils.log_verbose("group %s: broker ping failed: %s", self._name, error)
             return
         remote_sync = result["sync_id"]
+        if self._role != "member":
+            # Observers are outside the epoch: the broker's sync_id is the
+            # contributing cohort's, not ours — never resync over it.
+            return
         with self._lock:
             stale = remote_sync != self._sync_id
             if not stale:

@@ -831,6 +831,10 @@ def _batch_calls(calls):
             ret.error(message)
 
     return_callback.error = error
+    # Per-caller returns, row-aligned with the stacked batch: consumers that
+    # need sub-batch blast-radius control (serving's unbatched retry of a
+    # poisoned batch) answer callers individually instead of failing all.
+    return_callback.rets = rets
     return (return_callback, batched_args, batched_kwargs)
 
 

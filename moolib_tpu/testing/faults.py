@@ -238,6 +238,31 @@ class FaultPlan:
         self._record("kill_process", pid, sig)
         os.kill(pid, sig)
 
+    # --------------------------------------------------------- serving plane
+    def replica_kill_time(self, window: float) -> float:
+        """When (seconds from start) to SIGKILL a serving replica, drawn
+        uniformly from the middle half of ``window`` on the ``serving``
+        stream — always *mid-stream*, never at the edges where the kill
+        degenerates into a clean pre-start or post-drain shutdown."""
+        t = round(window * (0.25 + 0.5 * self.rng("serving").random()), 3)
+        self._record("replica_kill_time", window, t)
+        return t
+
+    def replica_kill(self, procs: Sequence, index: Optional[int] = None,
+                     sig: int = signal.SIGKILL) -> int:
+        """SIGKILL one serving replica out of ``procs`` (picked on the
+        ``serving`` stream when ``index`` is None); returns the victim's
+        index.  The failover invariant this arms: every request a
+        :class:`~moolib_tpu.serving.ServeClient` has in flight on the victim
+        must still complete on a surviving replica — latency, not loss."""
+        if index is None:
+            index = self.rng("serving").randrange(len(procs))
+        index = int(index)
+        pid = getattr(procs[index], "pid", procs[index])
+        self._record("replica_kill", index, pid, sig)
+        os.kill(pid, sig)
+        return index
+
     # ------------------------------------------------------------ checkpoints
     def truncate_checkpoint(self, path: str, step: Optional[int] = None) -> Optional[str]:
         """Truncate the biggest payload file of a checkpoint to half its

@@ -114,6 +114,16 @@ step "autoscale soak (Poisson preemption: respawn SLO, sub-second graceful decom
 MOOLIB_COMPILE_CACHE="${TMPDIR:-/tmp}/moolib_ci_jax_cache" \
   python scripts/autoscale_soak.py --smoke --recovery_bound_s 90 || fail=1
 
+step "serving plane tests (hot swap mid-traffic, typed admission rejects, req-id dedup, failover)"
+python -m pytest tests/test_serving.py -q || fail=1
+
+step "serving soak (seeded, ~40 s smoke: replica SIGKILL mid-stream + live hot-swap under paced load)"
+# Exits non-zero on any lost request (a future that errored or never
+# resolved), a hot swap that failed to land / record serve_swap_seconds,
+# or any admission reject attributable to the swap
+# (docs/RESILIENCE.md "Serving soak").
+python scripts/serve_soak.py --smoke || fail=1
+
 step "sanitizer matrix (skips where the runtime is missing)"
 python -m pytest tests/test_native_sanitizers.py -q || fail=1
 

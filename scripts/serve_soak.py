@@ -1,0 +1,359 @@
+#!/usr/bin/env python
+"""Serving-plane soak: replica SIGKILL + live hot-swap under sustained load.
+
+Drives the resilient serving plane (``moolib_tpu/serving.py``;
+docs/RESILIENCE.md "Serving") end to end with real processes:
+
+1. **Formation**: this script hosts the Broker and an in-process
+   :class:`~moolib_tpu.serving.ModelPublisher` ("pusher"), then spawns two
+   ``moolib_tpu.examples.lm_serve`` replica subprocesses (``--broker`` +
+   ``--publisher``).  Both must print the two-stage readiness lines and be
+   discovered by a broker-polling :class:`~moolib_tpu.serving.ServeClient`.
+2. **Sustained load**: paced open-loop requests at a target QPS for the
+   whole window; every future is awaited, every outcome classified.
+3. **Replica SIGKILL mid-stream**: at a seeded time (middle half of the
+   window, :meth:`FaultPlan.replica_kill_time`), a seeded victim is
+   SIGKILLed (:meth:`FaultPlan.replica_kill`) — no drain, no leave.  The
+   gate is the plane's headline claim: **zero lost requests** — every
+   in-flight future completes on the survivor (latency, not loss).
+4. **Live hot-swap**: the pusher publishes a new model version while load
+   continues; the survivor must install it between service iterations
+   (``hot_swaps >= 1``, ``serve_swap_seconds`` recorded) and the swap must
+   cause **no admission rejects** (rejects delta over the swap window = 0).
+
+Exit 0 only when every gate holds; the JSON verdict goes to ``--out`` (the
+committed ``SOAK_r07_serve.json`` capture) or stdout.
+
+Usage::
+
+    python scripts/serve_soak.py --smoke                  # ~1 min CI profile
+    python scripts/serve_soak.py --seed 7 --out SOAK_r07_serve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+T0 = time.monotonic()
+
+
+def log(msg: str) -> None:
+    print(f"[serve_soak +{time.monotonic() - T0:6.1f}s] {msg}", flush=True)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def await_line(log_path: str, proc, marker: str, timeout: float) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(log_path) as f:
+                if marker in f.read():
+                    return
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"replica died before '{marker}': "
+                + open(log_path).read()[-2000:]
+            )
+        time.sleep(0.2)
+    raise RuntimeError(f"'{marker}' not seen within {timeout:.0f}s")
+
+
+def spawn_replica(name: str, port: int, broker_addr: str, flags) -> tuple:
+    env = dict(
+        os.environ,
+        PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+    )
+    cmd = [
+        sys.executable, "-m", "moolib_tpu.examples.lm_serve",
+        "--listen", f"127.0.0.1:{port}",
+        "--broker", broker_addr,
+        "--name", name,
+        "--publisher", "pusher",
+        "--vocab", str(flags.vocab),
+        "--seq_len", str(flags.seq_len),
+        "--d_model", str(flags.d_model),
+        "--layers", str(flags.layers),
+        "--heads", str(flags.heads),
+        "--batch_size", str(flags.batch_size),
+        "--max_new_tokens", str(flags.max_new_tokens),
+        "--max_queue", str(flags.max_queue),
+        "--seed", str(flags.seed),
+    ]
+    log_path = f"/tmp/serve_soak_{name}.log"
+    with open(log_path, "w") as lf:
+        proc = subprocess.Popen(cmd, stdout=lf, stderr=subprocess.STDOUT,
+                                text=True, env=env, cwd=ROOT,
+                                start_new_session=True)
+    return proc, log_path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI profile: short window, small load")
+    ap.add_argument("--window_s", type=float, default=None,
+                    help="load window (default 20 smoke / 60 full)")
+    ap.add_argument("--qps", type=float, default=None,
+                    help="offered load (default 30 smoke / 50 full)")
+    ap.add_argument("--deadline_s", type=float, default=15.0)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--seq_len", type=int, default=8)
+    ap.add_argument("--d_model", type=int, default=32)
+    ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=8)
+    ap.add_argument("--max_new_tokens", type=int, default=4)
+    ap.add_argument("--max_queue", type=int, default=256)
+    ap.add_argument("--ready_timeout", type=float, default=300.0)
+    ap.add_argument("--out", default=None, help="write the JSON verdict here")
+    flags = ap.parse_args(argv)
+    if flags.window_s is None:
+        flags.window_s = 20.0 if flags.smoke else 60.0
+    if flags.qps is None:
+        flags.qps = 30.0 if flags.smoke else 50.0
+
+    import numpy as np
+
+    from moolib_tpu import Broker, Rpc
+    from moolib_tpu.serving import ModelPublisher, ServeClient, is_overload_error
+    from moolib_tpu.testing.faults import FaultPlan
+
+    # The payload a hot-swap installs must be REAL weights for the replicas'
+    # model geometry — the plane will faithfully install whatever the
+    # publisher announces, and a garbage pytree turns every later request
+    # into a step_fn error.  Build the same model the replicas build (same
+    # flags, same seed) and perturb it so the swap is observable.
+    import jax
+    import jax.numpy as jnp
+
+    from moolib_tpu.examples.lm_serve import make_model
+    from moolib_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+    model = make_model(flags)
+    rng0 = np.random.default_rng(flags.seed)
+    toks = jnp.asarray(
+        rng0.integers(0, flags.vocab, (1, flags.seq_len), dtype=np.int32)
+    )
+    base_params = model.init(jax.random.key(flags.seed), toks)
+    swap_params = jax.device_get(
+        jax.tree.map(lambda x: x * (1.0 + 1e-3), base_params)
+    )
+
+    plan = FaultPlan(flags.seed)
+    kill_t = plan.replica_kill_time(flags.window_s)
+    swap_t = round(flags.window_s * 0.8, 3)
+    log(f"seed={flags.seed} window={flags.window_s}s qps={flags.qps} "
+        f"kill@{kill_t}s swap@{swap_t}s")
+
+    broker_addr = f"127.0.0.1:{free_port()}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(broker_addr)
+    stop_pump = threading.Event()
+
+    def pump():
+        while not stop_pump.is_set():
+            broker.update()
+            stop_pump.wait(0.05)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    pusher_rpc = Rpc()
+    pusher_rpc.set_name("pusher")
+    pusher_rpc.listen("127.0.0.1:0")
+    pusher_rpc.connect(broker_addr)
+    pusher = ModelPublisher(pusher_rpc, name="model")
+
+    replicas = [
+        spawn_replica("rep0", free_port(), broker_addr, flags),
+        spawn_replica("rep1", free_port(), broker_addr, flags),
+    ]
+    result = {
+        "soak": "serve", "seed": flags.seed, "smoke": flags.smoke,
+        "window_s": flags.window_s, "qps": flags.qps,
+        "replicas": 2, "plan_actions": [],
+    }
+    client = None
+    try:
+        for (proc, lp), name in zip(replicas, ("rep0", "rep1")):
+            await_line(lp, proc, "serving", flags.ready_timeout)
+            log(f"{name} serving")
+        client = ServeClient(broker=broker_addr, deadline_s=flags.deadline_s,
+                             attempt_timeout=1.0, max_attempts=8)
+        client.wait_for_replicas(2, timeout=30.0)
+        log(f"discovered replicas: {client.replicas()}")
+
+        rng = np.random.default_rng(flags.seed)
+        warm = rng.integers(2, flags.vocab, flags.seq_len).astype(np.int32)
+        client.call(warm)
+
+        latencies: list = []
+        outcomes = {"ok": 0, "reject": 0, "deadline": 0, "error": 0}
+        error_samples: list = []
+        lock = threading.Lock()
+        pending = []
+
+        def on_done(fut, t0):
+            dt = time.monotonic() - t0
+            exc = fut.exception()
+            with lock:
+                if exc is None:
+                    outcomes["ok"] += 1
+                    latencies.append(dt)
+                elif is_overload_error(exc):
+                    outcomes["reject"] += 1
+                elif "deadline" in str(exc).lower():
+                    outcomes["deadline"] += 1
+                else:
+                    outcomes["error"] += 1
+                    if len(error_samples) < 5:
+                        error_samples.append(str(exc)[:300])
+
+        # One seeded schedule, three actors: paced arrivals, the SIGKILL,
+        # and the publish all run off the same monotonic clock.
+        interval = 1.0 / flags.qps
+        n = max(1, int(flags.window_s * flags.qps))
+        killed = None
+        swap = {"published": False, "rejects_before": None, "version": 2}
+        survivor = None
+        t_start = time.monotonic()
+        for i in range(n):
+            target = t_start + i * interval
+            now = time.monotonic()
+            if now < target:
+                time.sleep(target - now)
+            t_rel = time.monotonic() - t_start
+            if killed is None and t_rel >= kill_t:
+                victim = plan.replica_kill([p for p, _lp in replicas])
+                survivor = ("rep0", "rep1")[1 - victim]
+                killed = {"victim": f"rep{victim}", "t": round(t_rel, 3),
+                          "pid": replicas[victim][0].pid}
+                log(f"SIGKILLed rep{victim} (pid {killed['pid']}) "
+                    f"at +{t_rel:.1f}s; survivor={survivor}")
+            if not swap["published"] and t_rel >= swap_t:
+                stats = pusher_rpc.sync(survivor or "rep0", "generate_stats")
+                swap["rejects_before"] = stats["admission_rejects"]
+                pusher.publish(swap_params, version=swap["version"])
+                swap["published"] = True
+                log(f"published model version {swap['version']} at +{t_rel:.1f}s")
+            p = rng.integers(2, flags.vocab, flags.seq_len).astype(np.int32)
+            t0 = time.monotonic()
+            fut = client.submit(p)
+            fut.add_done_callback(lambda f, t0=t0: on_done(f, t0))
+            pending.append(fut)
+        log(f"offered {n} requests; awaiting completions")
+        unfinished = 0
+        for fut in pending:
+            try:
+                fut.result(flags.deadline_s + 10.0)
+            except TimeoutError:
+                unfinished += 1  # a future that never resolved = lost
+            except Exception:  # noqa: BLE001 — classified in on_done
+                pass
+
+        # Survivor's post-swap accounting: the swap must have landed, with
+        # its duration recorded, and caused no admission rejects.
+        deadline = time.monotonic() + 20.0
+        st = None
+        while time.monotonic() < deadline:
+            st = pusher_rpc.sync(survivor or "rep1", "generate_stats")
+            if st["model_version"] == swap["version"]:
+                break
+            time.sleep(0.25)
+        lat = sorted(latencies)
+        lost = outcomes["deadline"] + outcomes["error"] + unfinished
+        result.update(
+            requests=n,
+            ok=outcomes["ok"],
+            rejects=outcomes["reject"],
+            deadline_errors=outcomes["deadline"],
+            errors=outcomes["error"],
+            unfinished_futures=unfinished,
+            lost_requests=lost,
+            error_samples=error_samples,
+            p50_ms=round(lat[len(lat) // 2] * 1e3, 1) if lat else None,
+            p99_ms=round(lat[int(len(lat) * 0.99)] * 1e3, 1) if lat else None,
+            kill=killed,
+            survivor=survivor,
+            swap={
+                "version": swap["version"],
+                "hot_swaps": st["hot_swaps"],
+                "serve_swap_seconds": st["last_swap_seconds"],
+                "rejects_during_swap":
+                    st["admission_rejects"] - (swap["rejects_before"] or 0),
+            },
+            client_stats=client.stats(),
+            plan_actions=[list(a) for a in plan.actions],
+        )
+        gates = {
+            "zero_lost_requests": lost == 0,
+            "all_futures_completed": unfinished == 0,
+            "replica_killed_mid_stream": killed is not None,
+            "hot_swap_completed": st["model_version"] == swap["version"]
+                                  and st["hot_swaps"] >= 1,
+            "swap_seconds_recorded": st["last_swap_seconds"] is not None,
+            "no_swap_rejects":
+                st["admission_rejects"] - (swap["rejects_before"] or 0) == 0,
+        }
+        result["gates"] = gates
+        result["pass"] = all(gates.values())
+    except Exception as e:  # noqa: BLE001 — the verdict must always be written
+        log(f"FAILED: {e}")
+        result["pass"] = False
+        result["failure"] = str(e)
+    finally:
+        if client is not None:
+            client.close()
+        pusher.close()
+        pusher_rpc.close()
+        stop_pump.set()
+        broker.close()
+        for proc, lp in replicas:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                proc.kill()
+            proc.wait()
+            try:
+                os.unlink(lp)
+            except OSError:
+                pass
+
+    payload = json.dumps(result, indent=1)
+    if flags.out:
+        with open(flags.out, "w") as f:
+            f.write(payload + "\n")
+        log(f"verdict -> {flags.out}")
+    print(payload)
+    if result.get("pass"):
+        log("PASS: zero lost requests, failover + hot-swap held under load")
+        return 0
+    log("FAIL")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -446,8 +446,20 @@ class Accumulator:
             raise RpcError(f"accumulator {self._name!r} already exists on this Rpc")
         registry[self._name] = self
 
-    def connect(self, address: str) -> None:
-        """Connect to the broker coordinating this cohort."""
+    def connect(self, address) -> None:
+        """Connect to the broker coordinating this cohort.  A list (or
+        comma-separated string) of addresses enables broker failover: the
+        group dials every broker and re-targets its pings to the
+        highest-generation survivor when the primary dies
+        (``Group.set_brokers``, docs/RESILIENCE.md "Broker failover")."""
+        if isinstance(address, str) and "," in address:
+            address = [a.strip() for a in address.split(",") if a.strip()]
+        if isinstance(address, (list, tuple)):
+            if len(address) == 1:
+                self._rpc.connect(address[0])
+            else:
+                self._group.set_brokers(list(address))
+            return
         self._rpc.connect(address)
 
     def listen(self, address: str = "127.0.0.1:0") -> None:

@@ -422,7 +422,10 @@ def example_spawn(connect_addr: str, base_dir: str, module: str,
                   extra_args: Sequence[str] = ()) -> Callable[[str, str], subprocess.Popen]:
     """A ``SubprocessFleet`` spawn callable that launches one of the example
     trainers as a worker peer (the examples' ``--autoscale`` mode and the
-    soak both use this shape)."""
+    soak both use this shape).  ``connect_addr`` may be comma-separated —
+    the full broker list — in which case workers get ``--broker_addrs`` and
+    survive a broker failover mid-fleet."""
+    connect_flag = "--broker_addrs" if "," in connect_addr else "--connect"
 
     def spawn(name: str, localdir: str) -> subprocess.Popen:
         env = dict(os.environ)
@@ -431,7 +434,7 @@ def example_spawn(connect_addr: str, base_dir: str, module: str,
         env.setdefault("JAX_PLATFORMS", "cpu")
         cmd = [
             sys.executable, "-m", module,
-            "--connect", connect_addr,
+            connect_flag, connect_addr,
             "--local_name", name,
             "--localdir", localdir,
             *extra_args,

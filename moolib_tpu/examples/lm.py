@@ -117,6 +117,13 @@ def make_flags(argv=None):
                    help="host an in-process broker here and join it")
     p.add_argument("--connect", default=None,
                    help="join an existing broker (elastic DP cohort)")
+    p.add_argument("--broker_addrs", default=None,
+                   help="comma-separated broker addresses (primary + hot "
+                   "standbys, docs/RESILIENCE.md 'Broker failover'): with "
+                   "--address the others become replication peers of the "
+                   "hosted broker; without it, join with failover across "
+                   "the list (like --connect, which stays the single-"
+                   "address alias)")
     p.add_argument("--local_name", default=None,
                    help="peer name in the cohort (default: lm_<pid>)")
     p.add_argument("--virtual_batch_size", type=int, default=0,
@@ -184,7 +191,7 @@ def train(flags, on_stats=None) -> dict:
     _faults.install_from_env()  # opt-in chaos (MOOLIB_FAULTS; no-op unset)
     if flags.seq_len % 2:
         raise ValueError("--seq_len must be even")
-    if flags.address or flags.connect:
+    if flags.address or flags.connect or getattr(flags, "broker_addrs", None):
         # Elastic DP rides the plain single-device step: drop the PARSER
         # DEFAULTS that only make sense in-mesh so `--connect HOST` works
         # as documented; an explicitly-requested mesh is a real conflict.
@@ -321,7 +328,7 @@ def train(flags, on_stats=None) -> dict:
             if not flags.quiet:
                 print(f"resumed from checkpoint step {start_step}", flush=True)
 
-    if flags.address or flags.connect:
+    if flags.address or flags.connect or getattr(flags, "broker_addrs", None):
         return _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
                               on_stats=on_stats, ckpt=ckpt, start_step=start_step)
 
@@ -419,12 +426,26 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
 
     from .. import Accumulator, Broker
 
+    # HA broker list: --broker_addrs joins (and, when hosting, replicates to)
+    # the whole primary+standby set; --connect stays the single-address alias.
+    broker_list = [a.strip() for a in
+                   (getattr(flags, "broker_addrs", None) or "").split(",")
+                   if a.strip()]
+    if flags.address and broker_list and flags.address not in broker_list:
+        broker_list = [flags.address] + broker_list
     broker = None
     if flags.address:
         broker = Broker()
         broker.set_name("broker")
         broker.listen(flags.address)
-    addr = flags.connect or flags.address
+        standbys = [a for a in broker_list if a != flags.address]
+        if standbys:
+            broker.set_peer_brokers(standbys)
+    # A comma-joined addr flows through unchanged: Accumulator.connect
+    # splits it into the failover list, and the autoscaler's example_spawn
+    # re-emits it as --broker_addrs for supervised workers.
+    addr = (",".join(broker_list) if broker_list
+            else (flags.connect or flags.address))
 
     # Elastic fleet supervision (ROADMAP item 4): the broker-hosting peer
     # can autoscale lm worker subprocesses into this cohort.

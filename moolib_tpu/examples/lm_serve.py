@@ -25,6 +25,12 @@ Client:   python -m moolib_tpu.examples.lm_serve --broker 127.0.0.1:4431
 
 ``--connect`` stays the single-shot, no-retry baseline against one server.
 
+With a replicated broker control plane (a primary plus hot standbys, see
+docs/RESILIENCE.md "Broker failover"), pass the whole list instead —
+replicas and clients ping the primary and fail over on its death:
+
+    --broker_addrs 127.0.0.1:4431,127.0.0.1:4432
+
 Prompts in one batch must share a length (the queue stacks them); pad
 client-side for mixed lengths.
 """
@@ -178,6 +184,12 @@ def main(argv=None):
                    "observer, ServeClient-discoverable); without --listen, "
                    "run the resilient client (replica discovery + retry + "
                    "failover)")
+    p.add_argument("--broker_addrs", default=None,
+                   help="comma-separated broker addresses (primary + hot "
+                   "standbys, docs/RESILIENCE.md 'Broker failover'): like "
+                   "--broker but replicas and clients fail over across the "
+                   "list on primary death; supersedes --broker when both "
+                   "are given")
     p.add_argument("--broker_name", default="broker")
     p.add_argument("--group", default="serve",
                    help="broker group replicas register in / clients "
@@ -225,8 +237,15 @@ def main(argv=None):
         help="serve one call per iteration (latency baseline for serve_bench)",
     )
     flags = p.parse_args(argv)
-    if flags.listen is None and (flags.connect is None) == (flags.broker is None):
-        raise SystemExit("pass --listen, --connect, or --broker (client mode)")
+    # One broker list everywhere below: --broker_addrs (HA) wins, --broker
+    # stays as the single-address alias.
+    broker_list = [a.strip() for a in (flags.broker_addrs or "").split(",")
+                   if a.strip()]
+    if not broker_list and flags.broker:
+        broker_list = [flags.broker]
+    if flags.listen is None and (flags.connect is None) == (not broker_list):
+        raise SystemExit(
+            "pass --listen, --connect, or --broker/--broker_addrs (client mode)")
     if flags.listen is not None and flags.connect is not None:
         raise SystemExit("--listen and --connect are mutually exclusive")
     from ..utils import apply_platform_env
@@ -259,7 +278,7 @@ def main(argv=None):
                 f"[platform={jax.devices()[0].platform}]",
                 flush=True,
             )
-            if flags.broker or flags.publisher:
+            if broker_list or flags.publisher:
                 # Resilient replica: admission control + request dedup +
                 # hot-swap staging (moolib_tpu.serving), with the same
                 # bucket policy and pre-compile contract as serve().
@@ -282,7 +301,8 @@ def main(argv=None):
                     batch_size=flags.batch_size,
                     dynamic_batching=not flags.no_dynamic_batching,
                     max_queue=flags.max_queue,
-                    broker=flags.broker,
+                    broker=broker_list[0] if broker_list else None,
+                    brokers=broker_list[1:],
                     broker_name=flags.broker_name,
                     group=flags.group,
                     publisher=flags.publisher,
@@ -323,7 +343,8 @@ def main(argv=None):
             # Resilient path: broker discovery, load spreading, idempotent
             # retry with capped exponential backoff across replica deaths.
             client = serving_mod.ServeClient(
-                rpc, fn="generate", broker=flags.broker,
+                rpc, fn="generate", broker=broker_list[0],
+                brokers=broker_list[1:],
                 broker_name=flags.broker_name, group=flags.group,
                 deadline_s=flags.deadline_s,
             )

@@ -67,6 +67,13 @@ def make_flags(argv=None):
     p.add_argument("--use_lstm", action="store_true")
     p.add_argument("--address", default="127.0.0.1:4431")
     p.add_argument("--connect", default=None, help="external broker address")
+    p.add_argument(
+        "--broker_addrs", default=None,
+        help="comma-separated broker addresses (primary + hot standbys, "
+        "docs/RESILIENCE.md 'Broker failover'): when the list contains "
+        "--address this peer hosts the primary and replicates to the "
+        "others; otherwise it joins with failover across the list "
+        "(--connect stays the single-address alias)")
     p.add_argument("--local_name", default=None)
     p.add_argument("--train_id", default="impala")
     p.add_argument("--checkpoint", default=None)
@@ -528,13 +535,23 @@ def train(flags, on_stats=None) -> dict:
 
     # --- cohort wiring ---------------------------------------------------
     broker: Optional[Broker] = None
-    if flags.connect is None:
+    broker_list = [a.strip() for a in (flags.broker_addrs or "").split(",")
+                   if a.strip()]
+    # Host when no external broker was named: --connect, or a --broker_addrs
+    # list that does NOT include our own --address, means join-only.
+    hosting = flags.connect is None and (
+        not broker_list or flags.address in broker_list)
+    if hosting:
         broker = Broker()
         broker.set_name("broker")
         broker.listen(flags.address)
-        broker_addr = flags.address
-    else:
-        broker_addr = flags.connect
+        standbys = [a for a in broker_list if a != flags.address]
+        if standbys:
+            broker.set_peer_brokers(standbys)
+    connect_addrs = broker_list or [flags.connect or flags.address]
+    # Comma-joined for the autoscaler: example_spawn re-emits a multi-address
+    # plane as --broker_addrs so supervised workers inherit the failover list.
+    broker_addr = ",".join(connect_addrs)
 
     # Elastic fleet supervision (ROADMAP item 4): the broker-hosting peer can
     # run the telemetry-driven autoscaler, spawning/decommissioning worker
@@ -564,7 +581,7 @@ def train(flags, on_stats=None) -> dict:
             ),
             autoscaler_mod.SubprocessFleet(
                 autoscaler_mod.example_spawn(
-                    flags.address, fleet_dir,
+                    broker_addr, fleet_dir,
                     "moolib_tpu.examples.vtrace.experiment", worker_args,
                 ),
                 fleet_dir,
@@ -575,8 +592,11 @@ def train(flags, on_stats=None) -> dict:
     rpc = Rpc()
     rpc.set_name(flags.local_name or f"impala-{os.getpid()}")
     rpc.listen("127.0.0.1:0")
-    rpc.connect(broker_addr)
+    for a in connect_addrs:
+        rpc.connect(a)
     rpc_group = Group(rpc, name=flags.train_id)
+    if len(connect_addrs) > 1:
+        rpc_group.set_brokers(connect_addrs)
     accumulator = Accumulator(
         "model", params, buffers=None, group=rpc_group
     )

@@ -28,10 +28,21 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import buckets, utils
+from . import buckets, telemetry, utils
+from .telemetry.recovery import observe_phase
 from .utils import nest
 from .rpc import Future, Rpc, RpcError
 from .rpc.core import adopt_current_frame
+
+_REG = telemetry.get_registry()
+_M_FAILOVERS = _REG.counter(
+    "group_broker_failovers_total",
+    "Broker failover scans this peer started (ping silence or standby reply)",
+)
+_M_STALE_PUSHES = _REG.counter(
+    "group_stale_pushes_total",
+    "Epoch pushes rejected by the peer-side generation fence (zombie ex-primary)",
+)
 
 _OPS: Dict[str, Callable] = {
     "sum": lambda a, b: a + b,
@@ -811,8 +822,21 @@ class Group:
         self._last_ping = 0.0
         self._ping_interval = 1.0
         self._ping_inflight = False
+        # Ping cycle counter: bumped whenever an in-flight ping is abandoned
+        # (overdue, or the failover scan retargeted the broker) so the late
+        # reply from a dead/demoted broker can't clobber newer state.
+        self._ping_seq = 0
+        self._ping_fail_since: Optional[float] = None
         self._left = False
         self._stale_since: Optional[float] = None
+        # --- broker high availability (multi-address control plane) ------
+        # Addresses of every broker (primary + hot standbys).  Empty keeps
+        # the legacy single-name mode: ping whatever set_broker_name said.
+        self._broker_addrs: List[str] = []
+        self._broker_resolved = False  # _broker_name learned from an address
+        self._broker_gen = 0  # highest generation fence seen (0 = unfenced)
+        self._broker_fail_after = 5.0  # ping silence before a failover scan
+        self._failover: Optional[dict] = None  # in-flight scan state
         self._ops: Dict[Tuple, Any] = {}  # key -> _Op | _RingOp
         self._parked: Dict[Tuple, List[Any]] = {}
         self._ring_parked: Dict[Tuple, List[Tuple]] = {}
@@ -866,6 +890,26 @@ class Group:
     # ------------------------------------------------------------------- api
     def set_broker_name(self, name: str) -> None:
         self._broker_name = name
+
+    def set_brokers(self, addresses: List[str]) -> None:
+        """Give this group the full broker control plane: the ADDRESSES of
+        the primary and every hot standby (docs/RESILIENCE.md "Broker
+        failover").  The Rpc dials and keeps a connection to each; the
+        greeting resolves each address to the broker's rpc NAME (calls
+        route by name).  Pings go to the current primary; when they go
+        silent past ``set_broker_fail_after`` — or the broker answers as a
+        demoted standby — the group scans ``__broker_status`` across the
+        list and re-targets the highest-generation broker, recorded as a
+        ``recovery_seconds{phase="broker_failover"}`` span."""
+        self._broker_addrs = [a for a in addresses if a]
+        self._broker_resolved = False
+        for a in self._broker_addrs:
+            self._rpc.connect(a)
+
+    def set_broker_fail_after(self, seconds: float) -> None:
+        """Ping silence (seconds) on the current broker before the failover
+        scan starts.  Also bounds how long one unanswered ping is trusted."""
+        self._broker_fail_after = float(seconds)
 
     def set_timeout(self, seconds: float) -> None:
         self._timeout = float(seconds)
@@ -1003,20 +1047,24 @@ class Group:
         (``src/group.h:394-490``); call it regularly from the train loop.
         """
         now = time.monotonic()
+        if self._broker_addrs and not self._left:
+            self._broker_maintenance(now)
         if (now - self._last_ping >= self._ping_interval and not self._ping_inflight
                 and not self._left):
             self._last_ping = now
             self._ping_inflight = True
+            seq = self._ping_seq
             self._rpc.async_callback(
                 self._broker_name,
                 "__broker_ping",
-                self._on_ping_reply,
+                lambda result, error: self._on_ping_reply(result, error, seq),
                 self._name,
                 self._rpc.get_name(),
                 self._sort_order,
                 self._sync_id,
                 self._host_key,
                 self._role,
+                self._broker_gen,
             )
         with self._lock:
             expired = [
@@ -1042,24 +1090,144 @@ class Group:
         for op in expired:
             op.future.set_exception(RpcError(f"allreduce {op.key} timed out"))
 
-    def _on_ping_reply(self, result, error):
-        self._ping_inflight = False
-        if error is not None:
-            utils.log_verbose("group %s: broker ping failed: %s", self._name, error)
-            return
-        remote_sync = result["sync_id"]
-        if self._role != "member":
-            # Observers are outside the epoch: the broker's sync_id is the
-            # contributing cohort's, not ours — never resync over it.
+    # -------------------------------------------------------- broker failover
+    def _broker_maintenance(self, now: float) -> None:
+        """Multi-broker upkeep (``set_brokers`` mode): resolve the broker's
+        rpc name from the address list, abandon overdue pings, start and
+        pump the failover scan.  Called from ``update()``."""
+        sends: List[str] = []
+        fo_ref: Optional[dict] = None
+        with self._lock:
+            if not self._broker_resolved and self._failover is None:
+                for a in self._broker_addrs:
+                    name = self._rpc.peer_name_at(a)
+                    if name is not None:
+                        # First address to greet is the presumed primary; a
+                        # standby reply to the first ping corrects a wrong
+                        # first guess via the failover scan.
+                        self._broker_name = name
+                        self._broker_resolved = True
+                        break
+            # An unanswered ping blocks the ping loop (and the rpc-level
+            # timeout can be much longer than the failover budget): past the
+            # failure window stop trusting it — the late reply, if it ever
+            # lands, is ignored by the seq guard.
+            if (self._ping_inflight
+                    and now - self._last_ping
+                    > max(self._ping_interval, self._broker_fail_after)):
+                self._ping_inflight = False
+                self._ping_seq += 1
+                if self._ping_fail_since is None:
+                    self._ping_fail_since = self._last_ping
+            if (self._failover is None and self._ping_fail_since is not None
+                    and now - self._ping_fail_since > self._broker_fail_after):
+                self._start_failover_locked(now, "ping silence")
+            fo = self._failover
+            if fo is not None and fo.get("target") is None:
+                fo_ref = fo
+                for a in self._broker_addrs:
+                    name = self._rpc.peer_name_at(a)
+                    if name is None:
+                        continue  # never greeted (down or still dialing)
+                    if now - fo["asked"].get(name, -1e9) < 1.0:
+                        continue
+                    fo["asked"][name] = now
+                    sends.append(name)
+                replies = fo["replies"]
+                if replies and (len(replies) >= len(fo["asked"])
+                                or now - fo["t0"] >= 0.5):
+                    # Highest generation wins; primaries beat standbys at the
+                    # same generation (a fresh low-generation primary must
+                    # lose to the fenced standby that outlived it); the name
+                    # breaks exact ties deterministically.
+                    gen, _primary, target = max(replies.values())
+                    fo["target"] = target
+                    self._broker_name = target
+                    self._broker_resolved = True
+                    self._broker_gen = max(self._broker_gen, gen)
+                    self._ping_seq += 1
+                    self._ping_inflight = False
+                    self._last_ping = 0.0  # ping the new broker immediately
+                    self._ping_fail_since = None
+                    utils.log_info(
+                        "group %s: failing over to broker %r (generation %d)",
+                        self._name, target, gen,
+                    )
+        for name in sends:
+            self._rpc.async_callback(
+                name, "__broker_status",
+                lambda result, error, name=name, fo=fo_ref:
+                    self._on_status_reply(name, fo, result, error),
+            )
+
+    def _start_failover_locked(self, now: float, why: str) -> None:
+        self._failover = {"t0": now, "asked": {}, "replies": {}, "target": None}
+        _M_FAILOVERS.inc()
+        utils.log_info(
+            "group %s: broker %r unresponsive (%s) — scanning %d broker address(es)",
+            self._name, self._broker_name, why, len(self._broker_addrs),
+        )
+
+    def _on_status_reply(self, name: str, fo: dict, result, error) -> None:
+        if error is not None or not isinstance(result, dict):
             return
         with self._lock:
+            if self._failover is not fo or fo.get("target") is not None:
+                return  # a newer scan owns the state, or this one concluded
+            fo["replies"][name] = (
+                int(result.get("generation", 0)),
+                bool(result.get("primary", False)),
+                name,
+            )
+
+    def _on_ping_reply(self, result, error, seq: Optional[int] = None):
+        now = time.monotonic()
+        with self._lock:
+            if seq is not None and seq != self._ping_seq:
+                return  # abandoned cycle (overdue ping, or broker retargeted)
+            self._ping_inflight = False
+            if error is not None:
+                if self._ping_fail_since is None:
+                    self._ping_fail_since = now
+                utils.log_verbose("group %s: broker ping failed: %s", self._name, error)
+                return
+            self._ping_fail_since = None
+            if isinstance(result, dict):
+                gen = result.get("generation")
+                if gen is not None and int(gen) > self._broker_gen:
+                    self._broker_gen = int(gen)
+                if result.get("standby"):
+                    # The broker we ping was demoted (or never promoted): it
+                    # cannot serve epochs.  Don't wait for ping silence.
+                    if self._broker_addrs and self._failover is None:
+                        self._start_failover_locked(now, "standby reply")
+                    return
+            fo = self._failover
+            if fo is not None and fo.get("target") == self._broker_name:
+                # First successful ping against the newly-picked primary:
+                # the control plane is back for this peer.
+                self._failover = None
+                dt = now - fo["t0"]
+                observe_phase("broker_failover", dt)
+                utils.log_info(
+                    "group %s: broker failover complete: %r gen=%d in %.2fs",
+                    self._name, self._broker_name, self._broker_gen, dt,
+                )
+            elif fo is not None and fo.get("target") is None:
+                # The broker answered as a primary mid-scan: it recovered
+                # (or was a false alarm) — stand down the scan.
+                self._failover = None
+            remote_sync = result["sync_id"]
+            if self._role != "member":
+                # Observers are outside the epoch: the broker's sync_id is the
+                # contributing cohort's, not ours — never resync over it.
+                return
             stale = remote_sync != self._sync_id
             if not stale:
                 self._stale_since = None
                 return
             # The broker pushes updates on change; if we stay stale for more
             # than a couple of pings we likely missed the push — ask again.
-            now = time.monotonic()
             if self._stale_since is None:
                 self._stale_since = now
                 return
@@ -1075,8 +1243,27 @@ class Group:
             )
 
     # ------------------------------------------------------------ membership
-    def _on_update(self, sync_id: int, members: List[str], hosts=None):
+    def _on_update(self, sync_id: int, members: List[str], hosts=None,
+                   generation=None):
         with self._lock:
+            if generation is not None:
+                generation = int(generation)
+                if generation < self._broker_gen:
+                    # Generation fence: a zombie ex-primary (wedged process,
+                    # healed partition) pushing epochs it has no right to
+                    # mint.  Its sync_ids may even be higher than the real
+                    # primary's — the fence, not the epoch number, is what
+                    # rejects it (the real primary outruns those sync_ids on
+                    # our next ping via the broker's sync_id repair).
+                    _M_STALE_PUSHES.inc()
+                    utils.log_verbose(
+                        "group %s: rejecting push from fenced broker "
+                        "(generation %d < %d)",
+                        self._name, generation, self._broker_gen,
+                    )
+                    return None
+                if generation > self._broker_gen:
+                    self._broker_gen = generation
             if self._sync_id is not None and sync_id <= self._sync_id:
                 return None  # stale push
             self._sync_id = sync_id

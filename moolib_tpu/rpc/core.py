@@ -339,6 +339,7 @@ class _Connection:
         "transport",
         "reader",
         "writer",
+        "rpc",
         "peer_name",
         "peer_uid",
         "send_count",
@@ -379,6 +380,11 @@ class _Connection:
         _M_CONNECTS.inc(
             transport=transport, direction="inbound" if inbound else "outbound"
         )
+        # Owning Rpc (set at dial/accept).  Gives the ``send_frame`` fault
+        # seam the SENDER's identity, so a simulated network partition
+        # (testing.faults.Partition) can drop frames by (sender, receiver)
+        # pair even with many Rpcs in one process.
+        self.rpc = None
         self.peer_name: Optional[str] = None
         self.peer_uid: Optional[str] = None
         self.send_count = 0
@@ -447,7 +453,7 @@ class _NativeConnection(_Connection):
     and arrive via engine callbacks instead of an asyncio read loop.
     """
 
-    __slots__ = ("net", "conn_id", "rpc", "rx_seen", "tx_seen")
+    __slots__ = ("net", "conn_id", "rx_seen", "tx_seen")
 
     def __init__(self, net, conn_id: int, transport: str, rpc, inbound: bool = False):
         super().__init__(transport, None, None, inbound=inbound)
@@ -1054,6 +1060,37 @@ class Rpc:
         self._explicit.append(address)
         self._call_in_loop(lambda: self._loop.create_task(self._reconnect_task(address)))
 
+    def peer_name_at(self, address: str) -> Optional[str]:
+        """Name of the connected peer that advertises ``address`` among its
+        greeting listen addresses, or None if no greeting from there has
+        completed yet.  Calls route by peer NAME; this is how a client
+        holding a LIST of broker *addresses* (broker-HA failover) resolves
+        each one to the name it must actually call."""
+        try:
+            kind, target = parse_address(address)
+        except Exception:
+            return None
+        if kind == "ipc":
+            want = {f"ipc://{target}"}
+        else:
+            host, port = target
+            hosts = {host}
+            if host in ("0.0.0.0", "", "localhost"):
+                hosts.add("127.0.0.1")
+            else:
+                try:
+                    import socket as _socket
+
+                    hosts.add(_socket.gethostbyname(host))
+                except OSError:
+                    pass
+            want = {f"tcp://{h}:{port}" for h in hosts}
+        with self._state:
+            for p in self._peers.values():
+                if any(a in want for a in p.addresses):
+                    return p.name
+        return None
+
     def define(self, name: str, fn: Callable, batch_size: Optional[int] = None,
                inline: bool = False) -> None:
         """Register ``fn`` as a callable RPC endpoint.
@@ -1455,6 +1492,7 @@ class Rpc:
         except Exception:
             return False
         conn = _Connection(kind, reader, writer)
+        conn.rpc = self
         conn.initiator_uid = self._uid
         conn.conn_seq = next(self._dial_seq)
         if explicit_addr is not None:
@@ -1615,6 +1653,7 @@ class Rpc:
     # --------------------------------------------------------- receive path
     def _on_accept(self, transport: str, reader, writer):
         conn = _Connection(transport, reader, writer, inbound=True)
+        conn.rpc = self
         self._conns.append(conn)
         self._send_greeting(conn)
         self._loop.create_task(self._read_loop(conn))

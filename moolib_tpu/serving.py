@@ -58,6 +58,7 @@ from .rpc import Future, Rpc, RpcError
 
 __all__ = [
     "AdmissionController",
+    "BrokerUnreachableError",
     "ModelPublisher",
     "ModelSubscriber",
     "ServeClient",
@@ -111,6 +112,10 @@ _M_CLIENT_RETRIES = _REG.counter(
 _M_CLIENT_FAILOVERS = _REG.counter(
     "serve_client_failovers_total", "client attempts moved to another replica"
 )
+_M_BROKER_FAILOVERS = _REG.counter(
+    "serve_client_broker_failovers_total",
+    "discovery refreshes moved to a different broker in the list",
+)
 
 # Typed overload protocol: remote handler errors travel as strings
 # (``RpcError(message)`` on the caller), so the type rides a token in the
@@ -127,6 +132,15 @@ class ServeOverloadError(RpcError):
 
 class ServeDeadlineError(RpcError):
     """The client-side deadline expired before any replica answered."""
+
+
+class BrokerUnreachableError(RpcError):
+    """Every broker in the discovery list has been unreachable past the
+    client's patience window: the client cannot learn a roster at all.
+    Typed so callers can tell a dead control plane (page the operator)
+    from a slow or overloaded replica fleet (back off and retry).  Like
+    replica errors, failing brokers are suspected with capped exponential
+    backoff rather than hammered."""
 
 
 def is_overload_error(exc: object) -> bool:
@@ -763,10 +777,12 @@ class ServeClient:
 
     def __init__(self, rpc: Optional[Rpc] = None, *, fn: str = "generate",
                  replicas: Sequence[str] = (), broker: Optional[str] = None,
+                 brokers: Sequence[str] = (),
                  broker_name: str = "broker", group: str = "serve",
                  deadline_s: float = 30.0, attempt_timeout: float = 5.0,
                  max_attempts: int = 6, backoff: float = 0.05,
                  backoff_cap: float = 1.0, refresh_interval: float = 0.5,
+                 broker_unreachable_after: float = 10.0,
                  metadata: bool = True):
         self._owns_rpc = rpc is None
         if rpc is None:
@@ -792,8 +808,20 @@ class ServeClient:
         self._stats = {"ok": 0, "overload": 0, "deadline": 0, "error": 0,
                        "retries": 0, "failovers": 0}
         self._refresh_thread: Optional[threading.Thread] = None
-        if broker is not None:
-            rpc.connect(broker)
+        # Discovery control plane: one broker (legacy) or the full HA list.
+        # Re-resolved from ADDRESSES on every refresh — a cached name would
+        # pin discovery to whichever broker was primary at construction.
+        self._broker_addrs: List[str] = (
+            ([broker] if broker else []) + [b for b in brokers if b]
+        )
+        self._broker_addr: Optional[str] = None  # address currently serving us
+        self._broker_suspect: Dict[str, float] = {}  # addr -> suspect-until
+        self._broker_backoff: Dict[str, float] = {}  # addr -> current backoff
+        self._broker_unreachable_after = float(broker_unreachable_after)
+        self._broker_ok_at = time.monotonic()
+        if self._broker_addrs:
+            for a in self._broker_addrs:
+                rpc.connect(a)
             self._refresh_thread = threading.Thread(
                 target=self._refresh_loop, args=(float(refresh_interval),),
                 name="serve-client-refresh", daemon=True,
@@ -803,16 +831,69 @@ class ServeClient:
     # -------------------------------------------------------------- roster
     def _refresh_loop(self, interval: float) -> None:
         while not self._closed.is_set():
+            self._refresh_once()
+            self._closed.wait(interval)
+
+    def _refresh_once(self) -> None:
+        """One discovery pass across the broker list: current broker first,
+        suspects skipped while their backoff runs (unless everyone is
+        suspect), a primary's roster preferred over a standby's replicated
+        one (the standby keeps discovery alive mid-failover)."""
+        now = time.monotonic()
+        addrs = list(self._broker_addrs)
+        if self._broker_addr in addrs:
+            addrs.remove(self._broker_addr)
+            addrs.insert(0, self._broker_addr)
+        candidates = [a for a in addrs
+                      if self._broker_suspect.get(a, 0.0) <= now] or addrs
+        best: Optional[Tuple[str, dict]] = None
+        for addr in candidates:
+            name = self._rpc.peer_name_at(addr)
+            if name is None:  # never greeted: down, or still dialing
+                self._note_broker_fail(addr, now)
+                continue
             try:
                 listing = self._rpc.async_(
-                    self._broker_name, "__broker_list", self._group
-                ).result(5.0)
-            except Exception:  # noqa: BLE001 — broker briefly unreachable:
-                listing = None  # keep the last-known roster
-            if listing and listing.get("observers"):
-                with self._lock:
-                    self._replicas = sorted(listing["observers"])
-            self._closed.wait(interval)
+                    name, "__broker_list", self._group
+                ).result(2.0)
+            except Exception:  # noqa: BLE001
+                self._note_broker_fail(addr, now)
+                continue
+            if not isinstance(listing, dict):
+                self._note_broker_fail(addr, now)
+                continue
+            self._broker_suspect.pop(addr, None)
+            self._broker_backoff.pop(addr, None)
+            if not listing.get("standby"):
+                best = (addr, listing)
+                break
+            if best is None:
+                best = (addr, listing)
+        if best is None:
+            return  # everyone unreachable: keep the last-known roster
+        addr, listing = best
+        if self._broker_addr is not None and addr != self._broker_addr:
+            _M_BROKER_FAILOVERS.inc()
+            utils.log_info("serve client: discovery failed over to broker at %s",
+                           addr)
+        self._broker_addr = addr
+        self._broker_ok_at = time.monotonic()
+        if listing.get("observers"):
+            with self._lock:
+                self._replicas = sorted(listing["observers"])
+
+    def _note_broker_fail(self, addr: str, now: float) -> None:
+        backoff = self._broker_backoff.get(addr, 0.25)
+        self._broker_backoff[addr] = min(backoff * 2, 2.0)
+        self._broker_suspect[addr] = now + backoff
+
+    def broker_unreachable(self) -> bool:
+        """True when broker discovery is enabled and NO broker in the list
+        has answered for ``broker_unreachable_after`` seconds."""
+        if not self._broker_addrs or self._refresh_thread is None:
+            return False
+        return (time.monotonic() - self._broker_ok_at
+                > self._broker_unreachable_after)
 
     def replicas(self) -> List[str]:
         with self._lock:
@@ -825,6 +906,11 @@ class ServeClient:
             reps = self.replicas()
             if len(reps) >= n:
                 return reps
+            if not reps and self.broker_unreachable():
+                raise BrokerUnreachableError(
+                    f"no broker reachable (tried {self._broker_addrs}) and "
+                    f"no replicas known"
+                )
             time.sleep(0.05)
         raise ServeDeadlineError(
             f"discovered {len(self.replicas())}/{n} replicas within {timeout}s"
@@ -894,6 +980,15 @@ class ServeClient:
                 self._fail(st, ServeOverloadError(
                     f"all replicas rejected: {sorted(st['overloaded'])}"
                 ), "overload")
+                return
+            if not self.replicas() and self.broker_unreachable():
+                # Dead control plane, empty roster: a typed error NOW beats
+                # burning the deadline re-polling a discovery endpoint that
+                # every broker in the list has stopped answering.
+                self._fail(st, BrokerUnreachableError(
+                    f"no broker reachable (tried {self._broker_addrs}) and "
+                    f"no replicas known"
+                ), "error")
                 return
             # No replicas known yet (discovery warming up, or the whole
             # fleet died): keep polling the roster until the deadline.
@@ -984,6 +1079,7 @@ class ServeReplica:
                  name: str = "generate", version: int = 0,
                  batch_size: int = 16, dynamic_batching: bool = True,
                  max_queue: int = 128, broker: Optional[str] = None,
+                 brokers: Sequence[str] = (),
                  broker_name: str = "broker", group: str = "serve",
                  role: str = "replica", publisher: Optional[str] = None,
                  model_channel: str = "model", poll_interval: float = 0.5):
@@ -996,11 +1092,18 @@ class ServeReplica:
         self._group: Optional[Group] = None
         self._pump: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        if broker is not None:
-            rpc.connect(broker)
+        broker_addrs = ([broker] if broker else []) + [b for b in brokers if b]
+        if broker_addrs:
             self._group = Group(rpc, group)
             self._group.set_broker_name(broker_name)
             self._group.set_role(role)
+            if brokers:
+                # HA mode: the group dials every broker, resolves names from
+                # the greetings, and fails its registration pings over when
+                # the primary dies (the replica stays discoverable).
+                self._group.set_brokers(broker_addrs)
+            else:
+                rpc.connect(broker_addrs[0])
             self._pump = threading.Thread(
                 target=self._pump_loop, name="serve-replica-pump", daemon=True
             )

@@ -24,6 +24,10 @@ Phases (the peer-rejoin chain tiles the restart timeline end to end):
 - ``worker_respawn``     — EnvPool supervisor: worker death detected to the
   respawned slot re-attached with its unfinished steps re-issued
   (:meth:`moolib_tpu.envpool.EnvPool._supervise_dead_worker`).
+- ``broker_failover``    — a peer's broker pings going silent (or answered
+  by a demoted standby) to the first successful ping against the NEW
+  primary after the failover scan picked it
+  (:meth:`moolib_tpu.group.Group.set_brokers`).
 
 Buckets span 50 ms (same-host respawn) to 5 min (cold jax start on a
 loaded box) — wider than the default latency buckets because recovery is a
@@ -43,6 +47,7 @@ RECOVERY_PHASES = (
     "first_compile",
     "first_contribution",
     "worker_respawn",
+    "broker_failover",
 )
 
 RECOVERY_BUCKETS = (

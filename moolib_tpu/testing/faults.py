@@ -33,7 +33,7 @@ import signal
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["FaultPlan", "FrameFaults", "install_from_env"]
+__all__ = ["FaultPlan", "FrameFaults", "Partition", "install_from_env"]
 
 
 class FrameFaults:
@@ -146,6 +146,122 @@ class FrameFaults:
         return False
 
 
+class Partition:
+    """Simulated bidirectional network partition at the ``send_frame`` seam
+    of both transports: while active, any frame whose SENDER and RECEIVER
+    sit on opposite sides of the cut is silently dropped — both directions,
+    a real partition has no half-open mercy.  Peers named in neither side
+    are unaffected (so a test can cut a cohort in half while its own
+    observation channel stays up).
+
+    Sender identity comes from the connection's owning Rpc
+    (``conn.rpc``), receiver identity from the greeting
+    (``conn.peer_name``); frames to a peer whose greeting hasn't completed
+    pass through — a TCP connect still succeeds across a frame-layer
+    partition, but every post-greeting frame (pings, pushes, keepalives)
+    is then dropped, which is exactly what the liveness machinery keys on.
+
+    ``install()`` hooks the seam; the cut itself is switched with
+    ``start()``/``heal()`` (or scheduled by the ``start``/``duration``
+    seconds given to :meth:`FaultPlan.partition`).  Use as a context
+    manager for install/uninstall.
+    """
+
+    def __init__(self, groups: Sequence[Sequence[str]],
+                 start: Optional[float] = None,
+                 duration: Optional[float] = None):
+        if len(groups) != 2:
+            raise ValueError("partition takes exactly two peer-name groups")
+        self.a = frozenset(str(n) for n in groups[0])
+        self.b = frozenset(str(n) for n in groups[1])
+        overlap = self.a & self.b
+        if overlap:
+            raise ValueError(f"peer(s) on both sides of the cut: {sorted(overlap)}")
+        self.active = False
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._originals: List[Tuple[type, object]] = []
+        self._timers: List[threading.Timer] = []
+        self._start_after = start
+        self._duration = duration
+
+    def _severed(self, sender: Optional[str], receiver: Optional[str]) -> bool:
+        if sender is None or receiver is None:
+            return False
+        return ((sender in self.a and receiver in self.b)
+                or (sender in self.b and receiver in self.a))
+
+    def _wrap(self, cls, orig):
+        part = self
+
+        def send(conn_self, chunks):
+            if part.active:
+                rpc = getattr(conn_self, "rpc", None)
+                sender = rpc.get_name() if rpc is not None else None
+                if part._severed(sender, conn_self.peer_name):
+                    with part._lock:
+                        part.dropped += 1
+                    return None
+            return orig(conn_self, chunks)
+
+        return send
+
+    def start(self) -> None:
+        self.active = True
+
+    def heal(self) -> None:
+        self.active = False
+
+    def install(self) -> "Partition":
+        if self._originals:
+            return self  # already installed
+        from ..rpc import core as rpc_core
+
+        for cls in (rpc_core._Connection, rpc_core._NativeConnection):
+            orig = cls.__dict__["send_frame"]
+            self._originals.append((cls, orig))
+            cls.send_frame = self._wrap(cls, orig)
+        # Same reasoning as FrameFaults: the memfd-multicast broadcast fast
+        # path bypasses send_frame and would leak frames across the cut.
+        rpc_core.frame_seam_hooked = True
+        if self._start_after is None and self._duration is None:
+            pass  # manual start()/heal()
+        else:
+            delay = self._start_after or 0.0
+            if delay > 0:
+                t = threading.Timer(delay, self.start)
+                t.daemon = True
+                t.start()
+                self._timers.append(t)
+            else:
+                self.start()
+            if self._duration is not None:
+                t = threading.Timer(delay + self._duration, self.heal)
+                t.daemon = True
+                t.start()
+                self._timers.append(t)
+        return self
+
+    def uninstall(self) -> None:
+        from ..rpc import core as rpc_core
+
+        for t in self._timers:
+            t.cancel()
+        self._timers = []
+        self.active = False
+        for cls, orig in self._originals:
+            cls.send_frame = orig
+        self._originals = []
+        rpc_core.frame_seam_hooked = False
+
+    def __enter__(self) -> "Partition":
+        return self.install()
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
+
 class FaultPlan:
     """Deterministic, seed-driven fault schedule.
 
@@ -236,6 +352,41 @@ class FaultPlan:
         eviction, epoch churn, and leader re-election on the survivors."""
         pid = getattr(proc, "pid", proc)
         self._record("kill_process", pid, sig)
+        os.kill(pid, sig)
+
+    # ----------------------------------------------------------- broker plane
+    def partition(self, groups: Sequence[Sequence[str]],
+                  start: Optional[float] = None,
+                  duration: Optional[float] = None) -> Partition:
+        """A :class:`Partition` between two peer-name sets — bidirectional
+        frame drop at the ``send_frame`` seam.  ``start`` seconds after
+        ``install()`` the cut activates (0/None-with-duration = at once),
+        healing ``duration`` seconds later; omit both for manual
+        ``start()``/``heal()`` control.  The invariant this arms
+        (docs/RESILIENCE.md "Network partition"): after the heal, the
+        cohort re-forms on ONE fenced broker generation — the minority
+        side's promoted standby or zombie primary must demote, never
+        leaving two live primaries."""
+        self._record("partition", tuple(sorted(groups[0])),
+                     tuple(sorted(groups[1])), start, duration)
+        return Partition(groups, start=start, duration=duration)
+
+    def broker_kill_time(self, window: float) -> float:
+        """When (seconds from start) to SIGKILL the primary broker, drawn
+        uniformly from the middle half of ``window`` on the ``broker``
+        stream — always mid-allreduce / mid-serve, never at the edges
+        where the kill degenerates into a clean start/stop."""
+        t = round(window * (0.25 + 0.5 * self.rng("broker").random()), 3)
+        self._record("broker_kill_time", window, t)
+        return t
+
+    def broker_kill(self, proc, sig: int = signal.SIGKILL) -> None:
+        """SIGKILL the primary broker process.  The failover invariant this
+        arms: every peer re-targets a hot standby within the
+        ``recovery_seconds{phase="broker_failover"}`` budget, and no
+        request or contribution is lost to the control-plane change."""
+        pid = getattr(proc, "pid", proc)
+        self._record("broker_kill", pid, sig)
         os.kill(pid, sig)
 
     # --------------------------------------------------------- serving plane

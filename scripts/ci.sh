@@ -124,6 +124,16 @@ step "serving soak (seeded, ~40 s smoke: replica SIGKILL mid-stream + live hot-s
 # (docs/RESILIENCE.md "Serving soak").
 python scripts/serve_soak.py --smoke || fail=1
 
+step "broker HA tests (hot-standby failover, partition healing, generation fencing)"
+python -m pytest tests/test_group.py -q \
+  -k "broker_failover or partition_heals or split_brain or zombie or stale_push or standby_serves" || fail=1
+
+step "broker soak (seeded, ~30 s smoke: primary SIGKILL mid-allreduce + mid-serve)"
+# Exits non-zero on any recovery_seconds{phase="broker_failover"} span past
+# the budget, a peer left on a stale generation fence, or any lost serve
+# request across the takeover (docs/RESILIENCE.md "Broker failover").
+python scripts/broker_soak.py --smoke || fail=1
+
 step "sanitizer matrix (skips where the runtime is missing)"
 python -m pytest tests/test_native_sanitizers.py -q || fail=1
 

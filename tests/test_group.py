@@ -467,6 +467,14 @@ def test_broker_concurrent_ping_update_hammer():
     broker._groups = {}
     broker._timeout = 0.05  # evict aggressively so update() mutates members
     broker._lock = threading.Lock()
+    # HA state the ping/update paths read (a bare primary, no replication).
+    broker._generation = 1
+    broker._primary = True
+    broker._peer_broker_addrs = []
+    broker._replicate_interval = 0.5
+    broker._last_replicate_tx = 0.0
+    broker._last_replicate_rx = time.monotonic()
+    broker._promote_grace = 3.0
     pushes = []
     broker._push_to = lambda *a: pushes.append(a)
 
@@ -503,3 +511,301 @@ def test_broker_concurrent_ping_update_hammer():
         for t in threads:
             t.join()
     assert not errors, errors
+
+
+# --------------------------------------------------------------------------
+# Broker high availability (ISSUE 10): replicated membership, hot-standby
+# failover, partition-safe generations.
+
+
+def make_ha_cohort(n, group_name="g", timeout=5.0, promote_grace=1.0,
+                   replicate_interval=0.1, fail_after=1.5):
+    """A primary + hot-standby broker pair with ``n`` peers that know BOTH
+    broker addresses (``Group.set_brokers``)."""
+    from conftest import grab_port
+
+    addr0 = f"127.0.0.1:{grab_port()}"
+    addr1 = f"127.0.0.1:{grab_port()}"
+    b0 = Broker()
+    b0.set_name("broker0")
+    b1 = Broker(standby=True)
+    b1.set_name("broker1")
+    for b, addr, other in ((b0, addr0, addr1), (b1, addr1, addr0)):
+        b.set_timeout(timeout)
+        b.set_promote_grace(promote_grace)
+        b.set_replicate_interval(replicate_interval)
+        b.listen(addr)
+        b.set_peer_brokers([other])
+    peers = []
+    for i in range(n):
+        rpc = Rpc()
+        rpc.set_name(f"peer{i}")
+        rpc.set_timeout(10)
+        rpc.listen("127.0.0.1:0")
+        g = Group(rpc, group_name)
+        g.set_timeout(timeout)
+        g.set_broker_fail_after(fail_after)
+        g.set_brokers([addr0, addr1])
+        peers.append((rpc, g))
+    return (b0, addr0), (b1, addr1), peers
+
+
+def pump_ha(brokers, groups, seconds, until=None):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        for b in brokers:
+            b.update()
+        for g in groups:
+            g.update()
+        if until is not None and until():
+            return True
+        time.sleep(0.02)
+    return until() if until is not None else None
+
+
+def test_broker_failover_hot_standby():
+    """Kill the primary: every peer scans the broker list, re-targets the
+    promoted standby (higher generation), and the cohort reduces again —
+    the tentpole invariant, measured as recovery_seconds{broker_failover}."""
+    from moolib_tpu import telemetry
+
+    (b0, _), (b1, _), peers = make_ha_cohort(3)
+    groups = [g for _, g in peers]
+    failovers_before = (
+        telemetry.get_registry()
+        .counter("group_broker_failovers_total", "")
+        .labels()
+        .get()
+    )
+    try:
+        assert pump_ha(
+            [b0, b1], groups, 30,
+            until=lambda: all(len(g.members()) == 3 and g.active() for g in groups),
+        ), f"cohort never formed: {[g.members() for g in groups]}"
+        assert b0.is_primary and not b1.is_primary
+        old_sync = groups[0].sync_id()
+
+        b0.close()  # primary dies; replication to the standby stops
+        assert pump_ha(
+            [b1], groups, 60,
+            until=lambda: b1.is_primary and all(
+                len(g.members()) == 3 and g.active()
+                and g.sync_id() is not None and g.sync_id() > old_sync
+                for g in groups
+            ),
+        ), (
+            f"failover never converged: primary={b1.is_primary} "
+            f"{[(g.sync_id(), g.members()) for g in groups]}"
+        )
+        # The takeover bumped the generation fence and every peer adopted it.
+        assert b1.generation == 2
+        assert all(g._broker_gen == 2 for g in groups)
+        failovers_after = (
+            telemetry.get_registry()
+            .counter("group_broker_failovers_total", "")
+            .labels()
+            .get()
+        )
+        assert failovers_after > failovers_before
+        futs = [g.all_reduce("after_failover", i + 1) for i, g in enumerate(groups)]
+        assert pump_ha([b1], groups, 15, until=lambda: all(f.done() for f in futs))
+        assert all(f.result(5) == 6 for f in futs)
+    finally:
+        for rpc, _ in peers:
+            rpc.close()
+        b0.close()
+        b1.close()
+
+
+def test_partition_heals_single_generation():
+    """ISSUE 10 satellite: seeded FaultPlan.partition splits a 4-peer cohort
+    2/2 mid-allreduce (a broker on each side).  Each side re-forms under its
+    own broker; after the heal the zombie ex-primary demotes and the WHOLE
+    cohort converges on one fenced generation — no duplicate leaders."""
+    from moolib_tpu.testing.faults import FaultPlan
+
+    (b0, _), (b1, _), peers = make_ha_cohort(4, timeout=2.0)
+    groups = [g for _, g in peers]
+    plan = FaultPlan(seed=10)
+    cut = plan.partition(
+        [["broker0", "peer0", "peer1"], ["broker1", "peer2", "peer3"]]
+    )
+    try:
+        assert pump_ha(
+            [b0, b1], groups, 30,
+            until=lambda: all(len(g.members()) == 4 and g.active() for g in groups),
+        ), f"cohort never formed: {[g.members() for g in groups]}"
+
+        # An allreduce that can never complete across the cut: the split
+        # epochs must cancel it ("group changed"), not wedge it.
+        stuck = [groups[0].all_reduce("stuck", 1.0), groups[3].all_reduce("stuck", 2.0)]
+        with cut:
+            cut.start()
+            side_a, side_b = groups[:2], groups[2:]
+            assert pump_ha(
+                [b0, b1], groups, 60,
+                until=lambda: (
+                    all(g.members() == ["peer0", "peer1"] for g in side_a)
+                    and all(g.members() == ["peer2", "peer3"] for g in side_b)
+                    and b1.is_primary
+                ),
+            ), (
+                f"sides never re-formed: {[g.members() for g in groups]} "
+                f"primaries={b0.is_primary, b1.is_primary}"
+            )
+            # Transient split brain is expected mid-partition: the standby
+            # promoted behind the cut while the old primary serves its side.
+            assert b0.is_primary and b1.is_primary
+            assert cut.dropped > 0
+            for f in stuck:
+                assert f.done()
+                with pytest.raises(RpcError):
+                    f.result(1)
+            cut.heal()
+            # Post-heal: replication exchange demotes the fence loser
+            # (generation 1 zombie vs generation 2 standby-turned-primary),
+            # its peers fail over, and ONE 4-member epoch forms.
+            assert pump_ha(
+                [b0, b1], groups, 60,
+                until=lambda: (
+                    not b0.is_primary and b1.is_primary
+                    and all(
+                        g.members() == ["peer0", "peer1", "peer2", "peer3"]
+                        and g.active()
+                        for g in groups
+                    )
+                    and len({g.sync_id() for g in groups}) == 1
+                ),
+            ), (
+                f"cohort never converged after heal: "
+                f"primaries={b0.is_primary, b1.is_primary} "
+                f"{[(g.sync_id(), g.members()) for g in groups]}"
+            )
+        # Exactly one leader, one generation, everywhere.
+        assert [b0.is_primary, b1.is_primary].count(True) == 1
+        assert b0.generation == b1.generation == 2
+        assert all(g._broker_gen == 2 for g in groups)
+        futs = [g.all_reduce("after_heal", i + 1) for i, g in enumerate(groups)]
+        assert pump_ha([b0, b1], groups, 15, until=lambda: all(f.done() for f in futs))
+        assert all(f.result(5) == 10 for f in futs)
+    finally:
+        cut.uninstall()
+        for rpc, _ in peers:
+            rpc.close()
+        b0.close()
+        b1.close()
+
+
+def test_split_brain_two_primaries_converge(free_port):
+    """Two brokers that both believe they are primary (the post-heal zombie
+    scenario, isolated): the replication exchange demotes exactly one of
+    them — the (generation, name) fence picks a deterministic survivor."""
+    from conftest import grab_port
+
+    addr0 = f"127.0.0.1:{free_port}"
+    addr1 = f"127.0.0.1:{grab_port()}"
+    b0 = Broker()
+    b0.set_name("broker0")
+    b1 = Broker()
+    b1.set_name("broker1")
+    for b, addr, other in ((b0, addr0, addr1), (b1, addr1, addr0)):
+        b.set_replicate_interval(0.05)
+        b.listen(addr)
+        b.set_peer_brokers([other])
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            b0.update()
+            b1.update()
+            if b0.is_primary != b1.is_primary:
+                break
+            time.sleep(0.02)
+        # Equal generations: the name breaks the tie, broker1 survives.
+        assert b1.is_primary and not b0.is_primary
+        assert b0.generation == b1.generation
+    finally:
+        b0.close()
+        b1.close()
+
+
+def test_zombie_demotes_on_higher_generation_ping():
+    """Generation fence, broker side: a peer already following a newer
+    primary pings the zombie — it must stand down instantly (replicated
+    deployments) or absorb the fence (legacy single broker, where demoting
+    would wedge the cohort behind a broker that no longer exists)."""
+    zombie = Broker()
+    zombie.set_name("broker0")
+    try:
+        zombie._peer_broker_addrs = ["127.0.0.1:1"]  # replicated deployment
+        r = zombie._on_ping("g", "peer0", 0, None, None, "member", 5)
+        assert r["standby"] is True
+        assert not zombie.is_primary
+        assert zombie.generation == 5
+    finally:
+        zombie.close()
+
+    solo = Broker()
+    solo.set_name("broker0")
+    try:
+        r = solo._on_ping("g", "peer0", 0, None, None, "member", 5)
+        assert not r.get("standby")
+        assert solo.is_primary
+        assert solo.generation == 5
+        assert r["sync_id"] is not None
+    finally:
+        solo.close()
+
+
+def test_stale_push_rejected():
+    """Generation fence, peer side: a fenced ex-primary's epoch push is
+    rejected even when its sync_id is HIGHER than ours — the fence, not the
+    epoch number, decides; a higher generation is adopted as usual."""
+    rpc = Rpc()
+    rpc.set_name("peer0")
+    g = Group(rpc, "g")
+    try:
+        g._on_update(5, ["peer0", "peer1"], None, 2)
+        assert g.sync_id() == 5 and g._broker_gen == 2
+
+        # Zombie push: generation 1 < 2 -> rejected despite sync_id 99.
+        g._on_update(99, ["peer0"], None, 1)
+        assert g.sync_id() == 5
+        assert g.members() == ["peer0", "peer1"]
+
+        # Newer generation adopted; epoch must still be strictly newer.
+        g._on_update(6, ["peer0", "peer1", "peer2"], None, 3)
+        assert g.sync_id() == 6 and g._broker_gen == 3
+
+        # Legacy push without a generation passes the fence unchanged.
+        g._on_update(7, ["peer0"])
+        assert g.sync_id() == 7 and g._broker_gen == 3
+    finally:
+        rpc.close()
+
+
+def test_standby_serves_discovery_from_replicated_state():
+    """__broker_list answers from a standby's replicated snapshot: serving
+    clients keep discovering replicas while the failover is still electing
+    the next primary."""
+    (b0, _), (b1, _), peers = make_ha_cohort(2)
+    groups = [g for _, g in peers]
+    try:
+        assert pump_ha(
+            [b0, b1], groups, 30,
+            until=lambda: all(len(g.members()) == 2 and g.active() for g in groups),
+        )
+        # Let at least one replication snapshot land on the standby.
+        assert pump_ha(
+            [b0, b1], groups, 10,
+            until=lambda: b1._groups.get("g") is not None
+            and len(b1._groups["g"].active_members) == 2,
+        ), "replication never reached the standby"
+        listing = b1._on_list("g")
+        assert listing["standby"] is True
+        assert listing["members"] == ["peer0", "peer1"]
+        assert listing["sync_id"] == groups[0].sync_id()
+    finally:
+        for rpc, _ in peers:
+            rpc.close()
+        b0.close()
+        b1.close()

@@ -27,9 +27,10 @@ import time
 import numpy as np
 import pytest
 
-from moolib_tpu import Broker, Group, Rpc
+from moolib_tpu import Broker, Group, Rpc, RpcError
 from moolib_tpu.serving import (
     AdmissionController,
+    BrokerUnreachableError,
     ModelPublisher,
     ServeClient,
     ServeOverloadError,
@@ -502,3 +503,109 @@ def test_replica_kill_schedule_is_seeded():
     idx = a.replica_kill(procs, sig=0)  # sig 0: existence probe, no kill
     assert idx == b.replica_kill(procs, sig=0)
     assert a.actions[-1][0] == "replica_kill"
+
+
+# ------------------------------------------------- broker HA (ISSUE 10)
+def make_ha_brokers(promote_grace=1.0, replicate_interval=0.1):
+    """Primary + hot-standby broker pair, each pumped on a daemon thread
+    (a closed broker's pump just absorbs the shutdown errors)."""
+    from conftest import grab_port
+
+    addr0 = f"127.0.0.1:{grab_port()}"
+    addr1 = f"127.0.0.1:{grab_port()}"
+    b0 = Broker()
+    b0.set_name("broker0")
+    b1 = Broker(standby=True)
+    b1.set_name("broker1")
+    stop = threading.Event()
+    for b, addr, other in ((b0, addr0, addr1), (b1, addr1, addr0)):
+        b.set_promote_grace(promote_grace)
+        b.set_replicate_interval(replicate_interval)
+        b.listen(addr)
+        b.set_peer_brokers([other])
+
+        def pump(b=b):
+            while not stop.is_set():
+                try:
+                    b.update()
+                except Exception:  # noqa: BLE001 - closed mid-test
+                    pass
+                stop.wait(0.05)
+
+        threading.Thread(target=pump, daemon=True).start()
+    return (b0, addr0), (b1, addr1), stop
+
+
+def test_serve_client_discovery_fails_over_to_standby():
+    """ISSUE 10 satellite: ServeClient discovery re-resolves from the broker
+    ADDRESS LIST.  When the primary dies, the refresh loop suspects it and
+    reads the roster from the standby's replicated state (then from it as
+    the new primary) — replicas stay discoverable and calls keep landing."""
+    from moolib_tpu import telemetry
+
+    (b0, addr0), (b1, addr1), stop = make_ha_brokers()
+    rpc = Rpc()
+    rpc.set_name("rep0")
+    rpc.listen("127.0.0.1:0")
+    rep = ServeReplica(
+        rpc, scale_step(1.0), {"scale": 2.0}, name="generate", batch_size=4,
+        brokers=[addr0, addr1], poll_interval=0.1,
+    )
+    rep._group.set_broker_fail_after(1.5)
+    t = threading.Thread(target=lambda: asyncio.run(rep.loop()), daemon=True)
+    t.start()
+    failovers = telemetry.get_registry().counter(
+        "serve_client_broker_failovers_total", "").labels()
+    before = failovers.get()
+    cl = ServeClient(brokers=[addr0, addr1], deadline_s=20.0,
+                     attempt_timeout=2.0, refresh_interval=0.2,
+                     broker_unreachable_after=8.0)
+    try:
+        cl.wait_for_replicas(1, timeout=20.0)
+        assert cl.replicas() == ["rep0"]
+        np.testing.assert_allclose(np.asarray(cl.call(np.ones(2))),
+                                   np.ones(2) * 2.0)
+        assert cl._broker_addr == addr0
+
+        b0.close()  # primary dies mid-serve
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if cl._broker_addr == addr1 and b1.is_primary:
+                break
+            time.sleep(0.05)
+        assert cl._broker_addr == addr1, "discovery never failed over"
+        assert b1.is_primary, "standby never promoted"
+        assert failovers.get() > before
+        assert cl.replicas() == ["rep0"]  # roster survived the failover
+        np.testing.assert_allclose(np.asarray(cl.call(np.ones(2))),
+                                   np.ones(2) * 2.0)
+        st = cl.stats()
+        assert st["error"] == 0 and st["deadline"] == 0
+        cl.close()
+    finally:
+        stop.set()
+        rep.close()
+        rpc.close()
+        b0.close()
+        b1.close()
+
+
+def test_broker_unreachable_typed_error():
+    """ISSUE 10 satellite: every broker in the list dead + empty roster ->
+    a typed BrokerUnreachableError (an RpcError subclass), fast — never a
+    silent deadline burn."""
+    from conftest import grab_port
+
+    dead = [f"127.0.0.1:{grab_port()}", f"127.0.0.1:{grab_port()}"]
+    cl = ServeClient(brokers=dead, deadline_s=6.0, refresh_interval=0.1,
+                     broker_unreachable_after=0.5)
+    try:
+        assert issubclass(BrokerUnreachableError, RpcError)
+        t0 = time.monotonic()
+        with pytest.raises(BrokerUnreachableError):
+            cl.wait_for_replicas(1, timeout=15.0)
+        assert time.monotonic() - t0 < 10.0
+        with pytest.raises(BrokerUnreachableError):
+            cl.submit(np.ones(2)).result(15.0)
+    finally:
+        cl.close()

@@ -289,7 +289,8 @@ def parse_serve_qps(path):
                     row = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if row.get("metric") == "serve_qps" or "p99_ms" in row:
+                if (row.get("metric") in ("serve_qps", "serve_phase_breakdown")
+                        or "p99_ms" in row):
                     keep.append(json.dumps(row))
     except OSError:
         return None

@@ -215,6 +215,28 @@ def run_config(args, dynamic: bool, kv_heads: int, batch_size: int):
             pass
 
 
+_PHASES = ("admission", "queue", "batch_assembly", "device", "reply")
+
+
+def _phase_totals(rpc, replica):
+    """Per-phase ``(sum_s, count)`` of the server's ``serve_phase_seconds``
+    histogram, pulled over the ``__telemetry_snapshot`` RPC every scrapable
+    peer defines.  ``None`` when the server predates the endpoint — the
+    breakdown row is additive, never a bench failure."""
+    try:
+        snap = rpc.sync(replica, "__telemetry_snapshot")
+    except Exception:  # noqa: BLE001
+        return None
+    fam = (snap.get("metrics") or {}).get("serve_phase_seconds") or {}
+    out = {}
+    for s in fam.get("series", ()):
+        ph = (s.get("labels") or {}).get("phase")
+        v = s.get("value") or {}
+        if ph:
+            out[ph] = (float(v.get("sum", 0.0)), int(v.get("count", 0)))
+    return out
+
+
 def run_qps(args):
     """Sustained-QPS rows against a replica-mode server (admission control
     on): paced arrivals, per-request deadline, typed rejects counted."""
@@ -274,6 +296,8 @@ def run_qps(args):
         rng = np.random.default_rng(0)
         prompt = rng.integers(2, args.vocab, args.seq_len).astype(np.int32)
         client.call(prompt)  # warm + prime the server's service-time EMA
+        replica = client.replicas()[0]
+        phases0 = _phase_totals(client._rpc, replica)
 
         for q in args.qps:
             latencies: list = []
@@ -337,6 +361,26 @@ def run_qps(args):
                                if lat is not None else None),
                 }
             print(json.dumps(row), flush=True)
+        # Where did the latency go?  Per-phase means over the whole QPS
+        # sweep, from the server's serve_phase_seconds histogram deltas
+        # (admission -> queue -> batch_assembly -> device -> reply).
+        phases1 = _phase_totals(client._rpc, replica)
+        if phases0 is not None and phases1 is not None:
+            breakdown = {}
+            for ph in _PHASES:
+                s0, c0 = phases0.get(ph, (0.0, 0))
+                s1, c1 = phases1.get(ph, (0.0, 0))
+                dc = c1 - c0
+                breakdown[ph] = {
+                    "count": dc,
+                    "mean_ms": (round((s1 - s0) / dc * 1e3, 3)
+                                if dc > 0 else None),
+                }
+            print(json.dumps({
+                "metric": "serve_phase_breakdown",
+                "platform": platform,
+                "phases": breakdown,
+            }), flush=True)
     finally:
         import signal
 

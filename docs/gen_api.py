@@ -58,6 +58,9 @@ MODULES = [
     ("moolib_tpu.telemetry.tracing", "Telemetry: span tracer"),
     ("moolib_tpu.telemetry.exporters", "Telemetry: exporters"),
     ("moolib_tpu.telemetry.cohort", "Telemetry: cohort aggregation"),
+    ("moolib_tpu.telemetry.aggregator", "Telemetry: RPC cohort aggregator"),
+    ("moolib_tpu.telemetry.flightrec", "Telemetry: flight recorder"),
+    ("moolib_tpu.telemetry.profiling", "Telemetry: on-demand device profiling"),
     ("moolib_tpu.telemetry.recovery", "Telemetry: recovery-phase accounting"),
     ("moolib_tpu.utils", "Utilities"),
     ("moolib_tpu.utils.nest", "Utilities: nest"),

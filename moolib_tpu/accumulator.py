@@ -260,6 +260,10 @@ class Accumulator:
             self._group = group
             self._rpc = group._rpc
         self._group.add_change_callback(self._on_group_change)
+        # Every cohort peer is scrapable/profilable by the cohort
+        # aggregator (__telemetry_snapshot / __telemetry_trace /
+        # __telemetry_profile); idempotent when the Rpc is shared.
+        telemetry.install_rpc_handlers(self._rpc)
 
         # model / election state
         self._model_version = 0
@@ -1206,6 +1210,16 @@ class Accumulator:
                 "jax adaptation: pass the gradient pytree explicitly, "
                 "reduce_gradients(batch_size, gradients)"
             )
+        # Root of this round's distributed trace: everything launched while
+        # the span is open — staging, and the round's first wave of tree-op
+        # RPCs sent synchronously from _start_round — shares its trace_id,
+        # so a merged cohort timeline shows one causal tree per round.
+        with telemetry.root_span("accum.reduce_gradients",
+                                 accumulator=self._name,
+                                 batch_size=int(batch_size)):
+            self._reduce_gradients_traced(batch_size, gradients)
+
+    def _reduce_gradients_traced(self, batch_size: int, gradients) -> None:
         self._rec_note_first_reduce()
         stats = {"num_gradients": 1, "num_skipped": 0, "batch_size": int(batch_size)}
         if self._ici_eligible():
@@ -2293,6 +2307,8 @@ class Accumulator:
         self._is_leader = leader == self._rpc.get_name()
         self._election_retry_at = None
         _M_ELECTIONS.inc()
+        telemetry.flight_event("accum.election", accumulator=self._name,
+                               leader=leader, is_leader=self._is_leader)
         _M_IS_LEADER.set(
             1.0 if self._is_leader else 0.0,
             accumulator=self._name,

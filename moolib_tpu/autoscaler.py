@@ -118,30 +118,10 @@ def sample_from_snapshot(name: str, snap: Dict[str, Any]) -> PeerSample:
     )
 
 
-def read_snapshot_tail(path: str, max_bytes: int = 1 << 20) -> Optional[Dict[str, Any]]:
-    """Last parseable JSONL snapshot in ``path`` (None if absent/empty).
-    Reads only the file tail: snapshot files grow for the process lifetime,
-    and a half-written final line (snapshotter racing us) falls back to the
-    previous complete one."""
-    try:
-        with open(path, "rb") as f:
-            f.seek(0, os.SEEK_END)
-            size = f.tell()
-            f.seek(max(0, size - max_bytes))
-            tail = f.read().decode("utf-8", errors="replace")
-    except OSError:
-        return None
-    for line in reversed(tail.splitlines()):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            snap = json.loads(line)
-        except ValueError:
-            continue
-        if isinstance(snap, dict) and "metrics" in snap:
-            return snap
-    return None
+# The torn-tail-tolerant snapshot parser lives with its writer
+# (telemetry.exporters.JsonlSnapshotter); re-exported here for the existing
+# autoscaler-facing callers.
+read_snapshot_tail = telemetry.read_snapshot_tail
 
 
 class Decision:
@@ -236,13 +216,18 @@ class SubprocessFleet:
     """
 
     def __init__(self, spawn: Callable[[str, str], subprocess.Popen],
-                 base_dir: str, name_prefix: str = "auto"):
+                 base_dir: str, name_prefix: str = "auto",
+                 sample_source: Optional["RpcSampleSource"] = None):
         self._spawn = spawn
         self._base_dir = base_dir
         self._prefix = name_prefix
         self._next_idx = 0
         # name -> {"proc", "dir", "decommissioning", "last_steps": (t, n)}
         self._peers: Dict[str, dict] = {}
+        # Optional RPC-pull sampling (telemetry.CohortAggregator behind
+        # RpcSampleSource): replaces the file-tail reads in samples(), so
+        # the fleet can span hosts with no shared filesystem.
+        self._sample_source = sample_source
 
     # ----------------------------------------------------------- inventory
     def peers(self) -> List[str]:
@@ -338,6 +323,16 @@ class SubprocessFleet:
 
     # ------------------------------------------------------------- samples
     def samples(self) -> List[PeerSample]:
+        if self._sample_source is not None:
+            # RPC-pull path: the aggregator scraped every broker-discovered
+            # peer; keep only the ones this fleet supervises and considers
+            # live (a decommissioning peer still answers RPCs but must stop
+            # steering the policy).
+            live = {
+                name for name, p in self._peers.items()
+                if p["proc"].poll() is None and not p["decommissioning"]
+            }
+            return [s for s in self._sample_source.samples() if s.name in live]
         out = []
         for name, p in self._peers.items():
             if p["proc"].poll() is not None or p["decommissioning"]:
@@ -354,6 +349,23 @@ class SubprocessFleet:
                 p["last_steps"] = (s.time, s.steps)
             out.append(s)
         return out
+
+
+class RpcSampleSource:
+    """RPC-pull :class:`PeerSample` source behind the same ``samples()``
+    interface the policy consumes — the cross-host replacement for the
+    file-tail reads above.  Wraps a
+    :class:`moolib_tpu.telemetry.CohortAggregator`: each ``samples()`` call
+    is one cohort scrape (per-peer timeouts, so a dying peer costs one
+    bounded wait, not the poll), with step rates computed from successive
+    scrape deltas by the aggregator."""
+
+    def __init__(self, aggregator):
+        self._agg = aggregator
+
+    def samples(self) -> List[PeerSample]:
+        self._agg.scrape()
+        return self._agg.peer_samples()
 
 
 class Autoscaler:
@@ -388,6 +400,8 @@ class Autoscaler:
             name = self.fleet.grow()
             self.policy.note_event(t)
             _M_EVENTS.inc(direction="up")
+            telemetry.flight_event("autoscaler.grow", peer=name,
+                                   reason=decision.reason, cohort=cohort)
             utils.log_info(
                 "autoscaler: grow %s (%s, cohort %d -> %d)",
                 name, decision.reason, cohort, decision.target,
@@ -399,6 +413,8 @@ class Autoscaler:
             if name is not None:
                 self.policy.note_event(t)
                 _M_EVENTS.inc(direction="down")
+                telemetry.flight_event("autoscaler.shrink", peer=name,
+                                       reason=decision.reason, cohort=cohort)
                 utils.log_info(
                     "autoscaler: decommission %s (%s, cohort %d -> %d)",
                     name, decision.reason, cohort, decision.target,

@@ -93,6 +93,8 @@ def train(flags, on_stats=None) -> dict:
     init_compile_cache(flags.compile_cache_dir)
     # Opt-in exporters (MOOLIB_TELEMETRY_* env knobs, docs/TELEMETRY.md).
     telemetry.init_from_env()
+    # kill -USR2 toggles an on-demand jax.profiler device-trace window.
+    telemetry.profiling.install_signal_toggle()
     from ..testing import faults as _faults
 
     _faults.install_from_env()  # opt-in chaos (MOOLIB_FAULTS; no-op unset)

@@ -186,6 +186,8 @@ def train(flags, on_stats=None) -> dict:
     # cache (--compile_cache_dir / MOOLIB_COMPILE_CACHE; no-op when unset).
     init_compile_cache(flags.compile_cache_dir)
     telemetry.init_from_env()  # opt-in exporters (docs/TELEMETRY.md)
+    # kill -USR2 toggles an on-demand jax.profiler device-trace window.
+    telemetry.profiling.install_signal_toggle()
     from ..testing import faults as _faults
 
     _faults.install_from_env()  # opt-in chaos (MOOLIB_FAULTS; no-op unset)

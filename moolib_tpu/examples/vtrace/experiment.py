@@ -379,6 +379,8 @@ def train(flags, on_stats=None) -> dict:
     # Opt-in exporters (MOOLIB_TELEMETRY_* env knobs, docs/TELEMETRY.md):
     # Prometheus /metrics endpoint, JSONL snapshots, SIGUSR1 dumps.
     tele = telemetry.init_from_env()
+    # kill -USR2 toggles an on-demand jax.profiler device-trace window.
+    telemetry.profiling.install_signal_toggle()
     if tele["http_port"]:
         print(f"telemetry: http://127.0.0.1:{tele['http_port']}/metrics", flush=True)
     from ...testing import faults as _faults

@@ -1209,6 +1209,11 @@ class Group:
                 self._failover = None
                 dt = now - fo["t0"]
                 observe_phase("broker_failover", dt)
+                telemetry.flight_event("group.broker_failover",
+                                       group=self._name,
+                                       broker=self._broker_name,
+                                       generation=self._broker_gen,
+                                       seconds=round(dt, 4))
                 utils.log_info(
                     "group %s: broker failover complete: %r gen=%d in %.2fs",
                     self._name, self._broker_name, self._broker_gen, dt,
@@ -1284,6 +1289,9 @@ class Group:
                             if k in self._parked or k in self._ring_parked}
             self._seq.clear()
             self._recv_seq.clear()
+        telemetry.flight_event("group.epoch", group=self._name,
+                               sync_id=sync_id, members=len(members),
+                               cancelled_ops=len(ops))
         for op in ops:
             op.future.set_exception(RpcError("group changed"))
         for cb in self._on_change_callbacks:

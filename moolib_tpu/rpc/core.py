@@ -35,6 +35,7 @@ import asyncio
 import atexit
 import collections
 import concurrent.futures
+import contextlib
 import itertools
 import math
 import os
@@ -47,6 +48,7 @@ import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import telemetry, utils
+from ..telemetry import tracing as _tracing
 from ..utils import nest
 from . import serialization
 
@@ -98,8 +100,10 @@ _M_QUEUE_WAIT = _REG.histogram(
 # incompatibly (0002: keepalive ping/pong + activity-based teardown; 0003:
 # max-(initiator_uid, dial_seq) duplicate-connection tie-break — mixed
 # versions would deterministically keep DIFFERENT duplicates and flap;
-# 0004: poke/ack/nack fast recovery frames).
-SIGNATURE = 0x6D6F6F5450550004
+# 0004: poke/ack/nack fast recovery frames; 0005: request header grew a
+# 2-byte trace-context length + optional 24-byte trace block after the fn
+# name — an 0004 peer would parse trace bytes as payload).
+SIGNATURE = 0x6D6F6F5450550005
 
 KIND_GREETING = 1
 KIND_REQUEST = 2
@@ -257,16 +261,88 @@ def _chunk_len(c) -> int:
 
 
 def _request_chunks(
-    rid: int, fn_name: str, body: List[bytes], timeout_s: float
+    rid: int, fn_name: str, body: List[bytes], timeout_s: float, trace: bytes = b""
 ) -> List[bytes]:
     """Single source of truth for the request frame layout. The sender's
     call timeout travels with the request so the receiver can size its
-    at-most-once dedup window to outlive every possible resend."""
+    at-most-once dedup window to outlive every possible resend.  ``trace``
+    is the encoded trace context (24 bytes when a trace is active, empty
+    otherwise — untraced calls pay zero extra wire bytes beyond the length
+    field)."""
     fnb = fn_name.encode()
     hdr = struct.pack(
-        "<BQIH", KIND_REQUEST, rid, min(int(timeout_s), 0xFFFFFFFF), len(fnb)
+        "<BQIHH",
+        KIND_REQUEST,
+        rid,
+        min(int(timeout_s), 0xFFFFFFFF),
+        len(fnb),
+        len(trace),
     )
-    return [hdr + fnb] + body
+    return [hdr + fnb + trace] + body
+
+
+def _trace_for_request():
+    """Trace-context capture for one outgoing request.  Returns
+    ``(wire_bytes, call_ctx, parent_ctx)``: a fresh child context whose
+    span id becomes the ``rpc.call`` span (and the remote handler's
+    parent), or ``(b"", None, None)`` when the calling thread has no
+    active trace."""
+    parent = _tracing.current_context()
+    if parent is None:
+        return b"", None, None
+    call = parent.child()
+    return _tracing.encode_context(call), call, parent
+
+
+def _record_call_span(out: "_Outgoing", peers: Optional[int] = None) -> None:
+    """Record the client-side ``rpc.call`` span when the response future
+    resolves.  The span id matches what rode the wire, so the remote
+    ``rpc.recv`` span's parent edge lands on it in a merged trace."""
+    trace_id, span_id, parent_id = out.trace_parent
+    args = {"peer": out.peer_name, "rid": out.rid}
+    if peers is not None:
+        args["peers"] = peers
+    _tracing.get_tracer().record(
+        f"rpc.call {out.fn_name}",
+        out.t0_ns,
+        time.perf_counter_ns() - out.t0_ns,
+        trace_id=trace_id,
+        span_id=span_id,
+        parent_id=parent_id,
+        args=args,
+    )
+
+
+# Shared no-op context manager: untraced requests skip span creation
+# entirely (nullcontext is reusable and reentrant).
+_NULL_CM = contextlib.nullcontext()
+
+
+def _recv_span(fn_name: str, tctx, rid=None):
+    """Child span for handler execution under a remote caller's context;
+    a no-op when the request carried none."""
+    if tctx is None:
+        return _NULL_CM
+    args = {} if rid is None else {"rid": rid}
+    return _tracing.child_span(f"rpc.recv {fn_name}", tctx, **args)
+
+
+def _record_resend_span(out: "_Outgoing", why: str) -> None:
+    """Record a retry as a SIBLING of the rpc.call span (fresh span id,
+    same parent) — resends stay visible in the trace without duplicating
+    the call span's id.  Instant event (no meaningful duration)."""
+    if out.trace_parent is None:
+        return
+    trace_id, _span_id, parent_id = out.trace_parent
+    _tracing.get_tracer().record(
+        f"rpc.resend {out.fn_name}",
+        time.perf_counter_ns(),
+        0,
+        trace_id=trace_id,
+        span_id=_tracing.new_span_id(),
+        parent_id=parent_id,
+        args={"peer": out.peer_name, "rid": out.rid, "why": why},
+    )
 
 
 def _local_addresses() -> List[str]:
@@ -614,6 +690,9 @@ class _Outgoing:
         "last_probe",
         "acked_at",
         "peers_pending",
+        "trace",
+        "trace_parent",
+        "t0_ns",
     )
 
     def __init__(self, rid, peer_name, fn_name, chunks, payload_obj, future, deadline):
@@ -636,6 +715,13 @@ class _Outgoing:
         # them (receiver dedup is per (peer, rid), so the shared rid is
         # unambiguous); None for ordinary single-peer requests.
         self.peers_pending: Optional[set] = None
+        # Distributed-tracing state: the encoded context bytes riding the
+        # wire (threaded through portable re-encodes), the (trace_id,
+        # span_id, parent_id) of the rpc.call span to record at completion,
+        # and the send-time perf_counter_ns.  All None/b"" when untraced.
+        self.trace = b""
+        self.trace_parent = None
+        self.t0_ns = 0
 
 
 class _FnDef:
@@ -1171,15 +1257,22 @@ class Rpc:
             future.set_exception(RpcError(f"serialization error: {e}"))
             return future
         rid = next(self._rid)
-        chunks = _request_chunks(rid, fn_name, body, self._timeout)
+        tb, call_ctx, parent_ctx = _trace_for_request()
+        chunks = _request_chunks(rid, fn_name, body, self._timeout, tb)
         deadline = time.monotonic() + self._timeout
         out = _Outgoing(rid, peer_names[0], fn_name, chunks, (args, kwargs), future, deadline)
         out.timeout_s = self._timeout
         out.peers_pending = set(peer_names)
+        if call_ctx is not None:
+            out.trace = tb
+            out.trace_parent = (call_ctx.trace_id, call_ctx.span_id, parent_ctx.span_id)
+            out.t0_ns = time.perf_counter_ns()
 
         def _done(fut: Future):
             with self._state:
                 self._outgoing.pop(rid, None)
+            if out.trace_parent is not None:
+                _record_call_span(out, peers=len(peer_names))
 
         future.add_done_callback(_done)
         with self._state:
@@ -1351,15 +1444,22 @@ class Rpc:
             future.set_exception(RpcError(f"serialization error: {e}"))
             return
         rid = next(self._rid)
-        chunks = _request_chunks(rid, fn_name, body, self._timeout)
+        tb, call_ctx, parent_ctx = _trace_for_request()
+        chunks = _request_chunks(rid, fn_name, body, self._timeout, tb)
         deadline = time.monotonic() + self._timeout
         out = _Outgoing(rid, peer_name, fn_name, chunks, (args, kwargs), future, deadline)
         out.timeout_s = self._timeout
+        if call_ctx is not None:
+            out.trace = tb
+            out.trace_parent = (call_ctx.trace_id, call_ctx.span_id, parent_ctx.span_id)
+            out.t0_ns = time.perf_counter_ns()
 
         def _done(fut: Future):
             # Completed (incl. user cancel): drop the resend buffer promptly.
             with self._state:
                 self._outgoing.pop(rid, None)
+            if out.trace_parent is not None:
+                _record_call_span(out)
 
         future.add_done_callback(_done)
 
@@ -1432,7 +1532,7 @@ class Rpc:
         if out.chunks_portable is None:
             sp = serialization._py_serialize(out.payload_obj)
             out.chunks_portable = _request_chunks(
-                out.rid, out.fn_name, serialization.pack(sp), out.timeout_s
+                out.rid, out.fn_name, serialization.pack(sp), out.timeout_s, out.trace
             )
         return out.chunks_portable
 
@@ -1762,6 +1862,7 @@ class Rpc:
                 self._nacks_recovered += 1
                 _M_NACKS.inc()
                 out.resent = True
+                _record_resend_span(out, "nack")
                 self._try_send(out)
 
     def _on_greeting(self, conn: _Connection, frame: bytes):
@@ -1859,10 +1960,15 @@ class Rpc:
             return
 
     def _on_request(self, conn: _Connection, frame: bytes):
-        rid, sender_timeout, fnlen = struct.unpack_from("<QIH", frame, 1)
-        off = 1 + 8 + 4 + 2
+        rid, sender_timeout, fnlen, tclen = struct.unpack_from("<QIHH", frame, 1)
+        off = 1 + 8 + 4 + 2 + 2
         fn_name = bytes(frame[off : off + fnlen]).decode()
         off += fnlen
+        # Remote trace context (0005): present only when the caller had an
+        # active trace.  The handler runs under a child span of the caller's
+        # rpc.call span — the cross-process edge trace_merge stitches on.
+        tctx = _tracing.decode_context(bytes(frame[off : off + tclen])) if tclen else None
+        off += tclen
         # At-most-once window must outlive every possible resend by this
         # sender: size it from the *sender's* call timeout, not ours.
         dedup_ttl = max(2.0 * sender_timeout, 120.0)
@@ -1977,7 +2083,8 @@ class Rpc:
                 respond(None, f"argument deserialization error: {e}", stage="deserialization")
                 return
             try:
-                respond(fdef.fn(*args, **kwargs), None)
+                with _recv_span(fn_name, tctx, rid):
+                    respond(fdef.fn(*args, **kwargs), None)
             except Exception:  # noqa: BLE001
                 respond(None, f"exception in {fdef.name!r}: {traceback.format_exc()}")
             return
@@ -1987,7 +2094,7 @@ class Rpc:
         except Exception as e:  # noqa: BLE001
             respond(None, f"argument deserialization error: {e}", stage="deserialization")
             return
-        self._dispatch(fdef, args, kwargs, respond)
+        self._dispatch(fdef, args, kwargs, respond, tctx=tctx, rid=rid)
 
     def _report_error(self, stage: str) -> bool:
         """Is this error stage reported to the caller under the current mode?"""
@@ -1997,16 +2104,25 @@ class Rpc:
             return self._exception_mode in ("deserialization", "all")
         return self._exception_mode == "all"
 
-    def _dispatch(self, fdef: _FnDef, args, kwargs, respond):
+    def _dispatch(self, fdef: _FnDef, args, kwargs, respond, tctx=None, rid=None):
+        # tctx: the caller's trace context decoded off the frame.  Each
+        # execution path runs the handler under an rpc.recv child span, so
+        # handler-internal span()/async_ calls chain beneath it — including
+        # onward RPCs, which re-encode the context for the next hop.
         if fdef.kind == "queue":
-            fdef.fn.enqueue(RpcDeferredReturn(respond), args, kwargs)
+            # The span covers the enqueue (service time is the queue's own
+            # business); the Queue can capture current_context() here to
+            # reattach at take time.
+            with _recv_span(fdef.name, tctx, rid):
+                fdef.fn.enqueue(RpcDeferredReturn(respond), args, kwargs)
             return
         if fdef.kind == "deferred":
             ret = RpcDeferredReturn(respond)
 
             def run_deferred():
                 try:
-                    fdef.fn(ret, *args, **kwargs)
+                    with _recv_span(fdef.name, tctx, rid):
+                        fdef.fn(ret, *args, **kwargs)
                 except Exception:  # noqa: BLE001
                     if not ret._sent:
                         ret.error(f"exception in {fdef.name!r}: {traceback.format_exc()}")
@@ -2024,7 +2140,10 @@ class Rpc:
 
                 def run_batched():
                     try:
-                        ret_cb(fdef.fn(*bargs, **bkwargs))
+                        # The batch executes once for many callers; it runs
+                        # under the flush-triggering caller's context.
+                        with _recv_span(fdef.name, tctx, rid):
+                            ret_cb(fdef.fn(*bargs, **bkwargs))
                     except Exception:  # noqa: BLE001
                         msg = f"exception in {fdef.name!r}: {traceback.format_exc()}"
                         for r, _, _ in calls:
@@ -2037,7 +2156,9 @@ class Rpc:
         if asyncio.iscoroutinefunction(fdef.fn):
             async def run_async():
                 try:
-                    respond(await fdef.fn(*args, **kwargs), None)
+                    with _recv_span(fdef.name, tctx, rid):
+                        value = await fdef.fn(*args, **kwargs)
+                    respond(value, None)
                 except Exception:  # noqa: BLE001
                     respond(None, f"exception in {fdef.name!r}: {traceback.format_exc()}")
 
@@ -2048,7 +2169,9 @@ class Rpc:
 
         def run_plain():
             try:
-                respond(fdef.fn(*args, **kwargs), None)
+                with _recv_span(fdef.name, tctx, rid):
+                    value = fdef.fn(*args, **kwargs)
+                respond(value, None)
             except Exception:  # noqa: BLE001
                 respond(None, f"exception in {fdef.name!r}: {traceback.format_exc()}")
 
@@ -2126,6 +2249,7 @@ class Rpc:
                 for out in list(self._outgoing.values()):
                     if now - out.sent_at > _RESEND_BLIND:
                         out.resent = True  # RTT no longer a clean sample
+                        _record_resend_span(out, "blind")
                         self._try_send(out)
                         out.sent_at = now
                         continue

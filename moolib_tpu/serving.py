@@ -55,6 +55,7 @@ import numpy as np
 from . import telemetry, utils
 from .group import Group
 from .rpc import Future, Rpc, RpcError
+from .telemetry import tracing as _tracing
 
 __all__ = [
     "AdmissionController",
@@ -115,6 +116,13 @@ _M_CLIENT_FAILOVERS = _REG.counter(
 _M_BROKER_FAILOVERS = _REG.counter(
     "serve_client_broker_failovers_total",
     "discovery refreshes moved to a different broker in the list",
+)
+_M_PHASE = _REG.histogram(
+    "serve_phase_seconds",
+    "per-request serve latency by phase: admission (handler entry -> "
+    "enqueue), queue (enqueue -> batch take), batch_assembly (concat + "
+    "bucket pad), device (step_fn), reply (responses out)",
+    labelnames=("phase",),
 )
 
 # Typed overload protocol: remote handler errors travel as strings
@@ -237,9 +245,10 @@ class AdmissionController:
 # --------------------------------------------------------------------------
 class _Request:
     __slots__ = ("prompt", "ret", "waiters", "t_enq", "deadline_at", "req_id",
-                 "single")
+                 "single", "tctx")
 
-    def __init__(self, prompt, ret, t_enq, deadline_at, req_id, single):
+    def __init__(self, prompt, ret, t_enq, deadline_at, req_id, single,
+                 tctx=None):
         self.prompt = prompt
         self.ret = ret
         self.waiters: List[Any] = []  # dedup'd rets riding the same req_id
@@ -247,6 +256,10 @@ class _Request:
         self.deadline_at = deadline_at
         self.req_id = req_id
         self.single = single
+        # Trace context captured at admission (the deferred handler runs
+        # under the RPC layer's rpc.recv span) — the service loop's batch
+        # span parents under it, crossing the queue/batch thread hop.
+        self.tctx = tctx
 
 
 class ServeService:
@@ -337,6 +350,8 @@ class ServeService:
         _M_SWAPS.inc()
         _M_SWAP_S.observe(dt)
         _M_VERSION.set(version)
+        telemetry.flight_event("serve.hot_swap", endpoint=self._name,
+                               version=version, seconds=round(dt, 4))
         utils.log_info(
             "serve %s: hot-swapped to model version %d in %.3fs",
             self._name, version, dt,
@@ -388,6 +403,7 @@ class ServeService:
                 deadline_at=None if deadline_s is None else now + float(deadline_s),
                 req_id=req_id,
                 single=arr.ndim == 1,
+                tctx=telemetry.current_context(),
             )
             self._queue.append(req)
             if req_id is not None:
@@ -395,6 +411,7 @@ class ServeService:
             self._stats["depth_max"] = max(self._stats["depth_max"],
                                            len(self._queue))
             _M_DEPTH.inc()
+        _M_PHASE.observe(time.monotonic() - now, phase="admission")
         self._wake_loop()
 
     def _wake_loop(self) -> None:
@@ -421,6 +438,7 @@ class ServeService:
             wait = now - r.t_enq
             s["wait_s_sum"] += wait
             s["wait_s_max"] = max(s["wait_s_max"], wait)
+            _M_PHASE.observe(wait, phase="queue")
         return batch
 
     def _respond(self, req: _Request, value, err: Optional[str]) -> None:
@@ -452,43 +470,55 @@ class ServeService:
             del self._done[k]
 
     def _run_batch(self, batch: List[_Request]) -> None:
-        prompts = np.concatenate([r.prompt for r in batch], axis=0)
-        n = prompts.shape[0]
-        if self._pad_buckets and n < self._batch_size:
-            b = bucket(n, self._batch_size)
-            if n < b:
-                pad = np.repeat(prompts[-1:], b - n, axis=0)
-                prompts = np.concatenate([prompts, pad], axis=0)
-                self._stats["bucket_pad_rows"] += b - n
-        t0 = time.monotonic()
-        try:
-            out = np.asarray(self._step_fn(self._params, prompts))[:n]
-        except Exception as e:  # noqa: BLE001
-            if len(batch) == 1:
-                # Already unbatched: the failure belongs to this caller.
-                self._respond(batch[0], None, f"generate failed: {e}")
+        # The batch serves under the first traced request's context — one
+        # representative cross-host edge per step_fn call (per-request edges
+        # would draw N identical arrows onto the same device work).
+        parent = next((r.tctx for r in batch if r.tctx is not None), None)
+        with telemetry.child_span(f"serve.batch {self._name}", parent,
+                                  requests=len(batch)):
+            t_asm = time.monotonic()
+            prompts = np.concatenate([r.prompt for r in batch], axis=0)
+            n = prompts.shape[0]
+            if self._pad_buckets and n < self._batch_size:
+                b = bucket(n, self._batch_size)
+                if n < b:
+                    pad = np.repeat(prompts[-1:], b - n, axis=0)
+                    prompts = np.concatenate([prompts, pad], axis=0)
+                    self._stats["bucket_pad_rows"] += b - n
+            t0 = time.monotonic()
+            _M_PHASE.observe(t0 - t_asm, phase="batch_assembly")
+            try:
+                out = np.asarray(self._step_fn(self._params, prompts))[:n]
+            except Exception as e:  # noqa: BLE001
+                if len(batch) == 1:
+                    # Already unbatched: the failure belongs to this caller.
+                    self._respond(batch[0], None, f"generate failed: {e}")
+                    return
+                # Blast-radius isolation: one poisoned request must not error
+                # every caller stacked into its batch — retry once, unbatched,
+                # so only the offender fails.
+                self._stats["batch_retries"] += 1
+                _M_BATCH_RETRY.inc()
+                for req in batch:
+                    rows = req.prompt.shape[0]
+                    try:
+                        o = np.asarray(self._step_fn(self._params, req.prompt))[:rows]
+                    except Exception as e2:  # noqa: BLE001
+                        self._respond(req, None, f"generate failed: {e2}")
+                        continue
+                    self._respond(req, o[0] if req.single else o, None)
                 return
-            # Blast-radius isolation: one poisoned request must not error
-            # every caller stacked into its batch — retry once, unbatched,
-            # so only the offender fails.
-            self._stats["batch_retries"] += 1
-            _M_BATCH_RETRY.inc()
+            dt = time.monotonic() - t0
+            self.admission.note_service(dt)
+            _M_PHASE.observe(dt, phase="device")
+            t_reply = time.monotonic()
+            i = 0
             for req in batch:
                 rows = req.prompt.shape[0]
-                try:
-                    o = np.asarray(self._step_fn(self._params, req.prompt))[:rows]
-                except Exception as e2:  # noqa: BLE001
-                    self._respond(req, None, f"generate failed: {e2}")
-                    continue
-                self._respond(req, o[0] if req.single else o, None)
-            return
-        self.admission.note_service(time.monotonic() - t0)
-        i = 0
-        for req in batch:
-            rows = req.prompt.shape[0]
-            part = out[i:i + rows]
-            i += rows
-            self._respond(req, part[0] if req.single else part, None)
+                part = out[i:i + rows]
+                i += rows
+                self._respond(req, part[0] if req.single else part, None)
+            _M_PHASE.observe(time.monotonic() - t_reply, phase="reply")
 
     async def loop(self, total=None) -> int:
         """Serve until ``total`` requests have been answered (None =
@@ -943,6 +973,14 @@ class ServeClient:
             "overloaded": set(),
             "future": Future(),
             "replica": None,
+            # Root of the request's distributed trace.  The span itself is
+            # recorded at completion (retries outlive this stack frame);
+            # each attempt attaches the context so its rpc.call — and the
+            # replica's handler spans across the wire — parent under it.
+            "tctx": _tracing.TraceContext(
+                _tracing.new_trace_id(), _tracing.new_span_id()
+            ),
+            "t0_ns": time.perf_counter_ns(),
         }
         self._attempt(st)
         return st["future"]
@@ -953,7 +991,22 @@ class ServeClient:
 
     def _fail(self, st: Dict[str, Any], exc: RpcError, outcome: str) -> None:
         self._stats[outcome] = self._stats.get(outcome, 0) + 1
+        self._record_request_span(st, outcome)
         st["future"].set_exception(exc)
+
+    def _record_request_span(self, st: Dict[str, Any], outcome: str) -> None:
+        ctx = st.get("tctx")
+        if ctx is None:
+            return
+        _tracing.get_tracer().record(
+            "serve.request",
+            st["t0_ns"],
+            time.perf_counter_ns() - st["t0_ns"],
+            trace_id=ctx.trace_id,
+            span_id=ctx.span_id,
+            args={"req_id": st["id"], "outcome": outcome,
+                  "attempts": st["attempt"] + 1},
+        )
 
     def _later(self, st: Dict[str, Any], delay: float) -> None:
         if self._closed.is_set():
@@ -1003,7 +1056,8 @@ class ServeClient:
             self._outstanding[replica] = self._outstanding.get(replica, 0) + 1
         kwargs = ({"deadline_s": remaining, "req_id": st["id"]}
                   if self.metadata else {})
-        fut = self._rpc.async_(replica, self.fn, *st["args"], **kwargs)
+        with _tracing.attach_context(st["tctx"]):
+            fut = self._rpc.async_(replica, self.fn, *st["args"], **kwargs)
         # Per-attempt watchdog: the engine's own timeout is per-Rpc and far
         # too slow for failover; cancelling routes through the same done
         # callback as a transport error.
@@ -1026,6 +1080,7 @@ class ServeClient:
         exc = fut.exception()
         if exc is None:
             self._stats["ok"] += 1
+            self._record_request_span(st, "ok")
             st["future"].set_result(fut._result)
             return
         if is_overload_error(exc):
@@ -1084,6 +1139,8 @@ class ServeReplica:
                  role: str = "replica", publisher: Optional[str] = None,
                  model_channel: str = "model", poll_interval: float = 0.5):
         self._rpc = rpc
+        # Every replica is scrapable/profilable by the cohort aggregator.
+        telemetry.install_rpc_handlers(rpc)
         self.service = ServeService(
             rpc, step_fn, params, name=name, version=version,
             batch_size=batch_size, dynamic_batching=dynamic_batching,

@@ -56,15 +56,35 @@ from .metrics import (  # noqa: F401
     DEFAULT_TIME_BUCKETS,
     get_registry,
 )
-from .tracing import Span, Tracer, get_tracer, span  # noqa: F401
+from .tracing import (  # noqa: F401
+    Span,
+    TraceContext,
+    Tracer,
+    attach_context,
+    child_span,
+    current_context,
+    decode_context,
+    encode_context,
+    get_tracer,
+    root_span,
+    span,
+)
 from .exporters import (  # noqa: F401
     JsonlSnapshotter,
     dump_diagnostics,
     install_signal_dump,
     prometheus_text,
+    read_snapshot_tail,
     serve_http,
 )
+from .flightrec import (  # noqa: F401
+    FlightRecorder,
+    flight_event,
+    get_flight_recorder,
+)
 from .cohort import CohortCounters  # noqa: F401
+from .aggregator import CohortAggregator, install_rpc_handlers  # noqa: F401
+from . import profiling  # noqa: F401
 from .recovery import (  # noqa: F401
     RECOVERY_BUCKETS,
     RECOVERY_PHASES,
@@ -73,24 +93,38 @@ from .recovery import (  # noqa: F401
 )
 
 __all__ = [
+    "CohortAggregator",
     "CohortCounters",
+    "install_rpc_handlers",
+    "profiling",
     "RECOVERY_BUCKETS",
     "RECOVERY_PHASES",
     "observe_phase",
     "recovery_histogram",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JsonlSnapshotter",
     "Registry",
     "Span",
+    "TraceContext",
     "Tracer",
+    "attach_context",
+    "child_span",
+    "current_context",
+    "decode_context",
     "dump_diagnostics",
+    "encode_context",
+    "flight_event",
     "flush",
+    "get_flight_recorder",
     "get_registry",
     "get_tracer",
     "init_from_env",
     "install_signal_dump",
+    "read_snapshot_tail",
+    "root_span",
     "shutdown",
     "prometheus_text",
     "serve_http",

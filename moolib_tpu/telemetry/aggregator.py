@@ -1,0 +1,293 @@
+"""Cohort metrics aggregator: one fused view of every peer's registry.
+
+Before this module the only cross-process metrics view was the autoscaler
+tailing ``telemetry.jsonl`` files — which requires a shared filesystem and
+a supervisor that spawned every peer.  The aggregator instead rides the
+broker's discovery surface: ``__broker_list`` names the live cohort
+(contributing members AND observers — serving replicas, standbys), each of
+which answers a ``__telemetry_snapshot`` RPC with the same JSON row shape
+the :class:`~moolib_tpu.telemetry.exporters.JsonlSnapshotter` writes.  The
+fused result exposes per-peer-labeled Prometheus text / JSONL and feeds the
+autoscaler's :class:`~moolib_tpu.autoscaler.PeerSample` pipeline over RPC,
+so fleet supervision works across hosts.
+
+Wiring: every peer that should be scrapable calls
+:func:`install_rpc_handlers` on its ``Rpc`` (the serving replica and the
+example train loops do this by default); the aggregating process connects
+an ``Rpc`` to the broker and polls :meth:`CohortAggregator.scrape`.  A peer
+dying mid-scrape costs one per-peer timeout and an
+``aggregator_scrape_errors_total`` increment — never the scrape.
+
+The ``__telemetry_profile`` handler makes every scrapable peer remotely
+profilable: ``{"action": "start"|"stop"|"window"}`` opens/closes an
+on-demand ``jax.profiler`` device-trace window
+(:mod:`moolib_tpu.telemetry.profiling`) aligned to host span timestamps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from . import exporters, metrics, tracing
+
+__all__ = [
+    "install_rpc_handlers",
+    "CohortAggregator",
+    "fused_prometheus_text",
+]
+
+_REG = metrics.get_registry()
+_M_SCRAPES = _REG.counter(
+    "aggregator_scrapes_total", "cohort scrape rounds completed"
+)
+_M_SCRAPE_ERRORS = _REG.counter(
+    "aggregator_scrape_errors_total",
+    "per-peer snapshot pulls that failed or timed out",
+    ("peer",),
+)
+_M_PEERS = _REG.gauge(
+    "aggregator_peers", "peers in the last fused snapshot"
+)
+
+_INSTALLED_FLAG = "_moolib_telemetry_handlers"
+
+
+def install_rpc_handlers(
+    rpc,
+    registry: Optional[metrics.Registry] = None,
+    tracer: Optional[tracing.Tracer] = None,
+) -> bool:
+    """Define the ``__telemetry_*`` endpoints on ``rpc`` (idempotent):
+
+    - ``__telemetry_snapshot()`` → ``{"time", "pid", "name", "metrics"}`` —
+      the JSONL row shape, so :func:`moolib_tpu.autoscaler.sample_from_snapshot`
+      consumes it unchanged.
+    - ``__telemetry_trace()`` → this peer's Chrome trace dict (feed files to
+      ``scripts/trace_merge.py``).
+    - ``__telemetry_profile(action, logdir=None, seconds=None)`` → on-demand
+      device profiling (:func:`moolib_tpu.telemetry.profiling.handle_command`).
+
+    Returns False when the endpoints were already installed on this ``rpc``.
+    """
+    if getattr(rpc, _INSTALLED_FLAG, False):
+        return False
+    reg = registry or metrics.get_registry()
+    tr = tracer or tracing.get_tracer()
+
+    def _snapshot():
+        return {
+            "time": time.time(),
+            "pid": os.getpid(),
+            "name": rpc.get_name(),
+            "metrics": reg.snapshot(),
+        }
+
+    def _trace():
+        return tr.chrome_trace()
+
+    def _profile(action: str, logdir: Optional[str] = None, seconds: Optional[float] = None):
+        from . import profiling
+
+        return profiling.handle_command(action, logdir=logdir, seconds=seconds)
+
+    rpc.define("__telemetry_snapshot", _snapshot)
+    rpc.define("__telemetry_trace", _trace)
+    rpc.define("__telemetry_profile", _profile)
+    setattr(rpc, _INSTALLED_FLAG, True)
+    return True
+
+
+class CohortAggregator:
+    """Pull every broker-discovered peer's registry snapshot over RPC and
+    fuse them into one per-peer-labeled view.
+
+    ``rpc`` must be connected (or connectable by gossip) to at least one of
+    ``brokers`` — the same client contract as ``ServeClient``.  Peers are
+    reached by their broker-advertised names through ``__moolib_find_peer``
+    gossip; no address bookkeeping here.
+    """
+
+    def __init__(
+        self,
+        rpc,
+        brokers: Union[str, Sequence[str]],
+        group: str = "default",
+        scrape_timeout: float = 2.0,
+        include_observers: bool = True,
+        include_self: bool = False,
+    ):
+        self._rpc = rpc
+        self._brokers = [brokers] if isinstance(brokers, str) else list(brokers)
+        if not self._brokers:
+            raise ValueError("need at least one broker peer name")
+        self._group = group
+        self._timeout = float(scrape_timeout)
+        self._include_observers = include_observers
+        self._include_self = include_self
+        self._lock = threading.Lock()
+        self._roster: Dict[str, str] = {}  # name -> role
+        self._fused: Dict[str, Any] = {"time": 0.0, "peers": {}, "errors": {}}
+        self._last_steps: Dict[str, tuple] = {}  # peer -> (time, steps)
+
+    # ------------------------------------------------------------ discovery
+    def discover(self) -> Dict[str, str]:
+        """Refresh the roster from the first broker that answers
+        ``__broker_list``; on total silence the last roster is kept (a
+        scrape through a broker failover degrades, it doesn't blank)."""
+        for broker in self._brokers:
+            try:
+                listing = self._rpc.async_(
+                    broker, "__broker_list", self._group
+                ).result(self._timeout)
+            except Exception:  # noqa: BLE001 — next broker owns this
+                continue
+            if not isinstance(listing, dict):
+                continue
+            roster: Dict[str, str] = {}
+            for m in listing.get("members") or ():
+                roster[m] = "member"
+            if self._include_observers:
+                for name, role in (listing.get("observers") or {}).items():
+                    roster.setdefault(name, role or "observer")
+            if not self._include_self:
+                roster.pop(self._rpc.get_name(), None)
+            with self._lock:
+                self._roster = roster
+            return dict(roster)
+        with self._lock:
+            return dict(self._roster)
+
+    # -------------------------------------------------------------- scraping
+    def scrape(self) -> Dict[str, Any]:
+        """One fused pull: discover, fan out ``__telemetry_snapshot`` to
+        every peer concurrently, collect under a shared deadline.  Returns
+        (and caches) ``{"time", "peers": {name: row}, "errors": {name:
+        reason}}``; a peer that died mid-scrape lands in ``errors`` and
+        costs at most the scrape timeout in wall clock."""
+        roster = self.discover()
+        futures = {
+            name: self._rpc.async_(name, "__telemetry_snapshot") for name in roster
+        }
+        deadline = time.monotonic() + self._timeout
+        peers: Dict[str, Any] = {}
+        errors: Dict[str, str] = {}
+        for name, fut in futures.items():
+            try:
+                row = fut.result(max(0.05, deadline - time.monotonic()))
+            except Exception as e:  # noqa: BLE001 — per-peer failure isolated
+                fut.cancel()
+                errors[name] = str(e) or type(e).__name__
+                _M_SCRAPE_ERRORS.inc(peer=name)
+                continue
+            if isinstance(row, dict) and "metrics" in row:
+                row.setdefault("name", name)
+                row["role"] = roster.get(name, "member")
+                peers[name] = row
+            else:
+                errors[name] = "malformed snapshot"
+                _M_SCRAPE_ERRORS.inc(peer=name)
+        fused = {"time": time.time(), "peers": peers, "errors": errors}
+        with self._lock:
+            self._fused = fused
+        _M_SCRAPES.inc()
+        _M_PEERS.set(len(peers))
+        return fused
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The last fused scrape (without pulling again)."""
+        with self._lock:
+            return self._fused
+
+    # ------------------------------------------------------------ exposition
+    def prometheus_text(self) -> str:
+        """The last fused scrape as Prometheus text with a ``peer`` label
+        on every series."""
+        with self._lock:
+            peers = self._fused["peers"]
+        return fused_prometheus_text(peers)
+
+    def write_jsonl(self, path: str) -> None:
+        """Append the last fused scrape as one JSON line (the cohort-level
+        analogue of the per-process ``telemetry.jsonl``)."""
+        with self._lock:
+            fused = self._fused
+        with open(path, "a") as f:
+            f.write(json.dumps(fused) + "\n")
+
+    # ------------------------------------------------------------ autoscaler
+    def peer_samples(self) -> List[Any]:
+        """The last fused scrape as :class:`moolib_tpu.autoscaler.PeerSample`
+        rows, with step rates from successive scrape deltas — the RPC-pull
+        counterpart of ``SubprocessFleet.samples()``."""
+        from .. import autoscaler  # deferred: autoscaler imports telemetry
+
+        with self._lock:
+            peers = dict(self._fused["peers"])
+        out = []
+        for name, row in peers.items():
+            s = autoscaler.sample_from_snapshot(name, row)
+            if s.steps is not None:
+                prev = self._last_steps.get(name)
+                if prev is not None and s.time > prev[0]:
+                    s.step_rate = (s.steps - prev[1]) / (s.time - prev[0])
+                self._last_steps[name] = (s.time, s.steps)
+            out.append(s)
+        return out
+
+
+def fused_prometheus_text(peers: Dict[str, Dict[str, Any]]) -> str:
+    """Merge per-peer registry snapshots (``{peer: {"metrics": ...}}`` rows)
+    into one Prometheus exposition with a ``peer`` label on every series."""
+    # family name -> {"kind", "help", "buckets"?, "series": [(labels, value)]}
+    fams: Dict[str, Dict[str, Any]] = {}
+    for peer in sorted(peers):
+        met = peers[peer].get("metrics") or {}
+        for name in sorted(met):
+            fam = met[name]
+            dst = fams.setdefault(
+                name,
+                {
+                    "kind": fam.get("kind", "gauge"),
+                    "help": fam.get("help", ""),
+                    "buckets": fam.get("buckets"),
+                    "series": [],
+                },
+            )
+            for s in fam.get("series", ()):
+                labels = dict(s.get("labels") or {})
+                labels["peer"] = peer
+                dst["series"].append((labels, s.get("value")))
+    lines: List[str] = []
+    fmt_labels = exporters._fmt_labels
+    fmt_value = exporters._fmt_value
+    for name in sorted(fams):
+        fam = fams[name]
+        if fam["help"]:
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {fam['kind']}")
+        if fam["kind"] == "histogram":
+            bounds = fam.get("buckets") or ()
+            for labels, h in fam["series"]:
+                if not isinstance(h, dict):
+                    continue
+                cum = 0
+                for bound, n in zip(bounds, h.get("buckets", ())):
+                    cum += n
+                    lines.append(
+                        f"{name}_bucket{fmt_labels(labels, ('le', fmt_value(bound)))} {cum}"
+                    )
+                hb = h.get("buckets", ())
+                cum += hb[-1] if len(hb) > len(bounds) else 0
+                lines.append(f"{name}_bucket{fmt_labels(labels, ('le', '+Inf'))} {cum}")
+                lines.append(f"{name}_sum{fmt_labels(labels)} {fmt_value(h.get('sum', 0.0))}")
+                lines.append(f"{name}_count{fmt_labels(labels)} {h.get('count', 0)}")
+        else:
+            for labels, v in fam["series"]:
+                if v is None:
+                    continue
+                lines.append(f"{name}{fmt_labels(labels)} {fmt_value(v)}")
+    return "\n".join(lines) + "\n"

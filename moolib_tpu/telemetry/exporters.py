@@ -31,6 +31,7 @@ from typing import Optional
 
 from .metrics import Registry, get_registry
 from .tracing import Tracer, get_tracer
+from .flightrec import format_tail as _flight_tail
 
 __all__ = [
     "prometheus_text",
@@ -38,6 +39,7 @@ __all__ = [
     "JsonlSnapshotter",
     "dump_diagnostics",
     "install_signal_dump",
+    "read_snapshot_tail",
 ]
 
 
@@ -188,6 +190,34 @@ class JsonlSnapshotter:
         self.flush()
 
 
+def read_snapshot_tail(path: str, max_bytes: int = 1 << 20):
+    """Last parseable JSONL snapshot in ``path`` (None if absent/empty) —
+    the reader counterpart of :class:`JsonlSnapshotter`, shared by the
+    autoscaler's file-tail sampling and the cohort aggregator's fallbacks.
+    Reads only the file tail: snapshot files grow for the process lifetime,
+    and a half-written final line (snapshotter racing us) falls back to the
+    previous complete one."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - max_bytes))
+            tail = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snap = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(snap, dict) and "metrics" in snap:
+            return snap
+    return None
+
+
 def dump_diagnostics(
     reason: str = "",
     run_dir: Optional[str] = None,
@@ -212,6 +242,9 @@ def dump_diagnostics(
         for tid, frame in sys._current_frames().items():
             parts.append(f"--- thread {names.get(tid, '?')!r} (ident {tid}) ---\n")
             parts.append("".join(traceback.format_stack(frame)))
+    # The flight recorder's recent-event tail: what the process believed
+    # was happening right before the dump (watchdog expiry, crash, signal).
+    parts.append(_flight_tail())
     parts.append("--- end telemetry dump ---\n")
     out.write("".join(parts))
     try:

@@ -24,6 +24,7 @@ documented in docs/TELEMETRY.md; add new ones there.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -51,6 +52,20 @@ DEFAULT_SIZE_BUCKETS = (
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _max_labelsets() -> int:
+    """Per-family cardinality cap.  Unbounded label values (a peer name
+    used as a label by a process that churns peers forever) would grow the
+    registry — and every exporter payload — without bound; past the cap new
+    label sets collapse into one hidden overflow child and
+    ``telemetry_dropped_labelsets_total`` counts the drops.  Read per
+    overflow decision (not hot: the check only runs when a *new* child
+    would be created), so tests and operators can retune it live."""
+    try:
+        return max(1, int(os.environ.get("MOOLIB_TELEMETRY_MAX_LABELSETS", "1000")))
+    except ValueError:
+        return 1000
 
 
 class _Child:
@@ -157,6 +172,8 @@ class _Metric:
         self.labelnames = tuple(labelnames)
         self._lock = threading.RLock()  # reentrant: see _Child
         self._children: Dict[Tuple[Tuple[str, str], ...], object] = {}
+        self._registry: Optional["Registry"] = None  # set by Registry._register
+        self._overflow = None  # shared sink for label sets past the cap
 
     def _new_child(self):
         raise NotImplementedError
@@ -164,7 +181,13 @@ class _Metric:
     def labels(self, **labels):
         """Bind (and memoize) the child for one label set.  Unknown or
         missing label names are an error — mismatched label sets would
-        render as distinct series of the same family and break aggregation."""
+        render as distinct series of the same family and break aggregation.
+
+        Cardinality guard: once a family holds :func:`_max_labelsets`
+        distinct label sets, further NEW sets all bind one shared overflow
+        child that is never exported, and each such call increments
+        ``telemetry_dropped_labelsets_total`` — bounding memory and export
+        size while keeping writers crash-free."""
         if set(labels) != set(self.labelnames):
             raise ValueError(
                 f"metric {self.name!r} takes labels {self.labelnames}, got "
@@ -172,9 +195,30 @@ class _Metric:
             )
         key = _label_key(labels)
         child = self._children.get(key)
-        if child is None:
-            with self._lock:
-                child = self._children.setdefault(key, self._new_child())
+        if child is not None:
+            return child
+        dropped = False
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if self.labelnames and len(self._children) >= _max_labelsets():
+                    if self._overflow is None:
+                        self._overflow = self._new_child()
+                    child = self._overflow
+                    dropped = True
+                else:
+                    child = self._children.setdefault(key, self._new_child())
+        if dropped:
+            # Outside the family lock: the drop counter is another family in
+            # the same registry; nesting its child lock under ours would
+            # order locks across families.
+            reg = self._registry
+            if reg is not None:
+                reg.counter(
+                    "telemetry_dropped_labelsets_total",
+                    "new label sets dropped by the per-family cardinality "
+                    "cap (MOOLIB_TELEMETRY_MAX_LABELSETS)",
+                ).inc()
         return child
 
     def _default(self):
@@ -261,6 +305,7 @@ class Registry:
                     )
                 return m
             m = cls(name, help, labelnames, **kw)
+            m._registry = self
             self._metrics[name] = m
             return m
 

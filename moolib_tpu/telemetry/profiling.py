@@ -1,0 +1,174 @@
+"""On-demand ``jax.profiler`` device-trace windows, RPC- or signal-driven.
+
+A device profile is the one observability surface you cannot leave running:
+it costs memory and perturbs timing.  This module makes it a *window* you
+open remotely on a live process — over the ``__telemetry_profile`` RPC
+every scrapable peer defines (:func:`moolib_tpu.telemetry.aggregator
+.install_rpc_handlers`), or a local signal toggle — and closes either
+explicitly or after a timed duration.
+
+Each window records a ``device_profile`` span in the host tracer when it
+closes, with the same ``perf_counter_ns`` clock every other span uses, so a
+merged cohort timeline (``scripts/trace_merge.py``) shows exactly which
+host-side work the device capture brackets; the returned anchors
+(``unix_time_ns``/``perf_counter_ns`` at start) let offline tooling align
+the XLA trace the same way.
+
+``jax`` is imported lazily inside the start path only — processes that
+never profile (env workers, the broker) never pay the import, and a box
+without jax degrades to an error dict instead of an exception.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import threading
+import time
+from typing import Optional
+
+from . import tracing
+
+__all__ = [
+    "start_device_trace",
+    "stop_device_trace",
+    "profile_status",
+    "handle_command",
+    "install_signal_toggle",
+]
+
+_lock = threading.Lock()
+_active: Optional[dict] = None  # {"logdir", "t0_ns", "unix_ns", "timer"}
+
+DEFAULT_WINDOW_S = 3.0
+
+
+def _default_logdir() -> str:
+    base = os.environ.get("MOOLIB_PROFILE_DIR") or os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "moolib_profiles"
+    )
+    return os.path.join(base, f"pid{os.getpid()}-{int(time.time())}")
+
+
+def start_device_trace(logdir: Optional[str] = None) -> dict:
+    """Open a ``jax.profiler`` trace window.  Returns ``{"ok": True,
+    "logdir", "unix_time_ns", "perf_counter_ns"}`` (the anchors match the
+    host tracer's clock) or ``{"ok": False, "error"}`` — never raises, so
+    the RPC handler can always serialize the answer."""
+    global _active
+    with _lock:
+        if _active is not None:
+            return {"ok": False, "error": "profile already active", "logdir": _active["logdir"]}
+        logdir = logdir or _default_logdir()
+        try:
+            import jax
+
+            os.makedirs(logdir, exist_ok=True)
+            jax.profiler.start_trace(logdir)
+        except ImportError:
+            return {"ok": False, "error": "jax unavailable"}
+        except Exception as e:  # noqa: BLE001 — report, don't kill the peer
+            return {"ok": False, "error": f"start_trace failed: {e}"}
+        _active = {
+            "logdir": logdir,
+            "t0_ns": time.perf_counter_ns(),
+            "unix_ns": time.time_ns(),
+            "timer": None,
+        }
+        tracing.get_tracer().event("device_profile.start", logdir=logdir)
+        return {
+            "ok": True,
+            "logdir": logdir,
+            "unix_time_ns": _active["unix_ns"],
+            "perf_counter_ns": _active["t0_ns"],
+        }
+
+
+def stop_device_trace() -> dict:
+    """Close the active window; records the ``device_profile`` host span
+    covering it."""
+    global _active
+    with _lock:
+        if _active is None:
+            return {"ok": False, "error": "no profile active"}
+        state, _active = _active, None
+        timer = state.get("timer")
+        if timer is not None:
+            timer.cancel()
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except ImportError:
+            return {"ok": False, "error": "jax unavailable"}
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "error": f"stop_trace failed: {e}", "logdir": state["logdir"]}
+    dur_ns = time.perf_counter_ns() - state["t0_ns"]
+    tracing.get_tracer().record(
+        "device_profile",
+        state["t0_ns"],
+        dur_ns,
+        args={"logdir": state["logdir"]},
+    )
+    return {"ok": True, "logdir": state["logdir"], "duration_s": dur_ns / 1e9}
+
+
+def profile_status() -> dict:
+    with _lock:
+        if _active is None:
+            return {"active": False}
+        return {"active": True, "logdir": _active["logdir"]}
+
+
+def handle_command(
+    action: str, logdir: Optional[str] = None, seconds: Optional[float] = None
+) -> dict:
+    """The ``__telemetry_profile`` RPC surface:
+
+    - ``"start"`` — open a window (until an explicit stop).
+    - ``"stop"`` — close it.
+    - ``"status"`` — is one open, and where.
+    - ``"window"`` — open and auto-close after ``seconds``
+      (default :data:`DEFAULT_WINDOW_S`); the follow-up stop runs on a
+      daemon timer, so the requesting client doesn't have to stay alive.
+    """
+    if action == "start":
+        return start_device_trace(logdir)
+    if action == "stop":
+        return stop_device_trace()
+    if action == "status":
+        return profile_status()
+    if action == "window":
+        res = start_device_trace(logdir)
+        if not res.get("ok"):
+            return res
+        delay = DEFAULT_WINDOW_S if seconds is None else max(0.1, float(seconds))
+        timer = threading.Timer(delay, stop_device_trace)
+        timer.daemon = True
+        with _lock:
+            if _active is not None:
+                _active["timer"] = timer
+        timer.start()
+        res["window_s"] = delay
+        return res
+    return {"ok": False, "error": f"unknown action {action!r}"}
+
+
+def install_signal_toggle(
+    signum: int = _signal.SIGUSR2, logdir: Optional[str] = None
+) -> bool:
+    """Toggle a device-trace window on ``signum`` (default SIGUSR2 — the
+    SIGUSR1 slot belongs to the diagnostics dump).  Main thread only;
+    returns False when the handler could not be installed."""
+
+    def _toggle(sig, frame):
+        if profile_status()["active"]:
+            stop_device_trace()
+        else:
+            start_device_trace(logdir)
+
+    try:
+        _signal.signal(signum, _toggle)
+    except (ValueError, OSError):
+        return False
+    return True

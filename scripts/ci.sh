@@ -53,6 +53,15 @@ fi
 step "telemetry tests"
 python -m pytest tests/test_telemetry.py tests/test_profiling.py -q || fail=1
 
+step "distributed tracing tests (context propagation, sibling resend spans under frame faults)"
+python -m pytest tests/test_tracing_distributed.py -q || fail=1
+
+step "trace-merge smoke (multi-process allreduce + serve request -> one merged Chrome trace)"
+# Real subprocesses prove the context actually rides the wire: the merged
+# timeline must validate as JSON with >= 1 cross-process parent/child span
+# edge per phase (docs/TELEMETRY.md "Distributed tracing").
+python scripts/trace_smoke.py --smoke || fail=1
+
 step "fault-domain supervision tests (envpool respawn, watchdog, checkpoint integrity)"
 python -m pytest tests/test_envpool_supervision.py tests/test_watchdog.py \
   tests/test_checkpoint_corrupt.py -q || fail=1

@@ -169,6 +169,131 @@ def test_tracer_ring_is_bounded():
     assert len(names) == 8 and names[-1] == "s19"
 
 
+# -------------------------------------------------------- distributed context
+def test_trace_context_wire_roundtrip():
+    from moolib_tpu.telemetry.tracing import new_span_id, new_trace_id
+
+    ctx = telemetry.TraceContext(new_trace_id(), new_span_id())
+    data = telemetry.encode_context(ctx)
+    assert len(data) == 24
+    assert telemetry.decode_context(data) == ctx
+    # Degraded inputs decode to None, never raise.
+    assert telemetry.encode_context(None) == b""
+    assert telemetry.decode_context(b"") is None
+    assert telemetry.decode_context(b"\x00" * 24) is None
+    assert telemetry.decode_context(b"short") is None
+
+
+def test_attach_context_is_ambient_but_records_nothing():
+    from moolib_tpu.telemetry.tracing import new_span_id, new_trace_id
+
+    ctx = telemetry.TraceContext(new_trace_id(), new_span_id())
+    assert telemetry.current_context() is None
+    with telemetry.attach_context(ctx):
+        assert telemetry.current_context() is ctx
+        with telemetry.span("attached_child"):
+            pass
+    assert telemetry.current_context() is None
+    spans = [
+        s for s in telemetry.get_tracer().spans() if s.trace_id == ctx.trace_id
+    ]
+    # Only the span opened inside recorded; the attach itself left no span.
+    assert [s.name for s in spans] == ["attached_child"]
+    assert spans[0].parent_id == ctx.span_id
+    # None is a no-op.
+    with telemetry.attach_context(None):
+        assert telemetry.current_context() is None
+
+
+def test_root_and_child_span_link_up():
+    with telemetry.root_span("op_root") as root:
+        ctx = root.context
+        assert ctx is not None and telemetry.current_context() is ctx
+    with telemetry.child_span("op_remote", ctx):
+        pass
+    spans = {
+        s.name: s
+        for s in telemetry.get_tracer().spans()
+        if s.trace_id == ctx.trace_id
+    }
+    assert spans["op_root"].parent_id is None
+    assert spans["op_remote"].parent_id == ctx.span_id
+    assert spans["op_remote"].span_id != ctx.span_id
+
+
+# --------------------------------------------------------- cardinality guard
+def test_cardinality_guard_caps_labelsets(reg, monkeypatch):
+    monkeypatch.setenv("MOOLIB_TELEMETRY_MAX_LABELSETS", "3")
+    c = reg.counter("fanout_total", "", ("shard",))
+    for k in range(5):
+        c.inc(1, shard=f"s{k}")
+    vals = reg.counter_values()
+    exported = [k for k in vals if k.startswith("fanout_total{")]
+    # Cap holds: 3 real children exported; the 2 overflow label sets share
+    # one hidden child that never reaches the exposition.
+    assert len(exported) == 3
+    assert sum(vals[k] for k in exported) == 3
+    assert vals["telemetry_dropped_labelsets_total"] == 2
+    # Existing label sets keep working past the cap.
+    c.inc(1, shard="s0")
+    assert reg.counter_values()['fanout_total{shard="s0"}'] == 2
+    # Unlabeled families are exempt from the guard.
+    reg.counter("plain_total").inc()
+    assert reg.counter_values()["plain_total"] == 1
+
+
+# ------------------------------------------------------------ flight recorder
+def test_flight_recorder_ring_and_tail():
+    rec = telemetry.FlightRecorder(capacity=4)
+    for k in range(6):
+        rec.event("evt", k=k)
+    evs = rec.events()
+    assert len(evs) == 4
+    assert evs[-1][1] == "evt" and evs[-1][2] == {"k": 5}
+    tail = rec.format_tail(2)
+    assert "last 2 events" in tail and "evt k=5" in tail
+    rec.clear()
+    assert "empty" in rec.format_tail()
+
+
+def test_flight_event_mirrors_into_tracer():
+    telemetry.flight_event("test.flight_marker", q=1)
+    assert any(
+        e[1] == "test.flight_marker"
+        for e in telemetry.get_flight_recorder().events()
+    )
+    # Mirrored as an instant event on the Chrome timeline.
+    assert any(
+        s.name == "test.flight_marker" and s.dur_ns is None
+        for s in telemetry.get_tracer().spans()
+    )
+
+
+def test_dump_diagnostics_includes_flight_tail(reg):
+    import io
+
+    telemetry.flight_event("diag.marker", x=42)
+    buf = io.StringIO()
+    telemetry.dump_diagnostics(reason="test", registry=reg, file=buf, stacks=False)
+    out = buf.getvalue()
+    assert "flight recorder" in out and "diag.marker" in out
+
+
+def test_read_snapshot_tail_shared_with_autoscaler(tmp_path, reg):
+    from moolib_tpu import autoscaler
+
+    # One implementation: the autoscaler's file-tail sampler re-exports the
+    # telemetry reader (it moved in with the aggregator).
+    assert autoscaler.read_snapshot_tail is telemetry.read_snapshot_tail
+    reg.counter("tailed_total").inc(3)
+    snap = telemetry.JsonlSnapshotter(str(tmp_path), interval=3600, registry=reg)
+    snap.snapshot_now()
+    snap.close()
+    row = telemetry.read_snapshot_tail(str(tmp_path / "telemetry.jsonl"))
+    assert row["metrics"]["tailed_total"]["series"][0]["value"] == 3
+    assert telemetry.read_snapshot_tail(str(tmp_path / "missing.jsonl")) is None
+
+
 # -------------------------------------------------------------------- cohort
 def test_cohort_counters_delta_protocol(reg):
     c = reg.counter("work_total")
@@ -334,3 +459,94 @@ def test_queue_stats_readable_through_registry():
     text = telemetry.prometheus_text()
     assert 'rpc_queue_items_total{queue="tele_q"} 1' in text
     assert 'rpc_queue_wait_seconds_count{queue="tele_q"} 1' in text
+
+
+# -------------------------------------------------------- cohort aggregation
+def test_telemetry_rpc_handlers_shape():
+    """install_rpc_handlers exposes snapshot/trace endpoints with the JSONL
+    row shape the autoscaler already consumes — and is idempotent."""
+    from moolib_tpu import Rpc
+
+    a, b = Rpc(), Rpc()
+    a.set_name("scrape-a")
+    b.set_name("scrape-b")
+    assert telemetry.install_rpc_handlers(b)
+    assert not telemetry.install_rpc_handlers(b)  # second install is a no-op
+    b.listen("127.0.0.1:0")
+    addr = next(x for x in b._listen_addrs if x.startswith("tcp://127"))
+    a.connect(addr)
+    try:
+        row = a.sync("scrape-b", "__telemetry_snapshot")
+        assert row["name"] == "scrape-b" and row["pid"] == os.getpid()
+        assert isinstance(row["metrics"], dict)
+        trace = a.sync("scrape-b", "__telemetry_trace")
+        assert "traceEvents" in trace and "clock_sync" in trace["metadata"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cohort_aggregator_survives_peer_kill(free_port):
+    """The acceptance scenario: a broker-discovered two-peer cohort scrapes
+    clean; killing one peer mid-flight costs that peer an entry in
+    ``errors`` (plus a scrape timeout), never the scrape."""
+    import numpy as np
+
+    from moolib_tpu import Accumulator, Broker, Rpc
+
+    # The group layer pings the peer literally named "broker" by default.
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(f"127.0.0.1:{free_port}")
+    accs = []
+    for i in range(2):
+        acc = Accumulator("aggtele", {"w": np.zeros(2, np.float32)})
+        acc._rpc.set_name(f"agg-peer-{i}")
+        acc.listen("127.0.0.1:0")
+        acc.connect(f"127.0.0.1:{free_port}")
+        accs.append(acc)
+    agg_rpc = Rpc()
+    agg_rpc.set_name("agg-scraper")
+    agg_rpc.connect(f"127.0.0.1:{free_port}")
+
+    def pump_all(seconds, until):
+        deadline = time.time() + seconds
+        while time.time() < deadline:
+            broker.update()
+            for acc in accs:
+                acc.update()
+            if until():
+                return True
+            time.sleep(0.02)
+        return until()
+
+    try:
+        agg = telemetry.CohortAggregator(
+            agg_rpc, "broker", group="aggtele", scrape_timeout=5.0
+        )
+        # Broker discovery (not full model sync) is all a scrape needs.
+        assert pump_all(
+            60, lambda: set(agg.discover()) == {"agg-peer-0", "agg-peer-1"}
+        )
+        roster = agg.discover()
+        fused = agg.scrape()
+        assert set(fused["peers"]) == {"agg-peer-0", "agg-peer-1"}
+        assert fused["errors"] == {}
+        # The fused exposition carries a peer label on every series.
+        text = agg.prometheus_text()
+        assert 'peer="agg-peer-0"' in text and 'peer="agg-peer-1"' in text
+        # peer_samples: one row per peer for the autoscaler pipeline.
+        assert {s.name for s in agg.peer_samples()} == set(roster)
+
+        # Kill one peer; the broker roster still advertises it (no eviction
+        # pumped), so the next scrape must isolate the failure per-peer.
+        accs[1].close()
+        fused = agg.scrape()
+        assert "agg-peer-0" in fused["peers"]
+        assert "agg-peer-1" in fused["errors"]
+        assert "agg-peer-1" not in fused["peers"]
+    finally:
+        agg_rpc.close()
+        for acc in accs:
+            acc.close()
+        broker.close()

@@ -1,0 +1,194 @@
+"""Distributed trace propagation: context rides the RPC frame, handlers open
+child spans, retries show up as sibling resend spans — and the span graph
+stays well-formed (unique span ids, no orphaned parents) even when
+FrameFaults drops/duplicates request frames underneath the calls."""
+
+import random
+import time
+
+from moolib_tpu import Rpc, telemetry
+from moolib_tpu.rpc.core import KIND_REQUEST
+from moolib_tpu.testing import FrameFaults
+
+
+class _Scripted(random.Random):
+    """random.Random whose random() plays back a fixed decision sequence
+    (then passes forever) — pins FrameFaults onto an exact frame."""
+
+    def __new__(cls, seq):
+        return super().__new__(cls, 0)  # Random.__new__ hashes its arg
+
+    def __init__(self, seq):
+        super().__init__(0)
+        self._seq = list(seq)
+
+    def random(self):
+        return self._seq.pop(0) if self._seq else 1.0
+
+
+def _rpc_pair(client_name, server_name):
+    a, b = Rpc(), Rpc()
+    a.set_name(client_name)
+    b.set_name(server_name)
+    b.define("echo", lambda x: x)
+    b.listen("127.0.0.1:0")
+    addr = next(x for x in b._listen_addrs if x.startswith("tcp://127"))
+    a.connect(addr)
+    return a, b
+
+
+def _spans_for(trace_id, name=None, deadline=5.0):
+    """Poll the default tracer for spans of one trace (the client-side
+    rpc.call span is recorded from the response future's done callback,
+    which can land a beat after sync() returns)."""
+    t0 = time.monotonic()
+    while True:
+        spans = [
+            s
+            for s in telemetry.get_tracer().spans()
+            if s.trace_id == trace_id and (name is None or s.name == name)
+        ]
+        if spans or time.monotonic() - t0 > deadline:
+            return spans
+        time.sleep(0.01)
+
+
+def _assert_well_formed(spans):
+    """Span-graph invariants for one trace: unique span ids, every parent
+    id resolves to a recorded span of the same trace (no orphans)."""
+    ids = [s.span_id for s in spans if s.span_id is not None]
+    assert len(ids) == len(set(ids)), "duplicated span ids in trace"
+    id_set = set(ids)
+    for s in spans:
+        if s.parent_id is not None:
+            assert s.parent_id in id_set, f"orphaned parent on {s.name!r}"
+
+
+def test_trace_propagation_clean(free_port):
+    """One traced call yields the full causal chain in one trace: root span
+    -> rpc.call (child of root) -> rpc.recv (child of the call span, i.e.
+    the cross-process edge trace_merge stitches on)."""
+    a, b = _rpc_pair("trc-a", "trc-b")
+    try:
+        with telemetry.root_span("client.op") as root:
+            ctx = root.context
+            assert a.sync("trc-b", "echo", 7) == 7
+    finally:
+        a.close()
+        b.close()
+
+    calls = _spans_for(ctx.trace_id, "rpc.call echo")
+    assert len(calls) == 1
+    recvs = _spans_for(ctx.trace_id, "rpc.recv echo")
+    assert len(recvs) == 1
+    roots = _spans_for(ctx.trace_id, "client.op")
+    assert len(roots) == 1 and roots[0].parent_id is None
+    assert calls[0].parent_id == roots[0].span_id == ctx.span_id
+    assert recvs[0].parent_id == calls[0].span_id
+    _assert_well_formed(_spans_for(ctx.trace_id))
+
+
+def test_untraced_call_records_no_ids(free_port):
+    """A call outside any span stays id-free: no trace context rides the
+    wire, no rpc.call/rpc.recv spans enter the trace graph."""
+    tracer = telemetry.get_tracer()
+    before = len(tracer.spans())
+    a, b = _rpc_pair("unt-a", "unt-b")
+    try:
+        assert telemetry.current_context() is None
+        assert a.sync("unt-b", "echo", 3) == 3
+    finally:
+        a.close()
+        b.close()
+    new = tracer.spans()[before:]
+    assert all(s.trace_id is None for s in new if s.name.startswith("rpc."))
+
+
+def test_dropped_request_resend_is_sibling_span(free_port):
+    """Scripted drop of exactly the first request frame: poke/nack recovery
+    resends it, and the retry appears as an rpc.resend SIBLING of the
+    rpc.call span — same parent, fresh span id — never a duplicate."""
+    a, b = _rpc_pair("drop-a", "drop-b")
+    try:
+        assert a.sync("drop-b", "echo", 0) == 0  # warm: connection up
+        faults = FrameFaults(_Scripted([0.0]), drop=0.5, kinds=(KIND_REQUEST,))
+        with faults:
+            with telemetry.root_span("client.drop") as root:
+                ctx = root.context
+                assert a.sync("drop-b", "echo", 41) == 41
+        assert faults.counts["drop"] == 1
+    finally:
+        a.close()
+        b.close()
+
+    calls = _spans_for(ctx.trace_id, "rpc.call echo")
+    resends = _spans_for(ctx.trace_id, "rpc.resend echo")
+    assert len(calls) == 1 and len(resends) >= 1
+    for r in resends:
+        assert r.parent_id == calls[0].parent_id  # sibling: same parent
+        assert r.span_id != calls[0].span_id  # fresh id, no duplicate
+        assert r.args["why"] in ("nack", "blind")
+    # Exactly one handler execution despite the retry (receiver dedup).
+    assert len(_spans_for(ctx.trace_id, "rpc.recv echo")) == 1
+    _assert_well_formed(_spans_for(ctx.trace_id))
+
+
+def test_duplicated_request_dedups_to_one_recv_span(free_port):
+    """Scripted dup of the request frame: at-most-once execution on the
+    receiver means exactly one rpc.recv span — the duplicate never forks
+    the trace."""
+    a, b = _rpc_pair("dup-a", "dup-b")
+    try:
+        assert a.sync("dup-b", "echo", 0) == 0
+        faults = FrameFaults(
+            _Scripted([0.6]), drop=0.5, dup=0.4, kinds=(KIND_REQUEST,)
+        )
+        with faults:
+            with telemetry.root_span("client.dup") as root:
+                ctx = root.context
+                assert a.sync("dup-b", "echo", 13) == 13
+        assert faults.counts["dup"] == 1
+    finally:
+        a.close()
+        b.close()
+
+    assert len(_spans_for(ctx.trace_id, "rpc.call echo")) == 1
+    assert len(_spans_for(ctx.trace_id, "rpc.recv echo")) == 1
+    _assert_well_formed(_spans_for(ctx.trace_id))
+
+
+def test_fault_run_traces_stay_well_formed(free_port):
+    """Seeded FrameFaults drop/dup soak over a batch of traced calls: every
+    call still completes, and every resulting trace is a well-formed tree —
+    unique span ids, no orphaned parents, retries only ever siblings."""
+    a, b = _rpc_pair("soak-a", "soak-b")
+    trace_ids = []
+    faults = FrameFaults(
+        random.Random(1234), drop=0.25, dup=0.25, kinds=(KIND_REQUEST,)
+    )
+    try:
+        assert a.sync("soak-b", "echo", 0) == 0
+        with faults:
+            for k in range(8):
+                with telemetry.root_span("client.soak", k=k) as root:
+                    trace_ids.append(root.context.trace_id)
+                    assert a.sync("soak-b", "echo", k) == k
+        assert faults.counts["drop"] + faults.counts["dup"] > 0
+    finally:
+        a.close()
+        b.close()
+
+    saw_resend = False
+    for tid in trace_ids:
+        calls = _spans_for(tid, "rpc.call echo")
+        assert len(calls) == 1
+        assert len(_spans_for(tid, "rpc.recv echo")) >= 1
+        spans = _spans_for(tid)
+        _assert_well_formed(spans)
+        for r in (s for s in spans if s.name == "rpc.resend echo"):
+            saw_resend = True
+            assert r.parent_id == calls[0].parent_id
+            assert r.span_id != calls[0].span_id
+    # With this seed at least one request frame was dropped and recovered.
+    if faults.counts["drop"] > 0:
+        assert saw_resend

@@ -289,7 +289,8 @@ def parse_serve_qps(path):
                     row = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if (row.get("metric") in ("serve_qps", "serve_phase_breakdown")
+                if (row.get("metric") in ("serve_qps", "serve_phase_breakdown",
+                                          "serve_engine_ab")
                         or "p99_ms" in row):
                     keep.append(json.dumps(row))
     except OSError:
@@ -297,6 +298,34 @@ def parse_serve_qps(path):
     # Without at least one serve_qps row this is a closed-loop serve log,
     # not a --qps capture — let the other detectors claim it.
     return keep if any('"serve_qps"' in l for l in keep) else None
+
+
+def _qps_row_key(line):
+    """Merge key for a serve_qps section row: (metric, engine-arm,
+    qps_target).  ``serve_engine_ab`` rows carry an arm *aggregate* under
+    "engine" (a dict, not the bool flag) — they key as a single comparison
+    row that each fresh A/B capture replaces."""
+    try:
+        row = json.loads(line)
+    except json.JSONDecodeError:
+        return line
+    eng = row.get("engine")
+    eng = bool(eng) if isinstance(eng, (bool, int)) or eng is None else "ab"
+    tgt = row.get("qps_target")
+    if tgt is None:
+        tgts = row.get("qps_targets")
+        tgt = tuple(tgts) if isinstance(tgts, list) else None
+    return (row.get("metric"), eng, tgt)
+
+
+def merge_qps_rows(old_lines, new_lines):
+    """serve_qps rows MERGE instead of clobber: an engine A/B capture must
+    not erase the plain sustained-QPS record, and vice versa.  A fresh row
+    replaces the stored row with the same key; everything else is kept in
+    its original order, fresh rows appended after."""
+    fresh = {_qps_row_key(l) for l in new_lines}
+    kept = [l for l in (old_lines or []) if _qps_row_key(l) not in fresh]
+    return kept + list(new_lines)
 
 
 def fold_local(log_path, json_path):
@@ -321,8 +350,10 @@ def fold_local(log_path, json_path):
             agent_lines,
         )
     elif qps_lines:
-        targets = [str(json.loads(l)["qps_target"]) for l in qps_lines
-                   if '"serve_qps"' in l]
+        # dict.fromkeys: an A/B capture has one row per target per arm.
+        targets = list(dict.fromkeys(
+            str(json.loads(l)["qps_target"]) for l in qps_lines
+            if '"serve_qps"' in l))
         section, cmd, lines = (
             "serve_qps",
             "benchmarks/serve_bench.py --qps " + " ".join(targets),
@@ -341,6 +372,8 @@ def fold_local(log_path, json_path):
     sec["cmd"] = cmd
     sec.pop("seconds", None)
     sec["rc"] = 0
+    if section == "serve_qps":
+        lines = merge_qps_rows(sec.get("stdout"), lines)
     sec["stdout"] = lines
     sec["stderr"] = []
     try:

@@ -237,9 +237,15 @@ def _phase_totals(rpc, replica):
     return out
 
 
-def run_qps(args):
+def run_qps(args, engine: bool = False):
     """Sustained-QPS rows against a replica-mode server (admission control
-    on): paced arrivals, per-request deadline, typed rejects counted."""
+    on): paced arrivals, per-request deadline, typed rejects counted.
+
+    ``engine=True`` serves through ``lm_serve --engine`` (continuous
+    batching over the paged KV cache) — the A/B arm.  With
+    ``--mixed_tokens`` each request draws its own generation budget, the
+    workload where batch-synchronous decode convoys short requests behind
+    long ones.  Returns the row dicts for the A/B gate."""
     import numpy as np
 
     from moolib_tpu import Broker
@@ -277,6 +283,9 @@ def run_qps(args):
         "--max_new_tokens", str(args.max_new_tokens),
         "--max_queue", str(args.max_queue),
     ]
+    if engine:
+        cmd += ["--engine", "--slots", str(args.batch_sizes[0]),
+                "--block_size", str(args.block_size)]
     log_path = f"/tmp/serve_bench_qps_{port}.log"
     with open(log_path, "w") as log:
         server = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
@@ -295,23 +304,45 @@ def run_qps(args):
         client.wait_for_replicas(1, timeout=30.0)
         rng = np.random.default_rng(0)
         prompt = rng.integers(2, args.vocab, args.seq_len).astype(np.int32)
-        client.call(prompt)  # warm + prime the server's service-time EMA
+        # Duplicates in --mixed_tokens weight the draw (8 8 32 256 = half
+        # the requests short); the latency buckets key on distinct values.
+        mixed = sorted(args.mixed_tokens or ())
+        distinct = sorted(set(mixed))
+        # Warm + prime the server's service-time EMA — one call per decode
+        # budget, so the baseline arm's per-budget jit compiles land before
+        # the measured window (the engine arm compiled everything at
+        # warmup; these are no-ops there).
+        if mixed:
+            for mt in distinct:
+                client.call(prompt, mt)
+        else:
+            client.call(prompt)
         replica = client.replicas()[0]
         phases0 = _phase_totals(client._rpc, replica)
 
+        rows = []
         for q in args.qps:
             latencies: list = []
-            outcomes = {"ok": 0, "reject": 0, "deadline": 0, "error": 0}
+            lat_by_mt: dict = {mt: [] for mt in distinct}
+            outcomes = {"ok": 0, "reject": 0, "deadline": 0, "error": 0,
+                        "tokens": 0}
             lock = threading.Lock()
             pending = []
 
-            def on_done(fut, t0):
+            def on_done(fut, t0, mt):
                 dt = time.perf_counter() - t0
                 exc = fut.exception()
                 with lock:
                     if exc is None:
                         outcomes["ok"] += 1
+                        # Real generated tokens, counted client-side from
+                        # the reply length (budget minus any early EOS).
+                        outcomes["tokens"] += (
+                            len(fut.result()) - args.seq_len
+                        )
                         latencies.append(dt)
+                        if mt in lat_by_mt:
+                            lat_by_mt[mt].append(dt)
                     elif is_overload_error(exc):
                         outcomes["reject"] += 1
                     elif "deadline" in str(exc).lower():
@@ -331,9 +362,12 @@ def run_qps(args):
                 if delay > 0:
                     time.sleep(delay)
                 p = rng.integers(2, args.vocab, args.seq_len).astype(np.int32)
+                mt = int(rng.choice(mixed)) if mixed else None
                 t0 = time.perf_counter()
-                fut = client.submit(p)
-                fut.add_done_callback(lambda f, t0=t0: on_done(f, t0))
+                fut = client.submit(p) if mt is None else client.submit(p, mt)
+                fut.add_done_callback(
+                    lambda f, t0=t0, mt=mt: on_done(f, t0, mt)
+                )
                 pending.append(fut)
             for fut in pending:
                 try:
@@ -341,11 +375,17 @@ def run_qps(args):
                 except Exception:  # noqa: BLE001 — classified in on_done
                     pass
             wall = time.perf_counter() - t_start
+
+            def _pct(xs, p):
+                return (round(float(np.percentile(np.asarray(xs), p)) * 1e3, 1)
+                        if xs else None)
+
             with lock:
-                lat = np.sort(np.asarray(latencies)) if latencies else None
+                lat = sorted(latencies)
                 row = {
                     "metric": "serve_qps",
                     "platform": platform,
+                    "engine": engine,
                     "qps_target": q,
                     "deadline_s": args.deadline_s,
                     "requests": n,
@@ -355,11 +395,20 @@ def run_qps(args):
                     "errors": outcomes["error"],
                     "reject_rate": round(outcomes["reject"] / n, 4),
                     "achieved_qps": round(outcomes["ok"] / wall, 1),
-                    "p50_ms": (round(float(np.percentile(lat, 50)) * 1e3, 1)
-                               if lat is not None else None),
-                    "p99_ms": (round(float(np.percentile(lat, 99)) * 1e3, 1)
-                               if lat is not None else None),
+                    "tokens_per_s": round(outcomes["tokens"] / wall, 1),
+                    "wall_s": round(wall, 2),
+                    "p50_ms": _pct(lat, 50),
+                    "p99_ms": _pct(lat, 99),
                 }
+                if mixed:
+                    # Convoy visibility: short requests' tail latency is
+                    # where batch-synchronous decode pays (a short request
+                    # steps to its batch's longest budget).
+                    row["mixed_tokens"] = mixed
+                    row["p50_ms_short"] = _pct(lat_by_mt[distinct[0]], 50)
+                    row["p99_ms_short"] = _pct(lat_by_mt[distinct[0]], 99)
+                    row["p99_ms_long"] = _pct(lat_by_mt[distinct[-1]], 99)
+            rows.append(row)
             print(json.dumps(row), flush=True)
         # Where did the latency go?  Per-phase means over the whole QPS
         # sweep, from the server's serve_phase_seconds histogram deltas
@@ -379,8 +428,10 @@ def run_qps(args):
             print(json.dumps({
                 "metric": "serve_phase_breakdown",
                 "platform": platform,
+                "engine": engine,
                 "phases": breakdown,
             }), flush=True)
+        return rows
     finally:
         import signal
 
@@ -432,6 +483,24 @@ def main(argv=None):
                    "bucketed serving pre-compiles every power-of-2 bucket "
                    "before readiness, and through the axon tunnel each "
                    "bucket's prefill+decode compile can take minutes")
+    p.add_argument("--engine", action="store_true",
+                   help="A/B in --qps mode: run the baseline replica arm, "
+                   "then the continuous-batching engine arm (lm_serve "
+                   "--engine), and print a serve_engine_ab comparison row")
+    p.add_argument("--mixed_tokens", type=int, nargs="+", default=None,
+                   help="per-request generation budgets drawn uniformly "
+                   "(e.g. 8 32 256) — the mixed-length workload where "
+                   "batch-synchronous decode convoys short requests")
+    p.add_argument("--block_size", type=int, default=16,
+                   help="KV block size for the engine arm")
+    p.add_argument("--check", action="store_true",
+                   help="with --engine: exit non-zero unless the engine arm "
+                   "sustains >= check_ratio x baseline tokens/s with zero "
+                   "errors in both arms (rejects are allowed — that is "
+                   "admission working)")
+    p.add_argument("--check_ratio", type=float, default=1.0,
+                   help="tokens/s floor for --check, as a multiple of the "
+                   "baseline arm")
     args = p.parse_args(argv)
 
     cfg = (
@@ -441,6 +510,55 @@ def main(argv=None):
     )
     print(cfg, flush=True)
     if args.qps:
+        if args.engine:
+            # Engine A/B: the same paced mixed-budget load against the
+            # baseline replica arm, then the continuous-batching engine.
+            # Same broker machinery, same admission contract — only the
+            # service loop differs, so the delta IS the engine.
+            base_rows = run_qps(args, engine=False)
+            eng_rows = run_qps(args, engine=True)
+
+            def _agg(rows):
+                ok = sum(r["ok"] for r in rows)
+                err = sum(r["errors"] + r["deadline_errors"] for r in rows)
+                tps = sum(r["tokens_per_s"] * r["wall_s"] for r in rows)
+                wall = sum(r["wall_s"] for r in rows)
+                p99s = [r.get("p99_ms_short") for r in rows
+                        if r.get("p99_ms_short") is not None]
+                return {
+                    "ok": ok, "errors": err,
+                    "tokens_per_s": round(tps / max(wall, 1e-9), 1),
+                    "p99_ms_short_worst": max(p99s) if p99s else None,
+                }
+            base, eng = _agg(base_rows), _agg(eng_rows)
+            speedup = (round(eng["tokens_per_s"] / base["tokens_per_s"], 2)
+                       if base["tokens_per_s"] else None)
+            print(json.dumps({
+                "metric": "serve_engine_ab",
+                "qps_targets": args.qps,
+                "mixed_tokens": sorted(args.mixed_tokens or ()),
+                "baseline": base,
+                "engine": eng,
+                "tokens_per_s_speedup": speedup,
+            }), flush=True)
+            if args.check:
+                problems = []
+                if base["errors"] or eng["errors"]:
+                    problems.append(
+                        f"hard errors (baseline={base['errors']}, "
+                        f"engine={eng['errors']})"
+                    )
+                if eng["tokens_per_s"] < args.check_ratio * base["tokens_per_s"]:
+                    problems.append(
+                        f"engine {eng['tokens_per_s']} tok/s < "
+                        f"{args.check_ratio} x baseline "
+                        f"{base['tokens_per_s']} tok/s"
+                    )
+                if problems:
+                    raise SystemExit("serve_engine_ab CHECK FAILED: "
+                                     + "; ".join(problems))
+                print("# serve_engine_ab check passed", flush=True)
+            return
         # The batch-1 two-stage-readiness baseline stays the first row (the
         # control a battery timeout must never truncate away), then the
         # sustained-QPS rows run against the resilient plane.

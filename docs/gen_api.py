@@ -38,6 +38,11 @@ MODULES = [
     ("moolib_tpu.watchdog", "Watchdog (run-loop deadman)"),
     ("moolib_tpu.autoscaler", "Autoscaler (elastic fleet supervision)"),
     ("moolib_tpu.serving", "Serving (replicated inference plane)"),
+    ("moolib_tpu.engine", "Engine: continuous batching (package)"),
+    ("moolib_tpu.engine.kv_pool", "Engine: paged KV block pool"),
+    ("moolib_tpu.engine.engine", "Engine: slot scheduler + decode step"),
+    ("moolib_tpu.engine.service", "Engine: serving-contract adapter"),
+    ("moolib_tpu.ops.paged_attention", "Ops: paged decode attention"),
     ("moolib_tpu.testing.faults", "Testing: seeded fault injection"),
     ("moolib_tpu.parallel", "Parallelism (package)"),
     ("moolib_tpu.parallel.mesh", "Parallelism: mesh + shardings"),

@@ -70,11 +70,16 @@ class PeerSample:
     or built directly by tests)."""
 
     __slots__ = ("name", "time", "queue_depth", "vbatch_fill",
-                 "recovery_active", "steps", "step_rate")
+                 "recovery_active", "steps", "step_rate",
+                 "serve_qps", "serve_depth", "serve_wait", "slot_occupancy")
 
     def __init__(self, name: str, time: float, queue_depth: Optional[float] = None,
                  vbatch_fill: Optional[float] = None, recovery_active: bool = False,
-                 steps: Optional[float] = None, step_rate: Optional[float] = None):
+                 steps: Optional[float] = None, step_rate: Optional[float] = None,
+                 serve_qps: Optional[float] = None,
+                 serve_depth: Optional[float] = None,
+                 serve_wait: Optional[float] = None,
+                 slot_occupancy: Optional[float] = None):
         self.name = name
         self.time = time
         self.queue_depth = queue_depth
@@ -82,11 +87,19 @@ class PeerSample:
         self.recovery_active = recovery_active
         self.steps = steps
         self.step_rate = step_rate
+        # Serving-plane signals (ISSUE 12): answered QPS, admission-queue
+        # depth, queue-wait EMA, and engine slot occupancy.  None on
+        # training peers — the policy's serving rules stay dormant there.
+        self.serve_qps = serve_qps
+        self.serve_depth = serve_depth
+        self.serve_wait = serve_wait
+        self.slot_occupancy = slot_occupancy
 
     def __repr__(self):  # pragma: no cover - debugging aid
         return (f"PeerSample({self.name!r}, t={self.time:.1f}, "
                 f"q={self.queue_depth}, fill={self.vbatch_fill}, "
-                f"rec={self.recovery_active}, rate={self.step_rate})")
+                f"rec={self.recovery_active}, rate={self.step_rate}, "
+                f"qps={self.serve_qps}, wait={self.serve_wait})")
 
 
 def _series_values(metrics: Dict[str, Any], name: str) -> List[float]:
@@ -108,6 +121,10 @@ def sample_from_snapshot(name: str, snap: Dict[str, Any]) -> PeerSample:
     fills = _series_values(metrics, "accum_virtual_batch_fill")
     rec = _series_values(metrics, "accum_recovery_active")
     steps = _series_values(metrics, "train_steps_total")
+    qps = _series_values(metrics, "serve_qps")
+    sdepth = _series_values(metrics, "serve_queue_depth")
+    swait = _series_values(metrics, "serve_queue_wait_s")
+    occ = _series_values(metrics, "serve_engine_slot_occupancy")
     return PeerSample(
         name=name,
         time=float(snap.get("time", 0.0)),
@@ -115,6 +132,10 @@ def sample_from_snapshot(name: str, snap: Dict[str, Any]) -> PeerSample:
         vbatch_fill=max(fills) if fills else None,
         recovery_active=any(v >= 1.0 for v in rec),
         steps=sum(steps) if steps else None,
+        serve_qps=sum(qps) if qps else None,
+        serve_depth=max(sdepth) if sdepth else None,
+        serve_wait=max(swait) if swait else None,
+        slot_occupancy=max(occ) if occ else None,
     )
 
 
@@ -149,16 +170,27 @@ class AutoscalePolicy:
        epoch bump and would cancel the rejoin's election/model sync).
     3. ``cooldown``: one scale event per ``cooldown_s`` window — every event
        itself triggers a recovery (re-elect) that the next poll must observe.
-    4. ``starved``: the learner queue is empty cohort-wide → grow.
-    5. ``saturated``: vbatch fill pinned >= threshold for ``saturate_polls``
+    4. ``serve_wait`` / ``serve_idle``: serving-fleet rules (ISSUE 12) —
+       evaluated only when samples carry serving signals, so training
+       cohorts never see them.  Queue-wait EMA above ``serve_wait_grow_s``
+       for ``serve_wait_polls`` consecutive polls → grow (clients are
+       visibly waiting for admission); answered QPS at/below
+       ``serve_idle_qps`` AND slot occupancy at/below
+       ``serve_idle_occupancy`` for ``serve_idle_polls`` polls → shrink
+       (the marginal replica is idle).
+    5. ``starved``: the learner queue is empty cohort-wide → grow.
+    6. ``saturated``: vbatch fill pinned >= threshold for ``saturate_polls``
        consecutive polls → shrink.
-    6. ``steady``: hold.
+    7. ``steady``: hold.
     """
 
     def __init__(self, min_peers: int, max_peers: int, *,
                  starvation_depth: float = 0.0, saturation_fill: float = 0.9,
                  saturate_polls: int = 3, cooldown_s: float = 10.0,
-                 stale_s: float = 30.0):
+                 stale_s: float = 30.0, serve_wait_grow_s: float = 0.5,
+                 serve_wait_polls: int = 2, serve_idle_qps: float = 0.1,
+                 serve_idle_occupancy: float = 0.25,
+                 serve_idle_polls: int = 3):
         if min_peers < 1 or max_peers < min_peers:
             raise ValueError("need 1 <= min_peers <= max_peers")
         self.min_peers = int(min_peers)
@@ -168,13 +200,22 @@ class AutoscalePolicy:
         self.saturate_polls = int(saturate_polls)
         self.cooldown_s = float(cooldown_s)
         self.stale_s = float(stale_s)
+        self.serve_wait_grow_s = float(serve_wait_grow_s)
+        self.serve_wait_polls = int(serve_wait_polls)
+        self.serve_idle_qps = float(serve_idle_qps)
+        self.serve_idle_occupancy = float(serve_idle_occupancy)
+        self.serve_idle_polls = int(serve_idle_polls)
         self._last_event_t: Optional[float] = None
         self._saturated_polls = 0
+        self._wait_streak = 0
+        self._idle_streak = 0
 
     def note_event(self, now: float) -> None:
         """Record that a scale action was taken (arms the cooldown)."""
         self._last_event_t = now
         self._saturated_polls = 0
+        self._wait_streak = 0
+        self._idle_streak = 0
 
     def decide(self, samples: Sequence[PeerSample], cohort_size: int,
                now: float) -> Decision:
@@ -188,6 +229,9 @@ class AutoscalePolicy:
         if (self._last_event_t is not None
                 and now - self._last_event_t < self.cooldown_s):
             return Decision("hold", "cooldown", cohort_size)
+        serve = self._decide_serving(fresh, cohort_size)
+        if serve is not None:
+            return serve
         depths = [s.queue_depth for s in fresh if s.queue_depth is not None]
         if (depths and cohort_size < self.max_peers
                 and max(depths) <= self.starvation_depth):
@@ -200,6 +244,42 @@ class AutoscalePolicy:
         if (self._saturated_polls >= self.saturate_polls
                 and cohort_size > self.min_peers):
             return Decision("shrink", "saturated", cohort_size - 1)
+        return Decision("hold", "steady", cohort_size)
+
+    def _decide_serving(self, fresh: Sequence[PeerSample],
+                        cohort_size: int) -> Optional[Decision]:
+        """Serving-fleet rules: sustained queue-wait grows, sustained idle
+        shrinks.  Returns None (and resets the streaks) when no fresh
+        sample carries serving signals — training cohorts fall through to
+        the starvation/saturation rules untouched."""
+        waits = [s.serve_wait for s in fresh if s.serve_wait is not None]
+        qpss = [s.serve_qps for s in fresh if s.serve_qps is not None]
+        if not waits and not qpss:
+            self._wait_streak = 0
+            self._idle_streak = 0
+            return None
+        if waits and max(waits) >= self.serve_wait_grow_s:
+            self._wait_streak += 1
+        else:
+            self._wait_streak = 0
+        if self._wait_streak >= self.serve_wait_polls:
+            if cohort_size < self.max_peers:
+                return Decision("grow", "serve_wait", cohort_size + 1)
+            return Decision("hold", "serve_wait_at_max", cohort_size)
+        occs = [s.slot_occupancy for s in fresh
+                if s.slot_occupancy is not None]
+        idle = (qpss and max(qpss) <= self.serve_idle_qps
+                and (not waits or max(waits) < self.serve_wait_grow_s)
+                and (not occs or max(occs) <= self.serve_idle_occupancy))
+        if idle:
+            self._idle_streak += 1
+        else:
+            self._idle_streak = 0
+        if (self._idle_streak >= self.serve_idle_polls
+                and cohort_size > self.min_peers):
+            return Decision("shrink", "serve_idle", cohort_size - 1)
+        # Serving signals present but no rule fired: the generic training
+        # rules must not interpret a serving fleet's (absent) batcher depth.
         return Decision("hold", "steady", cohort_size)
 
 

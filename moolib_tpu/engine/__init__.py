@@ -1,0 +1,20 @@
+"""Continuous-batching serving engine over a paged KV cache.
+
+The serving-throughput subsystem (ROADMAP item 2): slot-scheduled decode
+against a device-resident KV block pool, slotting in UNDER the existing
+``serving.ServeService`` contract so ``lm_serve --engine`` is a drop-in arm
+next to the batch-synchronous baseline.  See ``engine.py`` for the
+slot/block lifecycle and ``ops/paged_attention.py`` for the kernel.
+"""
+
+from .engine import ContinuousBatchingEngine, NoFreeSlot  # noqa: F401
+from .kv_pool import BlockPool, PoolExhausted  # noqa: F401
+from .service import EngineService  # noqa: F401
+
+__all__ = [
+    "BlockPool",
+    "ContinuousBatchingEngine",
+    "EngineService",
+    "NoFreeSlot",
+    "PoolExhausted",
+]

@@ -1,0 +1,465 @@
+"""Continuous-batching decode engine over a paged KV block pool.
+
+The batch-synchronous baseline (``serving.ServeService`` + a jitted
+``generate``) decodes every request in a batch until the LONGEST one
+finishes, in a dense per-sequence cache sized for the worst case.  This
+engine removes both wastes:
+
+- **Slots, not batches.**  Decode is ONE fixed-shape jitted call over ``S``
+  slots.  A sequence joins a free slot the moment its prefill lands and
+  retires the moment it emits EOS or exhausts its token budget — no convoy
+  behind a long neighbor.  Slot occupancy, lengths, and block tables are
+  jit *arguments* updated by donated in-place ops, so join/retire causes
+  no recompile and no device cache reshuffle.
+- **Blocks, not max_len rows.**  K/V live in a shared device pool of
+  fixed-size token blocks (``ops.paged_attention``); a sequence holds only
+  the blocks its length needs (``engine.kv_pool.BlockPool`` free list).
+
+Prefill is a separate shape-bucketed jitted path (``serving.bucket`` — the
+canonical bucketing policy) over the full prompt, reusing the model's own
+``collect_kv`` teacher-forced forward; its K/V rows scatter straight into
+pool blocks.  With ``mesh=`` and ``prefill_devices=``, prefill runs on a
+``split_mesh`` submesh and the K/V hand off to the decode submesh through
+the d2d :class:`..batcher.Batcher` (the PR-7 Sebulba seam generalized to
+serving; ``batcher_d2d_bytes_total`` counts the crossing).
+
+Greedy decoding only (temperature sampling would need per-slot rng lanes;
+the serving plane is argmax today, matching ``lm_serve``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from ..models.transformer import TransformerLM
+from ..ops.paged_attention import PagedState
+from ..serving import bucket, bucket_shapes
+from .kv_pool import BlockPool, PoolExhausted
+
+_REG = telemetry.get_registry()
+# Registration is idempotent: serving.py declares the same counter for the
+# batch-synchronous arm — both arms feed one series.
+_M_PAD_TOKENS = _REG.counter(
+    "serve_pad_tokens_total",
+    "tokens of padding waste: bucket pad rows and decode overrun in the "
+    "batch-synchronous arm, prompt-bucket padding in the engine arm — "
+    "subtract from gross throughput to get REAL tokens/s",
+)
+_M_TOKENS = _REG.counter(
+    "serve_engine_tokens_total", "tokens emitted by engine decode steps"
+)
+_M_PREFILL_TOKENS = _REG.counter(
+    "serve_engine_prefill_tokens_total", "prompt tokens prefilled (unpadded)"
+)
+_M_JOINS = _REG.counter(
+    "serve_engine_joins_total", "sequences joined to a decode slot"
+)
+_M_RETIRES = _REG.counter(
+    "serve_engine_retires_total", "sequences retired (EOS or budget)"
+)
+_M_SLOTS = _REG.gauge(
+    "serve_engine_slots_active", "decode slots currently occupied"
+)
+_M_OCC = _REG.gauge(
+    "serve_engine_slot_occupancy", "occupied fraction of decode slots (0..1)"
+)
+_M_BLOCKS_FREE = _REG.gauge(
+    "serve_engine_blocks_free", "KV pool blocks on the free list"
+)
+
+
+class NoFreeSlot(RuntimeError):
+    """Every decode slot is occupied — the request should stay queued."""
+
+
+class ContinuousBatchingEngine:
+    """See module docstring.  Host-side driver owning the device state
+    (KV pools, block tables, per-slot lengths/tokens/budgets) and the three
+    jitted paths: bucketed prefill, donated join, fixed-shape decode step.
+
+    Single-threaded by contract: one loop (``EngineService``) calls
+    ``submit``/``step``/``retire``; only ``set_params`` and the read-only
+    stats are safe from other threads.
+    """
+
+    def __init__(self, model: TransformerLM, params, *, slots: int = 8,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 max_prompt_len: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 mesh=None, prefill_devices: int = 0):
+        if model.moe_num_experts:
+            raise ValueError("the engine does not support MoE models yet")
+        self.model = model
+        self.slots = int(slots)
+        if self.slots < 1:
+            raise ValueError("need at least one decode slot")
+        self.block_size = int(block_size)
+        self.seq_capacity = int(max_seq_len or model.max_len)
+        if self.seq_capacity > model.max_len:
+            raise ValueError(
+                f"max_seq_len={self.seq_capacity} exceeds the model's "
+                f"max_len={model.max_len} (learned-pos table / rotary cap)"
+            )
+        self.max_blocks_per_seq = -(-self.seq_capacity // self.block_size)
+        if num_blocks is None:
+            # Worst case: every slot at full capacity, plus the null block.
+            num_blocks = 1 + self.slots * self.max_blocks_per_seq
+        self.pool = BlockPool(num_blocks, self.block_size)
+        self.max_prompt_len = int(max_prompt_len or self.seq_capacity)
+        self.eos_id = eos_id
+        self._L = model.num_layers
+        self._Hk = model.num_kv_heads or model.num_heads
+        self._hd = model.d_model // model.num_heads
+
+        self._dec = TransformerLM(
+            vocab_size=model.vocab_size, d_model=model.d_model,
+            num_heads=model.num_heads, num_kv_heads=model.num_kv_heads,
+            num_layers=model.num_layers, max_len=model.max_len,
+            attention="dense",  # unused: decode attention is the paged kernel
+            dtype=model.dtype, pos_embedding=model.pos_embedding,
+            decode=True, kv_num_blocks=num_blocks,
+            kv_block_size=self.block_size,
+        )
+        self._pre = TransformerLM(
+            vocab_size=model.vocab_size, d_model=model.d_model,
+            num_heads=model.num_heads, num_kv_heads=model.num_kv_heads,
+            num_layers=model.num_layers, max_len=model.max_len,
+            attention="flash" if model.attention == "ring" else model.attention,
+            dtype=model.dtype, pos_embedding=model.pos_embedding,
+            collect_kv=True,
+        )
+
+        # Optional disaggregated prefill: first N mesh devices prefill, the
+        # rest decode; K/V cross through the device-path Batcher (counted
+        # d2d, no host bounce).
+        self._prefill_sharding = self._decode_sharding = None
+        self._xfer = None
+        if mesh is not None and prefill_devices:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..batcher import Batcher
+            from ..parallel.mesh import split_mesh
+
+            pmesh, dmesh = split_mesh(mesh, prefill_devices)
+            self._prefill_sharding = NamedSharding(pmesh, PartitionSpec())
+            self._decode_sharding = NamedSharding(dmesh, PartitionSpec())
+            self._xfer = Batcher(1, device=self._decode_sharding,
+                                 name="engine_prefill_xfer")
+
+        self.set_params(params)
+
+        S, MB = self.slots, self.max_blocks_per_seq
+        cache: Dict[str, Dict[str, jax.Array]] = {}
+        shape = (num_blocks, self.block_size, self._Hk, self._hd)
+        for i in range(self._L):
+            cache[f"block{i}"] = {
+                "pool_k": jnp.zeros(shape, model.dtype),
+                "pool_v": jnp.zeros(shape, model.dtype),
+            }
+        self._cache = self._place_decode(cache)
+        self._tables = self._place_decode(jnp.zeros((S, MB), jnp.int32))
+        self._lengths = self._place_decode(jnp.zeros((S,), jnp.int32))
+        self._active = self._place_decode(jnp.zeros((S,), jnp.bool_))
+        self._tokens = self._place_decode(jnp.zeros((S,), jnp.int32))
+        self._remaining = self._place_decode(jnp.zeros((S,), jnp.int32))
+
+        # Host mirrors (slot bookkeeping never round-trips device state).
+        self._free_slots: List[int] = list(range(S - 1, -1, -1))
+        self._slot_blocks: List[List[int]] = [[] for _ in range(S)]
+        self._emitted: List[List[int]] = [[] for _ in range(S)]
+        self._remaining_host = np.zeros(S, np.int64)
+        self._active_host = np.zeros(S, bool)
+        self._stats = {
+            "joins": 0, "retires": 0, "decode_tokens": 0,
+            "prefill_tokens": 0, "prefill_pad_tokens": 0, "steps": 0,
+        }
+
+        self._step_jit = jax.jit(
+            self._step_impl, donate_argnums=(1, 2, 3, 4, 5, 6)
+        )
+        # Prefill/join jits cache by shape: one trace per prompt bucket
+        # (and per block-count bucket for join) — never per request.
+        self._prefill_jit = jax.jit(self._prefill_impl)
+        self._join_jit = jax.jit(
+            self._join_impl, donate_argnums=(0, 1, 2, 3, 4, 5)
+        )
+
+    # ------------------------------------------------------------- placement
+    def _place_decode(self, x):
+        if self._decode_sharding is None:
+            return x
+        return jax.device_put(x, self._decode_sharding)
+
+    def set_params(self, params) -> None:
+        """Install new weights (host or device pytree).  Called between
+        iterations by the service's hot-swap hook — the KV pools and slot
+        state are untouched, so in-flight sequences continue under the new
+        weights (same contract as the baseline's mid-stream swap)."""
+        if self._decode_sharding is not None:
+            self._params_dec = jax.device_put(params, self._decode_sharding)
+            self._params_pre = jax.device_put(params, self._prefill_sharding)
+        else:
+            self._params_dec = self._params_pre = params
+
+    # ------------------------------------------------------------ jit bodies
+    def _step_impl(self, params, cache, tables, lengths, active, tokens,
+                   remaining):
+        logits, upd = self._dec.apply(
+            {"params": params["params"], "cache": cache},
+            tokens[:, None],
+            paged=PagedState(tables, lengths, active),
+            mutable=["cache"],
+        )
+        act = active.astype(jnp.int32)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, tokens)
+        lengths = lengths + act
+        remaining = remaining - act
+        done = active & (remaining <= 0)
+        if self.eos_id is not None:
+            done = done | (active & (nxt == self.eos_id))
+        active = active & ~done
+        return upd["cache"], tables, lengths, active, nxt, remaining, done
+
+    def _prefill_impl(self, params, toks, tp):
+        """toks [1, Lb] (bucket-padded prompt), tp the true length.  Returns
+        pool-shaped K/V ([L, nbw, bs, Hk, hd]) and the first greedy token
+        (argmax of the logits at tp-1 — identical to ``generate()``)."""
+        logits, col = self._pre.apply(
+            {"params": params["params"]}, toks, mutable=["kv"]
+        )
+        last = jnp.take(logits[0], tp - 1, axis=0)
+        tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        Lb = toks.shape[1]
+        nbw = -(-Lb // self.block_size)
+        pad = nbw * self.block_size - Lb
+        ks = jnp.stack(
+            [col["kv"][f"block{i}"]["k"][0][0] for i in range(self._L)]
+        )
+        vs = jnp.stack(
+            [col["kv"][f"block{i}"]["v"][0][0] for i in range(self._L)]
+        )
+        if pad:
+            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+            ks, vs = jnp.pad(ks, widths), jnp.pad(vs, widths)
+        shape = (self._L, nbw, self.block_size, self._Hk, self._hd)
+        return (ks.reshape(shape).astype(self.model.dtype),
+                vs.reshape(shape).astype(self.model.dtype), tok0)
+
+    def _join_impl(self, cache, tables, lengths, active, tokens, remaining,
+                   slot, row, tp, tok0, rem0, ks, vs, block_ids):
+        """Donated in-place join: scatter the prefilled K/V blocks into the
+        pools and light the slot.  ``slot``/``tp``/``tok0``/``rem0`` are
+        traced scalars and ``row``/``block_ids`` traced vectors — a join
+        never recompiles (one trace per block-count bucket)."""
+        new_cache = {}
+        for i in range(self._L):
+            c = cache[f"block{i}"]
+            new_cache[f"block{i}"] = {
+                "pool_k": c["pool_k"].at[block_ids].set(
+                    ks[i].astype(c["pool_k"].dtype)
+                ),
+                "pool_v": c["pool_v"].at[block_ids].set(
+                    vs[i].astype(c["pool_v"].dtype)
+                ),
+            }
+        tables = jax.lax.dynamic_update_slice(tables, row[None, :], (slot, 0))
+        lengths = lengths.at[slot].set(tp)
+        active = active.at[slot].set(True)
+        tokens = tokens.at[slot].set(tok0)
+        remaining = remaining.at[slot].set(rem0)
+        return new_cache, tables, lengths, active, tokens, remaining
+
+    # --------------------------------------------------------------- serving
+    def can_accept(self, prompt_len: int, max_new: int) -> bool:
+        """A free slot AND enough free blocks for the worst case of this
+        request (its bucket-padded prompt or its full budget)."""
+        if not self._free_slots:
+            return False
+        lb = bucket(int(prompt_len), self.max_prompt_len)
+        need = self.pool.blocks_for(max(lb, int(prompt_len) + int(max_new)))
+        return self.pool.available() >= need
+
+    def pending_decode_tokens(self) -> int:
+        """Budgeted-but-unemitted tokens across active slots (the admission
+        controller's per-token wait estimate numerator)."""
+        return int(self._remaining_host[self._active_host].sum())
+
+    def active_count(self) -> int:
+        return int(self._active_host.sum())
+
+    def submit(self, prompt, max_new: int) -> Tuple[Optional[int], List[int]]:
+        """Prefill ``prompt`` (1-D int tokens) and join a decode slot.
+
+        Returns ``(slot, emitted)``: ``emitted`` always carries the first
+        greedy token; ``slot`` is None when the request finished at prefill
+        (budget of 1, or immediate EOS) and never occupied a slot.  Raises
+        :class:`NoFreeSlot` / :class:`PoolExhausted` when full (the caller
+        keeps the request queued) and ``ValueError`` for oversized prompts.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        tp = prompt.shape[0]
+        max_new = max(1, int(max_new))
+        if tp < 1:
+            raise ValueError("empty prompt")
+        if tp > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {tp} exceeds max_prompt_len={self.max_prompt_len}"
+            )
+        total = tp + max_new
+        if total > self.seq_capacity:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the engine's "
+                f"sequence capacity {self.seq_capacity}"
+            )
+        lb = bucket(tp, self.max_prompt_len)
+        pad = lb - tp
+        toks = np.pad(prompt, (0, pad))[None]
+        if pad:
+            self._stats["prefill_pad_tokens"] += pad
+            _M_PAD_TOKENS.inc(pad)
+        toks_dev = (toks if self._prefill_sharding is None
+                    else jax.device_put(toks, self._prefill_sharding))
+        ks, vs, tok0 = self._prefill_jit(
+            self._params_pre, toks_dev, np.int32(tp)
+        )
+        self._stats["prefill_tokens"] += tp
+        _M_PREFILL_TOKENS.inc(tp)
+        tok0 = int(tok0)
+        emitted = [tok0]
+        if max_new == 1 or (self.eos_id is not None and tok0 == self.eos_id):
+            return None, emitted
+        if self._xfer is not None:
+            # Prefill submesh -> decode submesh, one device-path crossing.
+            self._xfer.stack((ks, vs))
+            ks, vs = jax.tree.map(lambda x: x[0], self._xfer.get())
+        if not self._free_slots:
+            raise NoFreeSlot(f"all {self.slots} slots occupied")
+        nbw = int(ks.shape[1])
+        n_alloc = self.pool.blocks_for(max(lb, total))
+        block_ids = self.pool.alloc(n_alloc)  # PoolExhausted -> stay queued
+        slot = self._free_slots.pop()
+        row = np.zeros(self.max_blocks_per_seq, np.int32)
+        row[:n_alloc] = block_ids
+        (self._cache, self._tables, self._lengths, self._active,
+         self._tokens, self._remaining) = self._join_jit(
+            self._cache, self._tables, self._lengths, self._active,
+            self._tokens, self._remaining,
+            np.int32(slot), row, np.int32(tp), np.int32(tok0),
+            np.int32(max_new - 1),
+            ks, vs, np.asarray(block_ids[:nbw], np.int32),
+        )
+        self._slot_blocks[slot] = block_ids
+        self._emitted[slot] = emitted
+        self._remaining_host[slot] = max_new - 1
+        self._active_host[slot] = True
+        self._stats["joins"] += 1
+        _M_JOINS.inc()
+        self._update_gauges()
+        return slot, emitted
+
+    def step(self) -> Tuple[Dict[int, int], List[int]]:
+        """One fixed-shape decode step over every slot.  Returns the tokens
+        emitted this step (slot -> token) and the slots that finished."""
+        if not self._active_host.any():
+            return {}, []
+        (self._cache, self._tables, self._lengths, self._active,
+         self._tokens, self._remaining, done) = self._step_jit(
+            self._params_dec, self._cache, self._tables, self._lengths,
+            self._active, self._tokens, self._remaining,
+        )
+        nxt = np.asarray(self._tokens)
+        done = np.asarray(done)
+        emissions: Dict[int, int] = {}
+        finished: List[int] = []
+        for s in np.nonzero(self._active_host)[0]:
+            tok = int(nxt[s])
+            emissions[int(s)] = tok
+            self._emitted[s].append(tok)
+            self._remaining_host[s] -= 1
+            if done[s]:
+                finished.append(int(s))
+                self._active_host[s] = False
+        self._stats["steps"] += 1
+        self._stats["decode_tokens"] += len(emissions)
+        _M_TOKENS.inc(len(emissions))
+        return emissions, finished
+
+    def retire(self, slot: int) -> List[int]:
+        """Free the slot's blocks and return its emitted tokens.  Pure host
+        bookkeeping: the device state was already cleared by the step that
+        finished the slot (donated in-place), nothing round-trips."""
+        toks = self._emitted[slot]
+        self.pool.free(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._emitted[slot] = []
+        self._remaining_host[slot] = 0
+        self._free_slots.append(slot)
+        self._stats["retires"] += 1
+        _M_RETIRES.inc()
+        self._update_gauges()
+        return toks
+
+    def _update_gauges(self) -> None:
+        n = int(self._active_host.sum())
+        _M_SLOTS.set(n)
+        _M_OCC.set(n / self.slots)
+        _M_BLOCKS_FREE.set(self.pool.available())
+
+    # ---------------------------------------------------------------- warmup
+    def warmup(self) -> int:
+        """Compile every shape serving can hit: the decode step, one prefill
+        per prompt bucket, one join per block-count bucket.  Warmup joins
+        target the null block with a zero budget, so the single decode step
+        that follows retires them without touching real state.  Returns the
+        number of distinct compiled shapes."""
+        shapes = 0
+        seen_nbw = set()
+        for lb in sorted(set(bucket_shapes(self.max_prompt_len))):
+            toks = np.zeros((1, lb), np.int32)
+            toks_dev = (toks if self._prefill_sharding is None
+                        else jax.device_put(toks, self._prefill_sharding))
+            ks, vs, _ = self._prefill_jit(
+                self._params_pre, toks_dev, np.int32(lb)
+            )
+            shapes += 1
+            nbw = int(ks.shape[1])
+            if nbw in seen_nbw:
+                continue
+            seen_nbw.add(nbw)
+            if self._xfer is not None:
+                self._xfer.stack((ks, vs))
+                ks, vs = jax.tree.map(lambda x: x[0], self._xfer.get())
+            row = np.zeros(self.max_blocks_per_seq, np.int32)
+            (self._cache, self._tables, self._lengths, self._active,
+             self._tokens, self._remaining) = self._join_jit(
+                self._cache, self._tables, self._lengths, self._active,
+                self._tokens, self._remaining,
+                np.int32(0), row, np.int32(0), np.int32(0), np.int32(0),
+                ks, vs, np.zeros(nbw, np.int32),
+            )
+            shapes += 1
+        # One real step compiles the decode path and clears the warmup joins
+        # (zero budget -> done immediately; writes landed in the null block).
+        (self._cache, self._tables, self._lengths, self._active,
+         self._tokens, self._remaining, _done) = self._step_jit(
+            self._params_dec, self._cache, self._tables, self._lengths,
+            self._active, self._tokens, self._remaining,
+        )
+        return shapes + 1
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        out = dict(self._stats)
+        out.update(self.pool.stats())
+        out["slots"] = self.slots
+        out["slots_active"] = self.active_count()
+        out["slot_occupancy"] = self.active_count() / self.slots
+        return out

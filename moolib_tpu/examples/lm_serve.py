@@ -33,12 +33,22 @@ replicas and clients ping the primary and fail over on its death:
 
 Prompts in one batch must share a length (the queue stacks them); pad
 client-side for mixed lengths.
+
+``--engine`` (ISSUE 12) swaps the batch-synchronous replica plane for the
+continuous-batching engine (``moolib_tpu.engine``): decode slots over a
+paged KV cache, per-request token budgets (clients pass ``max_new`` as the
+second positional arg), admission in per-token units — same broker
+registration, hot-swap, and stats surface, so every client above works
+unchanged.  Without ``--engine`` the replica arm still honors per-request
+budgets (``per_request_tokens``), but decodes each batch to the row max —
+the convoy the engine arm removes.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import time
 from typing import Optional
 
 import jax
@@ -236,6 +246,37 @@ def main(argv=None):
         "--no_dynamic_batching", action="store_true",
         help="serve one call per iteration (latency baseline for serve_bench)",
     )
+    p.add_argument(
+        "--engine", action="store_true",
+        help="serve with the continuous-batching engine (paged KV cache, "
+        "per-request budgets, no convoy) instead of batch-synchronous "
+        "generate",
+    )
+    p.add_argument(
+        "--slots", type=int, default=0,
+        help="engine decode slots (0 = --batch_size)",
+    )
+    p.add_argument(
+        "--block_size", type=int, default=16,
+        help="engine KV pool block size in tokens",
+    )
+    p.add_argument(
+        "--prefill_devices", type=int, default=0,
+        help="with --engine and --mesh: run prefill on the first N mesh "
+        "devices and decode on the rest (d2d K/V handoff)",
+    )
+    p.add_argument(
+        "--service_delay_ms", type=float, default=0.0,
+        help="add this many milliseconds to every service iteration — a "
+        "load-testing hook that makes saturation (and so the autoscaler's "
+        "queue-wait signal) deterministic on any host; never use in "
+        "production",
+    )
+    p.add_argument(
+        "--localdir", default=None,
+        help="per-peer scratch dir: the autoscaler's decommission flag is "
+        "polled here (set MOOLIB_TELEMETRY_DIR to it for snapshots)",
+    )
     flags = p.parse_args(argv)
     # One broker list everywhere below: --broker_addrs (HA) wins, --broker
     # stays as the single-address alias.
@@ -251,6 +292,7 @@ def main(argv=None):
     from ..utils import apply_platform_env
 
     apply_platform_env()  # honor JAX_PLATFORMS over a sitecustomized backend
+    telemetry.init_from_env()  # opt-in exporters (docs/TELEMETRY.md)
 
     model = make_model(flags)
     if flags.listen:
@@ -272,31 +314,95 @@ def main(argv=None):
             # benchmark can tell "server is compiling (be patient)" from
             # "server never came up" (serve_bench keys its two timeouts on
             # exactly these two lines).
-            nbuckets = len(_bucket_shapes(flags.batch_size)) if not flags.no_dynamic_batching else 1
+            if flags.engine:
+                nbuckets = len(set(_bucket_shapes(flags.seq_len))) + 1
+            elif flags.no_dynamic_batching:
+                nbuckets = 1
+            else:
+                nbuckets = len(_bucket_shapes(flags.batch_size))
             print(
                 f"precompiling {nbuckets} bucket shape(s) "
                 f"[platform={jax.devices()[0].platform}]",
                 flush=True,
             )
-            if broker_list or flags.publisher:
+            if flags.engine:
+                # Continuous-batching arm: slots over a paged KV cache
+                # under the same ServeService contract (engine/service.py).
+                # warmup() compiles every prefill bucket, every join block
+                # count, and the decode step BEFORE the readiness line.
+                from .. import serving as serving_mod
+                from ..engine import ContinuousBatchingEngine, EngineService
+
+                engine = ContinuousBatchingEngine(
+                    model, params,
+                    slots=flags.slots or flags.batch_size,
+                    block_size=flags.block_size,
+                    max_prompt_len=flags.seq_len,
+                    mesh=mesh, prefill_devices=flags.prefill_devices,
+                )
+                engine.warmup()
+                if flags.service_delay_ms > 0:
+                    _eng_step = engine.step
+
+                    def _slow_step():
+                        time.sleep(flags.service_delay_ms / 1e3)
+                        return _eng_step()
+
+                    engine.step = _slow_step
+                service = EngineService(
+                    rpc, engine, name="generate",
+                    max_queue=flags.max_queue,
+                    default_max_new=flags.max_new_tokens,
+                )
+                replica = serving_mod.ServeReplica(
+                    rpc, None, params, name="generate", service=service,
+                    broker=broker_list[0] if broker_list else None,
+                    brokers=broker_list[1:],
+                    broker_name=flags.broker_name,
+                    group=flags.group,
+                    publisher=flags.publisher,
+                    model_channel=flags.model_channel,
+                )
+                loop = replica.loop()
+            elif broker_list or flags.publisher:
                 # Resilient replica: admission control + request dedup +
                 # hot-swap staging (moolib_tpu.serving), with the same
                 # bucket policy and pre-compile contract as serve().
+                # Per-request budgets ride as a third step_fn argument;
+                # each batch decodes to its row-max budget (bucketed so
+                # the jit cache stays bounded: one entry per (rows, decode
+                # bucket) pair).
                 from .. import serving as serving_mod
 
-                jgen = jax.jit(
-                    lambda p_, prompts: generate(model, p_, prompts,
-                                                 flags.max_new_tokens)
-                )
+                jits = {}
+
+                def _jgen(mn):
+                    fn = jits.get(mn)
+                    if fn is None:
+                        fn = jax.jit(
+                            lambda p_, prompts, m=mn: generate(
+                                model, p_, prompts, m
+                            )
+                        )
+                        jits[mn] = fn
+                    return fn
+
+                def step(p_, batch, budgets=None):
+                    if flags.service_delay_ms > 0:
+                        time.sleep(flags.service_delay_ms / 1e3)
+                    mn = (flags.max_new_tokens if budgets is None
+                          else int(np.max(budgets)))
+                    mn = _bucket(mn, flags.max_new_tokens)
+                    return np.asarray(_jgen(mn)(p_, jnp.asarray(batch)))
+
                 shapes = (_bucket_shapes(flags.batch_size)
                           if not flags.no_dynamic_batching else [1])
                 for b in shapes:
-                    np.asarray(jgen(params, jnp.zeros((b, flags.seq_len),
-                                                      jnp.int32)))
+                    np.asarray(_jgen(flags.max_new_tokens)(
+                        params, jnp.zeros((b, flags.seq_len), jnp.int32)
+                    ))
                 replica = serving_mod.ServeReplica(
-                    rpc,
-                    lambda p_, batch: np.asarray(jgen(p_, jnp.asarray(batch))),
-                    params,
+                    rpc, step, params,
                     name="generate",
                     batch_size=flags.batch_size,
                     dynamic_batching=not flags.no_dynamic_batching,
@@ -307,6 +413,8 @@ def main(argv=None):
                     group=flags.group,
                     publisher=flags.publisher,
                     model_channel=flags.model_channel,
+                    per_request_tokens=True,
+                    default_max_new=flags.max_new_tokens,
                 )
                 loop = replica.loop()
             else:
@@ -321,6 +429,32 @@ def main(argv=None):
                 f"[platform={jax.devices()[0].platform}]",
                 flush=True,
             )
+            if flags.localdir:
+                # Fleet membership: the autoscaler decommissions a serving
+                # replica by dropping the flag file; draining is the
+                # service close (queued requests get typed errors, the
+                # broker sees an explicit leave via replica.close()).
+                import threading
+
+                from .. import autoscaler as autoscaler_mod
+
+                rep = replica
+
+                def _watch_decommission():
+                    while True:
+                        if autoscaler_mod.decommission_requested(
+                                flags.localdir):
+                            print("decommission requested; leaving",
+                                  flush=True)
+                            if rep is not None:
+                                rep.close()
+                            else:
+                                rpc.close()
+                            return
+                        time.sleep(0.5)
+
+                threading.Thread(target=_watch_decommission,
+                                 daemon=True).start()
             asyncio.run(loop)
         finally:
             if replica is not None:

@@ -36,17 +36,27 @@ def apply_rotary(x: jax.Array, base: float = 10000.0, offset=0) -> jax.Array:
     learned table capping the usable length, graceful extrapolation).
     Computed in float32 and cast back (bf16 angles visibly distort long-range
     phases).  ``offset`` shifts the positions (the cache index during
-    autoregressive decoding); it may be a traced scalar.
+    autoregressive decoding); it may be a traced scalar, or a traced [B]
+    vector when each row sits at its own position (continuous-batching decode
+    slots).  The scalar and vector paths compute identical angles for equal
+    offsets, so they are bit-exact against each other.
     """
     B, T, H, D = x.shape
     half = D // 2
     if D % 2:
         raise ValueError(f"rotary needs an even head dim, got {D}")
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)  # [half]
-    positions = offset + jnp.arange(T, dtype=jnp.float32)
-    angles = positions[:, None] * freqs[None, :]  # [T, half]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    off = jnp.asarray(offset)
+    if off.ndim == 0:
+        positions = off + jnp.arange(T, dtype=jnp.float32)
+        angles = positions[:, None] * freqs[None, :]  # [T, half]
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:
+        positions = off[:, None] + jnp.arange(T, dtype=jnp.float32)[None, :]
+        angles = positions[..., None] * freqs  # [B, T, half]
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -83,9 +93,15 @@ class Block(nn.Module):
     max_len: int = 8192  # cache capacity in decode mode
     collect_kv: bool = False  # sow K/V into a "kv" collection (prefill)
     num_kv_heads: Optional[int] = None  # GQA: KV heads < query heads
+    # Paged KV cache (continuous-batching decode; see ops.paged_attention):
+    # >0 switches the decode cache from dense [B, max_len, Hk, hd] to a
+    # shared block pool [kv_num_blocks, kv_block_size, Hk, hd] addressed via
+    # the PagedState passed at apply time.
+    kv_num_blocks: int = 0
+    kv_block_size: int = 16
 
     @nn.compact
-    def __call__(self, x, mesh=None):
+    def __call__(self, x, mesh=None, paged=None):
         B, T, D = x.shape
         H = self.num_heads
         hd = D // H
@@ -109,42 +125,64 @@ class Block(nn.Module):
             # KV head through a grouped einsum — no repeat materializes.
             if T != 1:
                 raise ValueError(f"decode mode steps one token at a time, got T={T}")
-            ck = self.variable(
-                "cache", "k", jnp.zeros, (B, self.max_len, Hk, hd), self.dtype
+            from ..ops.paged_attention import (
+                gathered_decode_attention,
+                paged_attention,
+                paged_kv_write,
             )
-            cv = self.variable(
-                "cache", "v", jnp.zeros, (B, self.max_len, Hk, hd), self.dtype
-            )
-            idx = self.variable(
-                "cache", "idx", lambda: jnp.zeros((), jnp.int32)
-            )
-            t = idx.value
-            if self.rotary:
-                q = apply_rotary(q, offset=t)
-                k = apply_rotary(k, offset=t)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(self.dtype), (0, t, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(self.dtype), (0, t, 0, 0)
-            )
-            idx.value = t + 1
-            scale = hd**-0.5
-            qg = q.reshape(B, T, Hk, group, hd)
-            scores = (
-                jnp.einsum(
-                    "bqhgd,bkhd->bhgqk",
-                    qg.astype(jnp.float32),
-                    ck.value.astype(jnp.float32),
+
+            if self.kv_num_blocks:
+                # Paged layout: K/V live in a pool shared by all decode
+                # slots; each slot addresses its blocks through the block
+                # table in ``paged``.  Same math as the dense branch below
+                # (both call gathered_decode_attention), different storage.
+                if paged is None:
+                    raise ValueError("kv_num_blocks > 0 needs paged= at apply time")
+                pk = self.variable(
+                    "cache", "pool_k", jnp.zeros,
+                    (self.kv_num_blocks, self.kv_block_size, Hk, hd), self.dtype,
                 )
-                * scale
-            )
-            mask = jnp.arange(self.max_len)[None, None, None, None, :] <= t
-            scores = jnp.where(mask, scores, -1e30)
-            p_att = jax.nn.softmax(scores, axis=-1)
-            att = jnp.einsum(
-                "bhgqk,bkhd->bqhgd", p_att, cv.value.astype(jnp.float32)
-            ).reshape(B, T, H, hd).astype(x.dtype)
+                pv = self.variable(
+                    "cache", "pool_v", jnp.zeros,
+                    (self.kv_num_blocks, self.kv_block_size, Hk, hd), self.dtype,
+                )
+                t = paged.lengths
+                if self.rotary:
+                    q = apply_rotary(q, offset=t)
+                    k = apply_rotary(k, offset=t)
+                pk.value = paged_kv_write(
+                    pk.value, k[:, 0], paged.block_tables, t, paged.active
+                )
+                pv.value = paged_kv_write(
+                    pv.value, v[:, 0], paged.block_tables, t, paged.active
+                )
+                att = paged_attention(
+                    q, pk.value, pv.value, paged.block_tables, t
+                ).astype(x.dtype)
+            else:
+                ck = self.variable(
+                    "cache", "k", jnp.zeros, (B, self.max_len, Hk, hd), self.dtype
+                )
+                cv = self.variable(
+                    "cache", "v", jnp.zeros, (B, self.max_len, Hk, hd), self.dtype
+                )
+                idx = self.variable(
+                    "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+                )
+                t = idx.value
+                if self.rotary:
+                    q = apply_rotary(q, offset=t)
+                    k = apply_rotary(k, offset=t)
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(self.dtype), (0, t, 0, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(self.dtype), (0, t, 0, 0)
+                )
+                idx.value = t + 1
+                att = gathered_decode_attention(q, ck.value, cv.value, t).astype(
+                    x.dtype
+                )
         else:
             if self.rotary:
                 q, k = apply_rotary(q), apply_rotary(k)
@@ -213,6 +251,10 @@ class TransformerLM(nn.Module):
     pos_embedding: str = "learned"  # learned (table, capped at max_len) | rotary
     decode: bool = False  # single-token KV-cache steps (see generate())
     collect_kv: bool = False  # sow per-block K/V (generate()'s prefill)
+    # Paged decode (engine.ContinuousBatchingEngine): >0 makes every block's
+    # cache a shared pool addressed by the PagedState passed via paged=.
+    kv_num_blocks: int = 0
+    kv_block_size: int = 16
     remat: bool = False  # checkpoint each block: O(L) -> O(1) activations
     # What the per-block checkpoint SAVES (only meaningful with remat=True):
     #   "full"          — save nothing: every op recomputed in the backward
@@ -228,7 +270,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(
-        self, tokens: jax.Array, mesh=None, return_features: bool = False
+        self, tokens: jax.Array, mesh=None, return_features: bool = False,
+        paged=None,
     ) -> jax.Array:
         """Logits [B, T, V] — or pre-head features [B, T, D] with
         ``return_features=True``, for ``ops.xent.lm_head_xent``'s chunked
@@ -244,7 +287,11 @@ class TransformerLM(nn.Module):
         )
         if self.pos_embedding == "learned":
             pos_idx = jnp.arange(T)[None, :]
-            if self.decode:
+            if self.decode and paged is not None:
+                # Paged decode: each slot sits at its own position — the
+                # per-slot lengths ARE the position counter.
+                pos_idx = pos_idx + paged.lengths[:, None]
+            elif self.decode:
                 # The LM owns its position counter (how many tokens have
                 # been decoded) rather than peeking at a child block's cache.
                 ctr = self.variable(
@@ -270,7 +317,7 @@ class TransformerLM(nn.Module):
             block_cls = Block
         for i in range(self.num_layers):
             use_moe = self.moe_num_experts and i % self.moe_every == self.moe_every - 1
-            x = block_cls(
+            block = block_cls(
                 self.d_model,
                 self.num_heads,
                 self.attention,
@@ -282,8 +329,13 @@ class TransformerLM(nn.Module):
                 max_len=self.max_len,
                 collect_kv=self.collect_kv,
                 num_kv_heads=self.num_kv_heads,
+                kv_num_blocks=self.kv_num_blocks,
+                kv_block_size=self.kv_block_size,
                 name=f"block{i}",
-            )(x, mesh)
+            )
+            # paged stays out of the remat-wrapped call (remat only wraps
+            # the non-decode path, where paged is always None).
+            x = block(x, mesh) if paged is None else block(x, mesh, paged)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         head = nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")
         if return_features:

@@ -117,6 +117,20 @@ _M_BROKER_FAILOVERS = _REG.counter(
     "serve_client_broker_failovers_total",
     "discovery refreshes moved to a different broker in the list",
 )
+_M_QPS = _REG.gauge(
+    "serve_qps", "requests answered per second (sliding ~1s window)"
+)
+_M_QWAIT = _REG.gauge(
+    "serve_queue_wait_s",
+    "EMA of request queue wait, enqueue -> service take (the autoscaler's "
+    "serve grow signal)",
+)
+_M_PAD_TOKENS = _REG.counter(
+    "serve_pad_tokens_total",
+    "tokens of padding waste: bucket pad rows and decode overrun in the "
+    "batch-synchronous arm, prompt-bucket padding in the engine arm — "
+    "subtract from gross throughput to get REAL tokens/s",
+)
 _M_PHASE = _REG.histogram(
     "serve_phase_seconds",
     "per-request serve latency by phase: admission (handler entry -> "
@@ -195,26 +209,48 @@ class AdmissionController:
       — deliberately simple and slightly conservative; until a first batch
       has been timed there is no estimate and only ``queue_full`` applies.
 
+    ``per_token=True`` switches the estimate from per-batch to per-token
+    units for the continuous-batching engine, where "a batch" is not the
+    unit of service: ``note_service(seconds, tokens)`` maintains an EMA of
+    seconds-per-emitted-token and the wait estimate is ``pending tokens *
+    that EMA``, with the pending-token count supplied by the engine through
+    the ``pending_tokens`` callable (called under the service lock — it must
+    not block or re-enter).
+
     Thread-safe; ``note_service`` is fed by the serve loop after every
-    batch.
+    batch (or engine decode step).
     """
 
     def __init__(self, *, max_queue: int = 128, batch_size: int = 16,
-                 alpha: float = 0.25):
+                 alpha: float = 0.25, per_token: bool = False,
+                 pending_tokens: Optional[Callable[[], int]] = None):
         self.max_queue = int(max_queue)
         self.batch_size = max(1, int(batch_size))
         self.alpha = float(alpha)
+        self.per_token = bool(per_token)
+        self._pending_tokens = pending_tokens
         self._ema: Optional[float] = None
         self._lock = threading.Lock()
 
-    def note_service(self, seconds: float) -> None:
+    def note_service(self, seconds: float, tokens: Optional[int] = None) -> None:
+        """Feed one service interval.  Per-batch mode ignores ``tokens``;
+        per-token mode folds ``seconds / tokens`` into the EMA (a step that
+        emitted nothing carries no signal and is dropped)."""
+        if self.per_token:
+            if not tokens:
+                return
+            value = float(seconds) / int(tokens)
+        else:
+            value = float(seconds)
         with self._lock:
             if self._ema is None:
-                self._ema = float(seconds)
+                self._ema = value
             else:
-                self._ema += self.alpha * (float(seconds) - self._ema)
+                self._ema += self.alpha * (value - self._ema)
 
     def ema_batch_seconds(self) -> Optional[float]:
+        """The EMA in this controller's service unit: seconds per batch
+        (default) or seconds per emitted token (``per_token=True``)."""
         with self._lock:
             return self._ema
 
@@ -225,6 +261,10 @@ class AdmissionController:
             ema = self._ema
         if ema is None:
             return None
+        if self.per_token:
+            if self._pending_tokens is None:
+                return None  # engine wiring absent: only queue_full applies
+            return self._pending_tokens() * ema
         batches_ahead = math.ceil((depth + 1) / self.batch_size)
         return (batches_ahead + 1) * ema
 
@@ -245,10 +285,11 @@ class AdmissionController:
 # --------------------------------------------------------------------------
 class _Request:
     __slots__ = ("prompt", "ret", "waiters", "t_enq", "deadline_at", "req_id",
-                 "single", "tctx")
+                 "single", "tctx", "max_new")
 
     def __init__(self, prompt, ret, t_enq, deadline_at, req_id, single,
-                 tctx=None):
+                 tctx=None, max_new=None):
+        self.max_new = max_new  # per-request token budget (None = server default)
         self.prompt = prompt
         self.ret = ret
         self.waiters: List[Any] = []  # dedup'd rets riding the same req_id
@@ -283,7 +324,9 @@ class ServeService:
                  name: str = "generate", version: int = 0,
                  batch_size: int = 16, dynamic_batching: bool = True,
                  max_queue: int = 128, dedup_ttl: float = 60.0,
-                 pad_buckets: bool = True):
+                 pad_buckets: bool = True,
+                 per_request_tokens: bool = False,
+                 default_max_new: int = 16):
         self._rpc = rpc
         self._step_fn = step_fn
         self._params = params
@@ -292,10 +335,20 @@ class ServeService:
         self._dynamic = bool(dynamic_batching)
         self._pad_buckets = bool(pad_buckets) and self._dynamic
         self._dedup_ttl = float(dedup_ttl)
+        # per_request_tokens: step_fn grows a third argument — an int32
+        # per-row token-budget vector — and each caller's reply is sliced
+        # to its own budget.  The batch still decodes to the row max (the
+        # convoy the engine arm exists to remove); the overrun is counted
+        # as pad-token waste so the A/B compares real throughput.
+        self._per_request_tokens = bool(per_request_tokens)
+        self._default_max_new = int(default_max_new)
         self.admission = AdmissionController(
             max_queue=max_queue,
             batch_size=self._batch_size if self._dynamic else 1,
         )
+        # serve_qps window (shared by the engine subclass's loop).
+        self._qps_t0 = time.monotonic()
+        self._qps_n = 0
         self._lock = threading.Lock()
         self._queue: List[_Request] = []
         self._inflight: Dict[str, _Request] = {}  # req_id -> queued/served req
@@ -358,8 +411,12 @@ class ServeService:
         )
 
     # ------------------------------------------------------------ admission
-    def _on_request(self, ret, prompt, deadline_s: Optional[float] = None,
+    def _on_request(self, ret, prompt, max_new_tokens=None,
+                    deadline_s: Optional[float] = None,
                     req_id: Optional[str] = None):
+        # max_new_tokens rides positionally after the prompt so
+        # ``client.submit(prompt, max_new)`` works against both serving
+        # arms; legacy single-argument callers get the server default.
         now = time.monotonic()
         with self._lock:
             if self._closed:
@@ -404,6 +461,7 @@ class ServeService:
                 req_id=req_id,
                 single=arr.ndim == 1,
                 tctx=telemetry.current_context(),
+                max_new=None if max_new_tokens is None else int(max_new_tokens),
             )
             self._queue.append(req)
             if req_id is not None:
@@ -439,7 +497,26 @@ class ServeService:
             s["wait_s_sum"] += wait
             s["wait_s_max"] = max(s["wait_s_max"], wait)
             _M_PHASE.observe(wait, phase="queue")
+            self._note_queue_wait(wait)
         return batch
+
+    # Smoothed queue wait + answered-per-second gauges: the autoscaler's
+    # serve signals (PeerSample.serve_wait / serve_qps).
+    _WAIT_ALPHA = 0.3
+
+    def _note_queue_wait(self, wait: float) -> None:
+        ema = getattr(self, "_wait_ema", None)
+        self._wait_ema = (wait if ema is None
+                          else ema + self._WAIT_ALPHA * (wait - ema))
+        _M_QWAIT.set(self._wait_ema)
+
+    def _note_answered(self, n: int, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._qps_n += n
+        dt = now - self._qps_t0
+        if dt >= 1.0:
+            _M_QPS.set(self._qps_n / dt)
+            self._qps_t0, self._qps_n = now, 0
 
     def _respond(self, req: _Request, value, err: Optional[str]) -> None:
         now = time.monotonic()
@@ -479,16 +556,38 @@ class ServeService:
             t_asm = time.monotonic()
             prompts = np.concatenate([r.prompt for r in batch], axis=0)
             n = prompts.shape[0]
+            budgets = None
+            if self._per_request_tokens:
+                budgets = np.concatenate([
+                    np.full(r.prompt.shape[0],
+                            r.max_new if r.max_new else self._default_max_new,
+                            dtype=np.int32)
+                    for r in batch
+                ])
             if self._pad_buckets and n < self._batch_size:
                 b = bucket(n, self._batch_size)
                 if n < b:
                     pad = np.repeat(prompts[-1:], b - n, axis=0)
                     prompts = np.concatenate([prompts, pad], axis=0)
                     self._stats["bucket_pad_rows"] += b - n
+                    # Pad rows burn a full prompt + decode budget each.
+                    waste = (b - n) * prompts.shape[1]
+                    if budgets is not None:
+                        budgets = np.concatenate([
+                            budgets,
+                            np.full(b - n, budgets.max(), dtype=np.int32),
+                        ])
+                        waste += (b - n) * int(budgets.max())
+                    _M_PAD_TOKENS.inc(waste)
+            if budgets is not None:
+                # The convoy cost of batch-synchronous decode, made visible:
+                # every row steps to the batch max budget.
+                _M_PAD_TOKENS.inc(int((budgets[:n].max() - budgets[:n]).sum()))
             t0 = time.monotonic()
             _M_PHASE.observe(t0 - t_asm, phase="batch_assembly")
+            step_args = (prompts,) if budgets is None else (prompts, budgets)
             try:
-                out = np.asarray(self._step_fn(self._params, prompts))[:n]
+                out = np.asarray(self._step_fn(self._params, *step_args))[:n]
             except Exception as e:  # noqa: BLE001
                 if len(batch) == 1:
                     # Already unbatched: the failure belongs to this caller.
@@ -502,14 +601,25 @@ class ServeService:
                 for req in batch:
                     rows = req.prompt.shape[0]
                     try:
-                        o = np.asarray(self._step_fn(self._params, req.prompt))[:rows]
+                        args = ((req.prompt,) if budgets is None else
+                                (req.prompt, np.full(
+                                    rows,
+                                    req.max_new if req.max_new
+                                    else self._default_max_new,
+                                    dtype=np.int32)))
+                        o = np.asarray(self._step_fn(self._params, *args))[:rows]
                     except Exception as e2:  # noqa: BLE001
                         self._respond(req, None, f"generate failed: {e2}")
                         continue
-                    self._respond(req, o[0] if req.single else o, None)
+                    self._respond(req, self._clip(req, o), None)
                 return
             dt = time.monotonic() - t0
-            self.admission.note_service(dt)
+            if budgets is not None:
+                self.admission.note_service(
+                    dt, tokens=int(budgets[:n].sum())
+                )
+            else:
+                self.admission.note_service(dt)
             _M_PHASE.observe(dt, phase="device")
             t_reply = time.monotonic()
             i = 0
@@ -517,8 +627,17 @@ class ServeService:
                 rows = req.prompt.shape[0]
                 part = out[i:i + rows]
                 i += rows
-                self._respond(req, part[0] if req.single else part, None)
+                self._respond(req, self._clip(req, part), None)
             _M_PHASE.observe(time.monotonic() - t_reply, phase="reply")
+
+    def _clip(self, req: _Request, rows: np.ndarray):
+        """Slice one request's output rows down to its own token budget
+        (per-request-tokens mode decodes the whole batch to the row max)."""
+        if self._per_request_tokens and rows.ndim == 2:
+            budget = req.max_new if req.max_new else self._default_max_new
+            tp = req.prompt.shape[1]
+            rows = rows[:, :tp + budget]
+        return rows[0] if req.single else rows
 
     async def loop(self, total=None) -> int:
         """Serve until ``total`` requests have been answered (None =
@@ -544,12 +663,21 @@ class ServeService:
                     except asyncio.TimeoutError:
                         pass
                     self._wake.clear()
+                    # Close the rate window even with nothing answered, so
+                    # serve_qps decays to the true (zero) rate under silence
+                    # — the autoscaler's idle-shrink signal reads it.  Same
+                    # for the wait EMA: an empty queue means waits are now
+                    # zero, not whatever the last busy spell left behind.
+                    self._note_answered(0)
+                    if not self._queue:
+                        self._note_queue_wait(0.0)
                     continue
                 rows = sum(r.prompt.shape[0] for r in batch)
                 served += rows
                 self._stats["iterations"] += 1
                 self._stats["served"] += rows
                 self._run_batch(batch)
+                self._note_answered(len(batch))
         finally:
             self._loop = None
             self._wake = None
@@ -1130,21 +1258,27 @@ class ServeReplica:
     versions and stages them on the service.
     """
 
-    def __init__(self, rpc: Rpc, step_fn: Callable, params, *,
+    def __init__(self, rpc: Rpc, step_fn: Optional[Callable], params, *,
                  name: str = "generate", version: int = 0,
                  batch_size: int = 16, dynamic_batching: bool = True,
                  max_queue: int = 128, broker: Optional[str] = None,
                  brokers: Sequence[str] = (),
                  broker_name: str = "broker", group: str = "serve",
                  role: str = "replica", publisher: Optional[str] = None,
-                 model_channel: str = "model", poll_interval: float = 0.5):
+                 model_channel: str = "model", poll_interval: float = 0.5,
+                 per_request_tokens: bool = False, default_max_new: int = 16,
+                 service: Optional[ServeService] = None):
         self._rpc = rpc
         # Every replica is scrapable/profilable by the cohort aggregator.
         telemetry.install_rpc_handlers(rpc)
-        self.service = ServeService(
+        # A pre-built service (e.g. engine.EngineService — continuous
+        # batching under the same admission/dedup/hot-swap contract) plugs
+        # in here; otherwise the classic batch-synchronous plane is built.
+        self.service = service if service is not None else ServeService(
             rpc, step_fn, params, name=name, version=version,
             batch_size=batch_size, dynamic_batching=dynamic_batching,
-            max_queue=max_queue,
+            max_queue=max_queue, per_request_tokens=per_request_tokens,
+            default_max_new=default_max_new,
         )
         self._group: Optional[Group] = None
         self._pump: Optional[threading.Thread] = None

@@ -133,6 +133,42 @@ step "serving soak (seeded, ~40 s smoke: replica SIGKILL mid-stream + live hot-s
 # (docs/RESILIENCE.md "Serving soak").
 python scripts/serve_soak.py --smoke || fail=1
 
+step "paged-attention / engine tests (paged==dense bit-exact MHA+GQA, pool invariants, one-compile decode)"
+python -m pytest tests/test_paged_attention.py -q || fail=1
+
+step "engine serving soak (same SIGKILL + hot-swap gates through the continuous-batching arm)"
+# The engine replica must satisfy the identical resilience contract as the
+# batch-synchronous arm: zero lost requests across the kill, swap lands
+# between iterations, no swap-attributable rejects (DESIGN.md §6c).
+python scripts/serve_soak.py --smoke --engine || fail=1
+
+step "elasticity swing soak (calm -> 5x surge -> quiet through real engine replicas + autoscaler)"
+# Gates: fleet grows on sustained serve_queue_wait_s during the surge,
+# reaches two replicas, gracefully shrinks back on serve_idle when quiet,
+# and zero requests are lost across the scale events (DESIGN.md §6c;
+# --service_delay_ms pins per-iteration cost so saturation is
+# deterministic on any host).
+python scripts/serve_soak.py --smoke --swing || fail=1
+
+step "engine A/B smoke (continuous batching vs batch-sync under mixed budgets; folds serve rows into BENCH_LOCAL.json)"
+# Same broker, same admission contract, same paced open-loop load — only
+# the service loop differs.  --check fails on any hard/deadline error in
+# either arm or engine tokens/s below the baseline's; the fresh rows merge
+# (not clobber) into BENCH_LOCAL.json's serve_qps section, preserving the
+# curated saturation capture alongside this smoke (DESIGN.md §6c).
+ab_log="${TMPDIR:-/tmp}/moolib_ci_engine_ab.log"
+python benchmarks/serve_bench.py --qps 100 --seconds 6 --engine \
+  --mixed_tokens 8 8 32 96 --d_model 128 --layers 2 --heads 4 \
+  --batch_sizes 8 --max_new_tokens 96 --deadline_s 20 --max_queue 256 \
+  --check > "$ab_log" 2>&1
+ab_rc=$?
+cat "$ab_log"
+if [ "$ab_rc" = 0 ]; then
+  python benchmarks/fold_capture.py --local "$ab_log" || fail=1
+else
+  fail=1
+fi
+
 step "broker HA tests (hot-standby failover, partition healing, generation fencing)"
 python -m pytest tests/test_group.py -q \
   -k "broker_failover or partition_heals or split_brain or zombie or stale_push or standby_serves" || fail=1

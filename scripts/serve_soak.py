@@ -24,10 +24,20 @@ docs/RESILIENCE.md "Serving") end to end with real processes:
 Exit 0 only when every gate holds; the JSON verdict goes to ``--out`` (the
 committed ``SOAK_r07_serve.json`` capture) or stdout.
 
+``--engine`` serves every replica through the continuous-batching engine
+(``lm_serve --engine``) under the same kill + hot-swap gates — the engine
+inherits the resilience contract, so the soak must not care which service
+loop answered.  ``--swing`` runs the QPS-elasticity phase instead (see
+:func:`run_swing`): calm -> 5x surge -> quiet offered load against an
+autoscaled fleet, gated on a ``serve_wait`` grow, a ``serve_idle``
+graceful shrink, and zero lost requests.
+
 Usage::
 
     python scripts/serve_soak.py --smoke                  # ~1 min CI profile
     python scripts/serve_soak.py --seed 7 --out SOAK_r07_serve.json
+    python scripts/serve_soak.py --smoke --engine         # engine arm
+    python scripts/serve_soak.py --smoke --swing          # elasticity swing
 """
 
 from __future__ import annotations
@@ -100,12 +110,242 @@ def spawn_replica(name: str, port: int, broker_addr: str, flags) -> tuple:
         "--max_queue", str(flags.max_queue),
         "--seed", str(flags.seed),
     ]
+    if flags.engine:
+        cmd.append("--engine")
     log_path = f"/tmp/serve_soak_{name}.log"
     with open(log_path, "w") as lf:
         proc = subprocess.Popen(cmd, stdout=lf, stderr=subprocess.STDOUT,
                                 text=True, env=env, cwd=ROOT,
                                 start_new_session=True)
     return proc, log_path
+
+
+def run_swing(flags) -> int:
+    """QPS-elasticity swing (ISSUE 12 satellite): a one-replica engine
+    fleet under a 5x offered-load swing, supervised by the autoscaler's
+    serving rules end to end with real processes.
+
+    Phases: **calm** (qps_low, one replica keeps up) -> **surge** (5 x
+    qps_low, the replica saturates, ``serve_queue_wait_s`` climbs, the
+    policy grows a second replica) -> **quiet** (back to qps_low, the
+    fleet drains, sustained idle shrinks it back via the localdir
+    decommission flag — a graceful leave, not a kill).
+
+    ``--service_delay_ms`` pins per-iteration service time, so "one
+    replica saturates under the surge but two do not" holds on any host
+    instead of depending on CPU speed.  Gates: a ``serve_wait`` grow
+    fired during the surge, a ``serve_idle`` shrink brought the fleet
+    back to one, the decommissioned replica exited cleanly, and zero
+    requests were lost (admission rejects are the plane working).
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from moolib_tpu import Broker
+    from moolib_tpu.autoscaler import (
+        Autoscaler,
+        AutoscalePolicy,
+        SubprocessFleet,
+    )
+    from moolib_tpu.serving import ServeClient, is_overload_error
+
+    qps_low = flags.qps if flags.qps is not None else 2.0
+    qps_high = 5.0 * qps_low
+    calm_s = 8.0 if flags.smoke else 15.0
+    surge_s = 35.0 if flags.smoke else 60.0
+    quiet_s = 25.0 if flags.smoke else 45.0
+    log(f"swing: qps {qps_low} -> {qps_high} -> {qps_low} "
+        f"({calm_s}/{surge_s}/{quiet_s}s), service_delay="
+        f"{flags.service_delay_ms}ms")
+
+    broker_addr = f"127.0.0.1:{free_port()}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.listen(broker_addr)
+    stop_pump = threading.Event()
+
+    def pump():
+        while not stop_pump.is_set():
+            broker.update()
+            stop_pump.wait(0.05)
+
+    threading.Thread(target=pump, daemon=True).start()
+    base_dir = tempfile.mkdtemp(prefix="serve_swing_")
+
+    def spawn(name: str, localdir: str) -> subprocess.Popen:
+        env = dict(
+            os.environ,
+            PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+            MOOLIB_TELEMETRY_DIR=localdir,
+            MOOLIB_TELEMETRY_INTERVAL="1",
+        )
+        cmd = [
+            sys.executable, "-m", "moolib_tpu.examples.lm_serve",
+            "--listen", f"127.0.0.1:{free_port()}",
+            "--broker", broker_addr,
+            "--name", name,
+            "--localdir", localdir,
+            "--engine",
+            # Capacity pin: one replica serves ~slots/(max_new x delay)
+            # req/s (4/(4 x 0.15) ~ 6.7 with defaults), so the 5x surge
+            # (10 req/s) saturates one replica and two absorb it.
+            "--slots", str(max(1, flags.batch_size // 2)),
+            "--vocab", str(flags.vocab),
+            "--seq_len", str(flags.seq_len),
+            "--d_model", str(flags.d_model),
+            "--layers", str(flags.layers),
+            "--heads", str(flags.heads),
+            "--batch_size", str(flags.batch_size),
+            "--max_new_tokens", str(flags.max_new_tokens),
+            "--max_queue", str(flags.max_queue),
+            "--service_delay_ms", str(flags.service_delay_ms),
+            "--seed", str(flags.seed),
+        ]
+        lf = open(os.path.join(localdir, "replica.log"), "ab")
+        return subprocess.Popen(cmd, stdout=lf, stderr=subprocess.STDOUT,
+                                env=env, cwd=ROOT, start_new_session=True)
+
+    fleet = SubprocessFleet(spawn, base_dir, name_prefix="swing")
+    policy = AutoscalePolicy(
+        min_peers=1, max_peers=2, cooldown_s=5.0,
+        serve_wait_grow_s=0.4, serve_wait_polls=2,
+        # The quiet trickle still lands ~qps_low answers/s fleet-wide;
+        # idle means "at or below the calm rate with a cold queue".
+        serve_idle_qps=max(0.1, qps_low), serve_idle_occupancy=0.5,
+        serve_idle_polls=3,
+    )
+    scaler = Autoscaler(policy, fleet, poll_interval=1.0)
+    result = {
+        "soak": "serve_swing", "seed": flags.seed, "smoke": flags.smoke,
+        "qps_low": qps_low, "qps_high": qps_high,
+        "service_delay_ms": flags.service_delay_ms,
+    }
+    client = None
+    try:
+        fleet.grow()
+        client = ServeClient(broker=broker_addr, deadline_s=flags.deadline_s,
+                             attempt_timeout=2.0, max_attempts=8)
+        client.wait_for_replicas(1, timeout=flags.ready_timeout)
+        rng = np.random.default_rng(flags.seed)
+        warm = rng.integers(2, flags.vocab, flags.seq_len).astype(np.int32)
+        client.call(warm)
+
+        outcomes = {"ok": 0, "reject": 0, "deadline": 0, "error": 0}
+        error_samples: list = []
+        lock = threading.Lock()
+        pending = []
+
+        def on_done(fut):
+            exc = fut.exception()
+            with lock:
+                if exc is None:
+                    outcomes["ok"] += 1
+                elif is_overload_error(exc):
+                    outcomes["reject"] += 1
+                elif "deadline" in str(exc).lower():
+                    outcomes["deadline"] += 1
+                else:
+                    outcomes["error"] += 1
+                    if len(error_samples) < 5:
+                        error_samples.append(str(exc)[:300])
+
+        phase_cohorts = {}
+        for label, q, dur in (("calm", qps_low, calm_s),
+                              ("surge", qps_high, surge_s),
+                              ("quiet", qps_low, quiet_s)):
+            log(f"phase {label}: qps={q} for {dur}s (cohort={fleet.size()})")
+            interval = 1.0 / q
+            n = max(1, int(dur * q))
+            t0p = time.monotonic()
+            peak = fleet.size()
+            for i in range(n):
+                target = t0p + i * interval
+                # Supervise while pacing: the scaler self-limits to its
+                # poll interval, so calling it every beat is free.
+                while True:
+                    scaler.step()
+                    now = time.monotonic()
+                    if now >= target:
+                        break
+                    time.sleep(min(0.1, target - now))
+                p = rng.integers(2, flags.vocab,
+                                 flags.seq_len).astype(np.int32)
+                fut = client.submit(p)
+                fut.add_done_callback(on_done)
+                pending.append(fut)
+                peak = max(peak, fleet.size())
+            phase_cohorts[label] = {"end": fleet.size(), "peak": peak}
+        # Post-quiet grace: keep supervising until the idle shrink lands
+        # and the decommissioned replica actually exits.
+        deadline = time.monotonic() + 30.0
+        shrunk = False
+        while time.monotonic() < deadline:
+            scaler.step()
+            fleet.reap()
+            shrunk = (any(e["action"] == "shrink" for e in scaler.events)
+                      and fleet.size() <= 1)
+            if shrunk:
+                break
+            time.sleep(0.25)
+        unfinished = 0
+        for fut in pending:
+            try:
+                fut.result(flags.deadline_s + 10.0)
+            except TimeoutError:
+                unfinished += 1
+            except Exception:  # noqa: BLE001 — classified in on_done
+                pass
+        lost = outcomes["deadline"] + outcomes["error"] + unfinished
+        grow_reasons = [e["reason"] for e in scaler.events
+                        if e["action"] == "grow"]
+        shrink_reasons = [e["reason"] for e in scaler.events
+                          if e["action"] == "shrink"]
+        result.update(
+            requests=len(pending),
+            ok=outcomes["ok"], rejects=outcomes["reject"],
+            deadline_errors=outcomes["deadline"], errors=outcomes["error"],
+            unfinished_futures=unfinished, lost_requests=lost,
+            error_samples=error_samples,
+            phase_cohorts=phase_cohorts,
+            scale_events=[{k: e[k] for k in ("action", "peer", "reason")}
+                          for e in scaler.events],
+        )
+        gates = {
+            "grew_on_surge_wait": "serve_wait" in grow_reasons,
+            "fleet_reached_two": phase_cohorts["surge"]["peak"] >= 2,
+            "shrank_back_on_idle": shrunk
+                                   and "serve_idle" in shrink_reasons,
+            "zero_lost_requests": lost == 0,
+        }
+        result["gates"] = gates
+        result["pass"] = all(gates.values())
+    except Exception as e:  # noqa: BLE001 — the verdict must always be written
+        log(f"FAILED: {e}")
+        result["pass"] = False
+        result["failure"] = str(e)
+    finally:
+        if client is not None:
+            client.close()
+        stop_pump.set()
+        broker.close()
+        fleet.terminate_all()
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    payload = json.dumps(result, indent=1)
+    if flags.out:
+        with open(flags.out, "w") as f:
+            f.write(payload + "\n")
+        log(f"verdict -> {flags.out}")
+    print(payload)
+    if result.get("pass"):
+        log("PASS: fleet grew under the surge, shrank back when idle, "
+            "zero lost requests")
+        return 0
+    log("FAIL")
+    return 1
 
 
 def main(argv=None) -> int:
@@ -128,7 +368,21 @@ def main(argv=None) -> int:
     ap.add_argument("--max_queue", type=int, default=256)
     ap.add_argument("--ready_timeout", type=float, default=300.0)
     ap.add_argument("--out", default=None, help="write the JSON verdict here")
+    ap.add_argument("--engine", action="store_true",
+                    help="replicas serve through the continuous-batching "
+                    "engine (lm_serve --engine); same gates")
+    ap.add_argument("--swing", action="store_true",
+                    help="run the QPS-elasticity load-swing phase instead "
+                    "of the kill+swap soak: calm -> 5x surge -> quiet, "
+                    "gated on autoscaler grow/shrink + zero lost requests "
+                    "(--qps sets the calm rate, default 2)")
+    ap.add_argument("--service_delay_ms", type=float, default=150.0,
+                    help="swing only: per-iteration service delay handed to "
+                    "lm_serve so one replica deterministically saturates "
+                    "under the surge")
     flags = ap.parse_args(argv)
+    if flags.swing:
+        return run_swing(flags)
     if flags.window_s is None:
         flags.window_s = 20.0 if flags.smoke else 60.0
     if flags.qps is None:

@@ -137,6 +137,98 @@ def test_sample_from_snapshot_extracts_signals():
     assert s.steps == 42.0
 
 
+# ------------------------------------------------ serving rules (ISSUE 12)
+def _serve_sample(name="r0", t=NOW, qps=None, wait=None, occ=None):
+    return PeerSample(name, t, serve_qps=qps, serve_wait=wait,
+                      slot_occupancy=occ)
+
+
+def test_policy_grows_on_sustained_queue_wait():
+    pol = AutoscalePolicy(1, 4, cooldown_s=0.0, serve_wait_grow_s=0.5,
+                          serve_wait_polls=2)
+    hot = [_serve_sample(qps=20.0, wait=0.8, occ=1.0)]
+    assert pol.decide(hot, 2, NOW).action == "hold"  # one poll isn't a trend
+    d = pol.decide(hot, 2, NOW + 1)
+    assert (d.action, d.reason, d.target) == ("grow", "serve_wait", 3)
+    # A calm poll in between resets the streak.
+    pol2 = AutoscalePolicy(1, 4, cooldown_s=0.0, serve_wait_polls=2)
+    assert pol2.decide(hot, 2, NOW).action == "hold"
+    assert pol2.decide([_serve_sample(qps=20.0, wait=0.01)], 2,
+                       NOW + 1).action == "hold"
+    assert pol2.decide(hot, 2, NOW + 2).action == "hold"
+    assert pol2.decide(hot, 2, NOW + 3).action == "grow"
+
+
+def test_policy_serve_wait_respects_max_peers():
+    pol = AutoscalePolicy(1, 2, cooldown_s=0.0, serve_wait_polls=1)
+    d = pol.decide([_serve_sample(wait=5.0)], 2, NOW)
+    assert (d.action, d.reason) == ("hold", "serve_wait_at_max")
+
+
+def test_policy_shrinks_idle_serving_fleet():
+    pol = AutoscalePolicy(1, 4, cooldown_s=0.0, serve_idle_qps=0.1,
+                          serve_idle_polls=3)
+    idle = [_serve_sample(qps=0.0, wait=0.0, occ=0.0),
+            _serve_sample("r1", qps=0.0, wait=0.0, occ=0.0)]
+    assert pol.decide(idle, 2, NOW).action == "hold"
+    assert pol.decide(idle, 2, NOW + 1).action == "hold"
+    d = pol.decide(idle, 2, NOW + 2)
+    assert (d.action, d.reason, d.target) == ("shrink", "serve_idle", 1)
+    # Busy slots veto the shrink even at zero answered QPS (long decodes
+    # in flight answer nothing for a while but are NOT idle).
+    pol2 = AutoscalePolicy(1, 4, cooldown_s=0.0, serve_idle_polls=1)
+    busy = [_serve_sample(qps=0.0, wait=0.0, occ=0.9)]
+    assert pol2.decide(busy, 2, NOW).action == "hold"
+
+
+def test_policy_serving_rules_dormant_for_training_peers():
+    """Training samples carry no serving signals: the serving rules must
+    neither fire nor shadow the starvation rule."""
+    pol = AutoscalePolicy(1, 4, starvation_depth=0.0, cooldown_s=0.0,
+                          serve_idle_polls=1)
+    d = pol.decide([_sample(q=0.0)], 2, NOW)
+    assert (d.action, d.reason) == ("grow", "starved")
+
+
+def test_policy_serving_signals_shadow_training_rules():
+    """A serving fleet exposes no batcher depth, so the training starvation
+    rule must never fire for it — serving samples route to the serving
+    rules and steady traffic holds."""
+    pol = AutoscalePolicy(1, 4, cooldown_s=0.0)
+    steady = [_serve_sample(qps=50.0, wait=0.01, occ=0.6)]
+    d = pol.decide(steady, 2, NOW)
+    assert (d.action, d.reason) == ("hold", "steady")
+
+
+def test_sample_from_snapshot_extracts_serving_signals():
+    snap = {
+        "time": NOW,
+        "pid": 1,
+        "metrics": {
+            "serve_qps": {"kind": "gauge", "help": "", "series": [
+                {"labels": {}, "value": 12.5},
+            ]},
+            "serve_queue_depth": {"kind": "gauge", "help": "", "series": [
+                {"labels": {}, "value": 3.0},
+            ]},
+            "serve_queue_wait_s": {"kind": "gauge", "help": "", "series": [
+                {"labels": {}, "value": 0.75},
+            ]},
+            "serve_engine_slot_occupancy": {
+                "kind": "gauge", "help": "", "series": [
+                    {"labels": {}, "value": 0.875},
+                ],
+            },
+        },
+    }
+    s = sample_from_snapshot("r0", snap)
+    assert s.serve_qps == 12.5
+    assert s.serve_depth == 3.0
+    assert s.serve_wait == 0.75
+    assert s.slot_occupancy == 0.875
+    assert s.queue_depth is None  # no training signals on a serving peer
+
+
 def test_sample_falls_back_to_ready_depth():
     snap = {"time": NOW, "metrics": {
         "batcher_ready_depth": {"kind": "gauge", "help": "",

@@ -44,6 +44,9 @@ MODULES = [
     ("moolib_tpu.engine.service", "Engine: serving-contract adapter"),
     ("moolib_tpu.ops.paged_attention", "Ops: paged decode attention"),
     ("moolib_tpu.testing.faults", "Testing: seeded fault injection"),
+    ("moolib_tpu.testing.lockgraph", "Testing: lock-order race detection"),
+    ("moolib_tpu.analysis", "Analysis: contract lint (mtlint)"),
+    ("moolib_tpu.analysis.checks", "Analysis: check catalog"),
     ("moolib_tpu.parallel", "Parallelism (package)"),
     ("moolib_tpu.parallel.mesh", "Parallelism: mesh + shardings"),
     ("moolib_tpu.parallel.collectives", "Parallelism: collectives"),

@@ -11,7 +11,14 @@ mesh sharding (dp/tp/sp/ep), ring-attention sequence parallelism, and
 flax/optax model + ops libraries (``models``, ``ops``).
 """
 
-from . import telemetry  # noqa: F401  (stdlib-only; rpc/core depends on it)
+# Lock-order race detection must swap the threading.Lock/RLock factories
+# BEFORE any submodule (telemetry included) creates a module-level lock.
+# Strict no-op unless MOOLIB_LOCKGRAPH=1; stdlib-only import.
+from .testing import lockgraph as _lockgraph
+
+_lockgraph.install_from_env()
+
+from . import telemetry  # noqa: E402,F401  (stdlib-only; rpc/core depends on it)
 from . import utils  # noqa: F401
 from .utils import create_uid, set_log_level, set_logging, set_max_threads  # noqa: F401
 from .rpc import Future, Queue, Rpc, RpcDeferredReturn, RpcError  # noqa: F401

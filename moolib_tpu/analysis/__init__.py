@@ -1,0 +1,32 @@
+"""mtlint — the project contract lint (``python -m moolib_tpu.analysis``).
+
+Stdlib-only on purpose: the lint runs in CI before anything heavy imports,
+and it must be able to *parse* modules whose runtime dependencies (jax,
+numpy) it never needs.  See :mod:`moolib_tpu.analysis.core` for the
+finding/pragma/baseline machinery, :mod:`moolib_tpu.analysis.checks` for
+the check catalog, and ``docs/ANALYSIS.md`` for the user guide.
+"""
+
+from .core import (  # noqa: F401
+    Check,
+    Finding,
+    all_checks,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    register,
+    write_baseline,
+)
+from .cli import main  # noqa: F401
+
+__all__ = [
+    "Check",
+    "Finding",
+    "all_checks",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "main",
+    "register",
+    "write_baseline",
+]

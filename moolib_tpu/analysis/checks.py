@@ -1,0 +1,548 @@
+"""mtlint launch checks: the contracts PRs 1–12 bled for, as AST rules.
+
+Each check names the PR that motivated it (see docs/ANALYSIS.md for the
+full catalog with rationale); the scopes are the modules where the
+contract actually holds, so a check never nags code the contract was
+never meant to govern.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Check, Context, Finding, ModuleSource, register
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+#: the 0 B/frame hot-path modules (PR 5/7: the one-crossing and
+#: zero-crossing actor planes, PR 12: the decode loop).
+HOT_PATHS = (
+    "moolib_tpu/rollout.py",
+    "moolib_tpu/engine/",
+    "moolib_tpu/ops/",
+    "moolib_tpu/envs/jax_envs.py",
+)
+
+#: the threaded planes where lock ordering is load-bearing (PR 8 epoch
+#: push, PR 9/10 serving + broker HA, PR 12 engine service loop).
+LOCKED_PATHS = (
+    "moolib_tpu/group.py",
+    "moolib_tpu/serving.py",
+    "moolib_tpu/accumulator.py",
+    "moolib_tpu/rpc/core.py",
+    "moolib_tpu/engine/",
+    "moolib_tpu/rollout.py",
+)
+
+#: env/rollout code bound by the counter-based seeding contract (PR 7).
+RNG_PATHS = ("moolib_tpu/envs/", "moolib_tpu/rollout.py")
+
+
+def _in(path: str, prefixes: Sequence[str]) -> bool:
+    return any(path.startswith(p) for p in prefixes)
+
+
+def _call_name(mod: ModuleSource, call: ast.Call) -> str:
+    return mod.qualname(call.func)
+
+
+def _jit_donations(mod: ModuleSource) -> Dict[str, Tuple[int, ...]]:
+    """``{callable name: donated positional indices}`` for every
+    ``x = jax.jit(..., donate_argnums=...)`` (plain or ``self.x``) in the
+    module, plus plain ``jax.jit`` bindings with no donation (empty tuple)
+    so recompile-risk knows what is jitted."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        if mod.qualname(node.value.func) != "jax.jit":
+            continue
+        donated: Tuple[int, ...] = ()
+        for kw in node.value.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    donated = (v.value,)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    donated = tuple(
+                        e.value
+                        for e in v.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                    )
+        for tgt in node.targets:
+            name = ast.unparse(tgt) if isinstance(tgt, (ast.Name, ast.Attribute)) else ""
+            if name:
+                out[name] = donated
+    return out
+
+
+def _functions(mod: ModuleSource) -> Iterator[ast.AST]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# ---------------------------------------------------------------------------
+# host-sync (PR 5/7: the 0 B/frame contract)
+# ---------------------------------------------------------------------------
+
+
+@register
+class HostSyncCheck(Check):
+    name = "host-sync"
+    description = (
+        "device_get / block_until_ready / np.asarray / scalar coercion of a "
+        "computation inside the hot-path modules — every one is a host "
+        "round-trip the 0 B/frame actor plane and the one-compile decode "
+        "loop must not take per frame"
+    )
+    scope = staticmethod(lambda path: _in(path, HOT_PATHS))
+
+    _FUNCS = {
+        "jax.device_get": "jax.device_get forces a device->host transfer",
+        "jax.block_until_ready": "jax.block_until_ready stalls dispatch on device completion",
+        "numpy.asarray": "np.asarray on a device value is a blocking D2H copy",
+        "numpy.array": "np.array on a device value is a blocking D2H copy",
+        "numpy.copy": "np.copy on a device value is a blocking D2H copy",
+    }
+    _METHODS = {
+        "block_until_ready": ".block_until_ready() stalls dispatch on device completion",
+        "item": ".item() synchronously fetches a device scalar",
+    }
+    #: inner calls whose scalar coercion is host arithmetic, not a device
+    #: sync: builtins over python ints and environment/config parsing.
+    _HOST_SCALAR_CALLS = {
+        "min", "max", "len", "round", "abs", "divmod",
+        "os.environ.get", "os.getenv",
+    }
+
+    def run(self, mod: ModuleSource, ctx: Context) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = _call_name(mod, node)
+            if qual in self._FUNCS:
+                yield self.finding(mod, node, self._FUNCS[qual])
+                continue
+            if isinstance(node.func, ast.Attribute) and node.func.attr in self._METHODS:
+                yield self.finding(mod, node, self._METHODS[node.func.attr])
+                continue
+            # float(f(x)) / int(x.sum()): coercing the *result of a call* to
+            # a python scalar synchronizes on the whole computation.
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Call)
+                and _call_name(mod, node.args[0]) not in self._HOST_SCALAR_CALLS
+                and not _call_name(mod, node.args[0]).startswith("math.")
+            ):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"{node.func.id}() of a call result synchronously coerces "
+                    "a device scalar to host",
+                )
+
+
+# ---------------------------------------------------------------------------
+# donation-safety (PR 5: the donated-buffer carry contract)
+# ---------------------------------------------------------------------------
+
+
+@register
+class DonationSafetyCheck(Check):
+    name = "donation-safety"
+    description = (
+        "a variable passed at a donated position of a jax.jit(..., "
+        "donate_argnums=...) callable is read again afterwards in the same "
+        "function — donated buffers are dead the moment the call is issued"
+    )
+
+    def run(self, mod: ModuleSource, ctx: Context) -> Iterator[Finding]:
+        donations = {k: v for k, v in _jit_donations(mod).items() if v}
+        if not donations:
+            return
+        for fn in _functions(mod):
+            yield from self._check_function(mod, fn, donations)
+
+    def _check_function(
+        self, mod: ModuleSource, fn: ast.AST, donations: Dict[str, Tuple[int, ...]]
+    ) -> Iterator[Finding]:
+        # (donated var, line of donating call) pairs found in this function.
+        donated_at: List[Tuple[str, int, str]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = (
+                ast.unparse(node.func)
+                if isinstance(node.func, (ast.Name, ast.Attribute))
+                else ""
+            )
+            positions = donations.get(callee)
+            if not positions:
+                continue
+            for p in positions:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    donated_at.append((node.args[p].id, node.lineno, callee))
+        if not donated_at:
+            return
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name):
+                bucket = loads if isinstance(node.ctx, ast.Load) else stores
+                bucket.setdefault(node.id, []).append(node.lineno)
+        for var, call_line, callee in donated_at:
+            # `buf = step(buf)` rebinds the name to the fresh result — the
+            # canonical donation pattern, safe by construction.
+            if call_line in stores.get(var, []):
+                continue
+            rebinds = [ln for ln in stores.get(var, []) if ln > call_line]
+            horizon = min(rebinds) if rebinds else float("inf")
+            bad = [ln for ln in loads.get(var, []) if call_line < ln < horizon]
+            if bad:
+                yield Finding(
+                    check=self.name,
+                    path=mod.path,
+                    line=min(bad),
+                    col=0,
+                    message=(
+                        f"`{var}` was donated to {callee}() on line "
+                        f"{call_line} and read again here — the buffer may "
+                        "already be aliased by the callee's output"
+                    ),
+                    symbol=mod.symbol_at(min(bad)),
+                    text=mod.line_text(min(bad)),
+                )
+
+
+# ---------------------------------------------------------------------------
+# raw-rng (PR 7: the counter-based seeding contract)
+# ---------------------------------------------------------------------------
+
+
+@register
+class RawRngCheck(Check):
+    name = "raw-rng"
+    description = (
+        "jax.random.PRNGKey / global np.random state in env or rollout code "
+        "— keys must be *derived* (fold_in on episode/env counters, or a "
+        "seeded Generator handed in) so host and device replays stay "
+        "bit-identical"
+    )
+    scope = staticmethod(lambda path: _in(path, RNG_PATHS))
+
+    def run(self, mod: ModuleSource, ctx: Context) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = _call_name(mod, node)
+            if qual == "jax.random.PRNGKey":
+                yield self.finding(
+                    mod,
+                    node,
+                    "fresh PRNGKey in env/rollout code — derive keys from "
+                    "the carried key via fold_in (the seeding contract) "
+                    "instead of minting new roots",
+                )
+            elif qual == "numpy.random.default_rng":
+                if not node.args and not node.keywords:
+                    yield self.finding(
+                        mod,
+                        node,
+                        "unseeded np.random.default_rng() — host envs must "
+                        "derive their stream from the seed handed in",
+                    )
+            elif qual.startswith("numpy.random."):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"global-state {qual.replace('numpy', 'np')} — draw from "
+                    "a per-env seeded Generator instead",
+                )
+
+
+# ---------------------------------------------------------------------------
+# recompile-risk (PR 5/12: one-compile steady-state loops)
+# ---------------------------------------------------------------------------
+
+
+@register
+class RecompileRiskCheck(Check):
+    name = "recompile-risk"
+    description = (
+        "a python-varying scalar (loop index, len(), wall-clock) flows into "
+        "a jitted steady-state call — each distinct static value is a fresh "
+        "trace+compile (the engine asserts cache_size==1 for a reason)"
+    )
+
+    _VARYING_CALLS = {
+        "len",
+        "time.monotonic",
+        "time.time",
+        "time.perf_counter",
+        "time.perf_counter_ns",
+    }
+
+    def run(self, mod: ModuleSource, ctx: Context) -> Iterator[Finding]:
+        jitted = set(_jit_donations(mod))
+        for fn in _functions(mod):
+            # loop variables live for the span of their for statement
+            loop_spans: List[Tuple[str, int, int]] = []
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    end = getattr(node, "end_lineno", node.lineno) or node.lineno
+                    for t in ast.walk(node.target):
+                        if isinstance(t, ast.Name):
+                            loop_spans.append((t.id, node.lineno, end))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = (
+                    ast.unparse(node.func)
+                    if isinstance(node.func, (ast.Name, ast.Attribute))
+                    else ""
+                )
+                if callee not in jitted and not callee.endswith("_jit"):
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and any(
+                        name == arg.id and lo <= node.lineno <= hi
+                        for name, lo, hi in loop_spans
+                    ):
+                        yield self.finding(
+                            mod,
+                            node,
+                            f"loop variable `{arg.id}` flows into jitted "
+                            f"{callee}() — hash-static per value, so every "
+                            "iteration risks a retrace",
+                        )
+                    elif (
+                        isinstance(arg, ast.Call)
+                        and _call_name(mod, arg) in self._VARYING_CALLS
+                    ):
+                        yield self.finding(
+                            mod,
+                            node,
+                            f"{_call_name(mod, arg)}() result flows into "
+                            f"jitted {callee}() — a python-varying scalar "
+                            "is a retrace per distinct value",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# bare-timer (PR 1: every timing block must reach the exporters)
+# ---------------------------------------------------------------------------
+
+
+@register
+class BareTimerCheck(Check):
+    name = "bare-timer"
+    description = (
+        "hand-rolled time.perf_counter{,_ns} timing outside telemetry/ and "
+        "utils/profiling.py — invisible to every exporter; use "
+        "telemetry spans / Histogram.time() / StepTimer (the AST walk also "
+        "catches `from time import perf_counter as x` aliases the old shell "
+        "grep missed)"
+    )
+    scope = staticmethod(
+        lambda path: path.startswith("moolib_tpu/")
+        and not path.startswith("moolib_tpu/telemetry/")
+        and path != "moolib_tpu/utils/profiling.py"
+    )
+
+    def run(self, mod: ModuleSource, ctx: Context) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = _call_name(mod, node)
+            if qual in ("time.perf_counter", "time.perf_counter_ns"):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"bare {qual}() — time through telemetry spans / "
+                    "Histogram.time() / StepTimer so the block is visible "
+                    "to the exporters",
+                )
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (PR 8/9/10: the threaded RPC/group/serving planes)
+# ---------------------------------------------------------------------------
+
+_LOCKISH = re.compile(r"(^|[._])(lock|cond|mutex|mu)\b", re.IGNORECASE)
+
+
+@register
+class BlockingUnderLockCheck(Check):
+    name = "blocking-under-lock"
+    description = (
+        "an RPC send, future .result()/.wait(), sleep, or device sync while "
+        "holding a Lock/Condition — the handler or transport thread that "
+        "would unblock it may need the same lock (the ABBA half of what "
+        "testing.lockgraph catches at runtime)"
+    )
+    scope = staticmethod(lambda path: _in(path, LOCKED_PATHS))
+
+    _BLOCKING_FUNCS = {
+        "time.sleep": "time.sleep holds the lock for the whole nap",
+        "jax.device_get": "jax.device_get blocks on a D2H transfer",
+        "jax.block_until_ready": "jax.block_until_ready stalls on the device",
+    }
+    _BLOCKING_METHODS = {
+        "result": "Future.result() can wait a full timeout",
+        "wait": "waiting on a different primitive while holding this lock",
+        "wait_for": "waiting on a different primitive while holding this lock",
+        "call": "a synchronous RPC call round-trips the network",
+        "sync_call": "a synchronous RPC call round-trips the network",
+        "send_frame": "a transport send can block on a full socket",
+        "block_until_ready": "stalls on the device",
+    }
+
+    def run(self, mod: ModuleSource, ctx: Context) -> Iterator[Finding]:
+        yield from self._walk_stmts(mod, mod.tree.body, [])
+
+    def _walk_stmts(
+        self, mod: ModuleSource, stmts: Sequence[ast.stmt], held: List[str]
+    ) -> Iterator[Finding]:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def executes later, outside this lock scope
+                yield from self._walk_stmts(mod, st.body, [])
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired = [
+                    ast.unparse(item.context_expr)
+                    for item in st.items
+                    if _LOCKISH.search(ast.unparse(item.context_expr))
+                ]
+                if held:
+                    for item in st.items:
+                        yield from self._scan_expr(mod, item.context_expr, held)
+                yield from self._walk_stmts(mod, st.body, held + acquired)
+                continue
+            # any other statement: scan its own expressions (excluding
+            # nested statement bodies, which recurse below — each call is
+            # visited exactly once)
+            if held:
+                yield from self._scan_stmt(mod, st, held)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    yield from self._walk_stmts(mod, sub, held)
+            for handler in getattr(st, "handlers", ()):
+                yield from self._walk_stmts(mod, handler.body, held)
+
+    def _scan_stmt(
+        self, mod: ModuleSource, st: ast.stmt, held: List[str]
+    ) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(st):
+            if not isinstance(child, (ast.stmt, ast.excepthandler)):
+                yield from self._scan_expr(mod, child, held)
+
+    def _scan_expr(
+        self, mod: ModuleSource, top: ast.AST, held: List[str]
+    ) -> Iterator[Finding]:
+        stack: List[ast.AST] = [top]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.stmt, ast.Lambda)):
+                continue  # lambda bodies execute later; stmts recurse above
+            if isinstance(node, ast.Call):
+                f = self._classify(mod, node, held)
+                if f is not None:
+                    yield f
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _classify(
+        self, mod: ModuleSource, node: ast.Call, held: List[str]
+    ) -> Optional[Finding]:
+        qual = _call_name(mod, node)
+        lockset = ", ".join(held)
+        if qual in self._BLOCKING_FUNCS:
+            return self.finding(
+                mod,
+                node,
+                f"{self._BLOCKING_FUNCS[qual]} (holding {lockset})",
+            )
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        meth = node.func.attr
+        if meth not in self._BLOCKING_METHODS:
+            return None
+        recv = ast.unparse(node.func.value)
+        if meth in ("wait", "wait_for") and recv in held:
+            return None  # Condition.wait on the held condition RELEASES it
+        if meth == "call" and recv in ("super()",):
+            return None
+        if (
+            meth == "result"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == 0
+        ):
+            return None  # .result(0) cannot block: raises if not yet done
+        return self.finding(
+            mod,
+            node,
+            f".{meth}() — {self._BLOCKING_METHODS[meth]} (holding {lockset})",
+        )
+
+
+# ---------------------------------------------------------------------------
+# metric-docs (PR 1/11: docs/TELEMETRY.md is the metric contract)
+# ---------------------------------------------------------------------------
+
+
+@register
+class MetricDocsCheck(Check):
+    name = "metric-docs"
+    description = (
+        "every registry.counter/gauge/histogram name registered in code "
+        "must appear (backticked) in a docs/TELEMETRY.md table row — the "
+        "doc tables are the queryable metric contract"
+    )
+
+    def _doc_tables(self, ctx: Context) -> Optional[str]:
+        cached = getattr(ctx, "_metric_doc_tables", None)
+        if cached is not None:
+            return cached or None
+        path = os.path.join(ctx.root, "docs", "TELEMETRY.md")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            ctx._metric_doc_tables = ""  # absent docs: check is dormant
+            return None
+        tables = "\n".join(l for l in text.splitlines() if l.lstrip().startswith("|"))
+        ctx._metric_doc_tables = tables
+        return tables
+
+    def run(self, mod: ModuleSource, ctx: Context) -> Iterator[Finding]:
+        tables = self._doc_tables(ctx)
+        if tables is None:
+            return
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            if f"`{name}`" not in tables:
+                yield self.finding(
+                    mod,
+                    node,
+                    f"metric `{name}` ({node.func.attr}) is not documented "
+                    "in any docs/TELEMETRY.md table row",
+                )

@@ -290,10 +290,11 @@ class ContinuousBatchingEngine:
     def pending_decode_tokens(self) -> int:
         """Budgeted-but-unemitted tokens across active slots (the admission
         controller's per-token wait estimate numerator)."""
+        # mtlint: allow-host-sync(_remaining_host/_active_host are the host-side numpy mirrors, no device value involved)
         return int(self._remaining_host[self._active_host].sum())
 
     def active_count(self) -> int:
-        return int(self._active_host.sum())
+        return int(self._active_host.sum())  # mtlint: allow-host-sync(host-side numpy mirror)
 
     def submit(self, prompt, max_new: int) -> Tuple[Optional[int], List[int]]:
         """Prefill ``prompt`` (1-D int tokens) and join a decode slot.
@@ -304,6 +305,7 @@ class ContinuousBatchingEngine:
         :class:`NoFreeSlot` / :class:`PoolExhausted` when full (the caller
         keeps the request queued) and ``ValueError`` for oversized prompts.
         """
+        # mtlint: allow-host-sync(host token staging: the prompt arrives as a python/host sequence; the upload happens inside _join_jit)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         tp = prompt.shape[0]
         max_new = max(1, int(max_new))
@@ -354,7 +356,7 @@ class ContinuousBatchingEngine:
             self._tokens, self._remaining,
             np.int32(slot), row, np.int32(tp), np.int32(tok0),
             np.int32(max_new - 1),
-            ks, vs, np.asarray(block_ids[:nbw], np.int32),
+            ks, vs, np.asarray(block_ids[:nbw], np.int32),  # mtlint: allow-host-sync(block_ids is the pool's host-side free list)
         )
         self._slot_blocks[slot] = block_ids
         self._emitted[slot] = emitted
@@ -375,8 +377,9 @@ class ContinuousBatchingEngine:
             self._params_dec, self._cache, self._tables, self._lengths,
             self._active, self._tokens, self._remaining,
         )
+        # mtlint: allow-host-sync(the decode loop's one intentional D2H: emitted tokens/done flags must reach the host to answer requests)
         nxt = np.asarray(self._tokens)
-        done = np.asarray(done)
+        done = np.asarray(done)  # mtlint: allow-host-sync(same fetch: part of the decode loop's one D2H)
         emissions: Dict[int, int] = {}
         finished: List[int] = []
         for s in np.nonzero(self._active_host)[0]:
@@ -408,7 +411,7 @@ class ContinuousBatchingEngine:
         return toks
 
     def _update_gauges(self) -> None:
-        n = int(self._active_host.sum())
+        n = int(self._active_host.sum())  # mtlint: allow-host-sync(host-side numpy mirror)
         _M_SLOTS.set(n)
         _M_OCC.set(n / self.slots)
         _M_BLOCKS_FREE.set(self.pool.available())

@@ -161,7 +161,7 @@ class EngineService(ServeService):
 
     def _finish(self, req: _Request, emitted: List[int]) -> None:
         out = np.concatenate(
-            [req.prompt[0].astype(np.int32), np.asarray(emitted, np.int32)]
+            [req.prompt[0].astype(np.int32), np.asarray(emitted, np.int32)]  # mtlint: allow-host-sync(emitted is a host List[int])
         )
         self._respond(req, out if req.single else out[None], None)
 

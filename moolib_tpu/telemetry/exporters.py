@@ -245,6 +245,14 @@ def dump_diagnostics(
     # The flight recorder's recent-event tail: what the process believed
     # was happening right before the dump (watchdog expiry, crash, signal).
     parts.append(_flight_tail())
+    # Lock-order graph (MOOLIB_LOCKGRAPH=1): observed acquisition-order
+    # cycles with both offending stacks, plus long-hold outliers.
+    try:
+        from ..testing import lockgraph as _lockgraph
+
+        parts.append(_lockgraph.diagnostics_tail())
+    except Exception:  # noqa: BLE001 — diagnostics must never throw
+        pass
     parts.append("--- end telemetry dump ---\n")
     out.write("".join(parts))
     try:

@@ -38,17 +38,15 @@ step "telemetry guard (no bare perf_counter timing outside telemetry/profiling)"
 # New timing blocks belong in telemetry spans / Histogram.time() /
 # StepTimer (or utils.Timer for raw harnesses), not hand-rolled
 # time.perf_counter() pairs — those are invisible to every exporter.
-# docs/TELEMETRY.md documents the conventions.
-bare=$(grep -rn "time\.perf_counter" moolib_tpu \
-  --include='*.py' \
-  | grep -v "^moolib_tpu/telemetry/" \
-  | grep -v "^moolib_tpu/utils/profiling.py" || true)
-if [ -n "$bare" ]; then
-  echo "bare time.perf_counter() outside telemetry//utils/profiling.py:"
-  echo "$bare"
-  echo "use telemetry.span / Histogram.time() / StepTimer instead"
-  fail=1
-fi
+# AST-based (docs/ANALYSIS.md): catches aliased imports the old shell grep
+# never saw; intentional sites carry inline pragmas or baseline entries.
+python -m moolib_tpu.analysis --check bare-timer || fail=1
+
+step "contract lint (mtlint: host-sync, donation-safety, raw-rng, recompile-risk, blocking-under-lock, metric-docs)"
+# Zero NEW findings over the committed baseline (docs/ANALYSIS.md).  The
+# baseline for rollout.py, engine/, serving.py and group.py is empty by
+# construction — hot-path regressions in those modules fail outright.
+python -m moolib_tpu.analysis || fail=1
 
 step "telemetry tests"
 python -m pytest tests/test_telemetry.py tests/test_profiling.py -q || fail=1
@@ -109,7 +107,11 @@ step "chaos soak (seeded, ~80 s smoke: worker/peer kills + respawn SLO, RPC fram
 # budget).  The shared compile cache below is what keeps the respawn's
 # first_compile phase inside the bound — the soak exercises the same
 # mechanism production restarts rely on.
+# MOOLIB_LOCKGRAPH=1: every threading.Lock/RLock in every soak process is
+# instrumented; an observed ABBA acquisition-order cycle fails the run at
+# teardown with both stacks (moolib_tpu/testing/lockgraph.py).
 MOOLIB_COMPILE_CACHE="${TMPDIR:-/tmp}/moolib_ci_jax_cache" \
+  MOOLIB_LOCKGRAPH=1 \
   python scripts/chaos_soak.py --smoke --recovery_bound_s 60 || fail=1
 
 step "autoscaler tests (policy decisions, graceful leave, vbatch stability across resize)"
@@ -131,7 +133,8 @@ step "serving soak (seeded, ~40 s smoke: replica SIGKILL mid-stream + live hot-s
 # resolved), a hot swap that failed to land / record serve_swap_seconds,
 # or any admission reject attributable to the swap
 # (docs/RESILIENCE.md "Serving soak").
-python scripts/serve_soak.py --smoke || fail=1
+# Thread-heaviest path in the tree — runs under the lock-order detector.
+MOOLIB_LOCKGRAPH=1 python scripts/serve_soak.py --smoke || fail=1
 
 step "paged-attention / engine tests (paged==dense bit-exact MHA+GQA, pool invariants, one-compile decode)"
 python -m pytest tests/test_paged_attention.py -q || fail=1
@@ -140,7 +143,7 @@ step "engine serving soak (same SIGKILL + hot-swap gates through the continuous-
 # The engine replica must satisfy the identical resilience contract as the
 # batch-synchronous arm: zero lost requests across the kill, swap lands
 # between iterations, no swap-attributable rejects (DESIGN.md §6c).
-python scripts/serve_soak.py --smoke --engine || fail=1
+MOOLIB_LOCKGRAPH=1 python scripts/serve_soak.py --smoke --engine || fail=1
 
 step "elasticity swing soak (calm -> 5x surge -> quiet through real engine replicas + autoscaler)"
 # Gates: fleet grows on sustained serve_queue_wait_s during the surge,
@@ -148,7 +151,7 @@ step "elasticity swing soak (calm -> 5x surge -> quiet through real engine repli
 # and zero requests are lost across the scale events (DESIGN.md §6c;
 # --service_delay_ms pins per-iteration cost so saturation is
 # deterministic on any host).
-python scripts/serve_soak.py --smoke --swing || fail=1
+MOOLIB_LOCKGRAPH=1 python scripts/serve_soak.py --smoke --swing || fail=1
 
 step "engine A/B smoke (continuous batching vs batch-sync under mixed budgets; folds serve rows into BENCH_LOCAL.json)"
 # Same broker, same admission contract, same paced open-loop load — only

@@ -1,0 +1,285 @@
+"""mtlint: every check fires on a minimal fixture, pragmas and the
+baseline behave as documented, and a clean tree exits 0.
+
+The fixtures go through :func:`moolib_tpu.analysis.lint_source`, which
+lints a source string *as if* it lived at the given repo-relative path —
+that's how scoped checks (host-sync only in hot-path modules, raw-rng only
+in env/rollout code, ...) are pointed at their territory without building a
+tree on disk.  CLI-level behavior (baseline gating, exit codes) uses a real
+tmpdir tree via ``--root``.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from moolib_tpu.analysis import all_checks, lint_source
+from moolib_tpu.analysis.cli import main as mtlint_main
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+HOT = "moolib_tpu/engine/hot.py"
+LOCKED = "moolib_tpu/group.py"
+RNG = "moolib_tpu/envs/fixture_env.py"
+
+
+def findings(src, path, check=None):
+    active, _suppressed = lint_source(src, path=path)
+    if check:
+        active = [f for f in active if f.check == check]
+    return active
+
+
+# --------------------------------------------------------------------------
+# each check fires on a minimal fixture (and not on the clean variant)
+# --------------------------------------------------------------------------
+
+def test_host_sync_device_get():
+    src = "import jax\ndef f(x):\n    return jax.device_get(x)\n"
+    (f,) = findings(src, HOT, "host-sync")
+    assert f.line == 3
+    # out of scope: same code elsewhere is silent
+    assert not findings(src, "moolib_tpu/broker.py", "host-sync")
+
+
+def test_host_sync_aliased_numpy():
+    src = "import numpy as banana\ndef f(x):\n    return banana.asarray(x)\n"
+    assert len(findings(src, HOT, "host-sync")) == 1
+
+
+def test_host_sync_scalar_coercion():
+    src = "def f(x):\n    return float(x.mean())\n"
+    assert len(findings(src, HOT, "host-sync")) == 1
+    # host scalar math is not a sync
+    clean = "def f(a, b):\n    return int(min(a, b))\n"
+    assert not findings(clean, HOT, "host-sync")
+
+
+def test_donation_safety():
+    src = (
+        "import jax\n"
+        "step = jax.jit(lambda s: s, donate_argnums=(0,))\n"
+        "def f(state):\n"
+        "    out = step(state)\n"
+        "    return state.mean(), out\n"
+    )
+    (f,) = findings(src, HOT, "donation-safety")
+    assert f.line == 5
+    # the rebind idiom is the contract, not a violation
+    clean = src.replace("out = step(state)", "state = step(state)").replace(
+        "return state.mean(), out", "return state.mean()"
+    )
+    assert not findings(clean, HOT, "donation-safety")
+
+
+def test_raw_rng():
+    src = "import jax\ndef reset():\n    return jax.random.PRNGKey(0)\n"
+    assert len(findings(src, RNG, "raw-rng")) == 1
+    src2 = "import numpy as np\ndef reset():\n    return np.random.rand(3)\n"
+    assert len(findings(src2, RNG, "raw-rng")) == 1
+    # the seeding contract (fold_in on a handed-down key) is fine
+    clean = "import jax\ndef reset(key, i):\n    return jax.random.fold_in(key, i)\n"
+    assert not findings(clean, RNG, "raw-rng")
+
+
+def test_recompile_risk():
+    src = (
+        "import jax\n"
+        "f_jit = jax.jit(lambda x: x)\n"
+        "def run(items):\n"
+        "    for i in range(3):\n"
+        "        f_jit(i)\n"
+    )
+    assert len(findings(src, HOT, "recompile-risk")) == 1
+
+
+def test_bare_timer_aliased():
+    src = "from time import perf_counter as pc\ndef f():\n    return pc()\n"
+    assert len(findings(src, "moolib_tpu/group.py", "bare-timer")) == 1
+    # the telemetry plane itself is allowed to own the timers
+    assert not findings(src, "moolib_tpu/telemetry/metrics.py", "bare-timer")
+    assert not findings(src, "moolib_tpu/utils/profiling.py", "bare-timer")
+
+
+def test_blocking_under_lock():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self, fut):\n"
+        "        with self._lock:\n"
+        "            return fut.result()\n"
+    )
+    (f,) = findings(src, LOCKED, "blocking-under-lock")
+    assert f.line == 7
+    # .result(0) cannot block; outside the with it is fine anyway
+    clean = src.replace("fut.result()", "fut.result(0)")
+    assert not findings(clean, LOCKED, "blocking-under-lock")
+
+
+def test_blocking_under_lock_condition_wait_exempt():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "    def f(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait()\n"
+    )
+    # waiting on the lock you hold releases it — not a blocking hold
+    assert not findings(src, LOCKED, "blocking-under-lock")
+
+
+def test_metric_docs_needs_docs_tree(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "TELEMETRY.md").write_text(
+        "| Metric | Type |\n|---|---|\n| `documented_total` | counter |\n"
+    )
+    src = (
+        "def f(reg):\n"
+        "    reg.counter('documented_total', 'ok')\n"
+        "    reg.counter('mystery_total', 'undocumented')\n"
+    )
+    pkg = tmp_path / "moolib_tpu"
+    pkg.mkdir()
+    mod = pkg / "thing.py"
+    mod.write_text(src)
+    rc = mtlint_main(
+        [str(pkg), "--root", str(tmp_path), "--no-baseline", "--check", "metric-docs"]
+    )
+    assert rc == 1
+
+
+# --------------------------------------------------------------------------
+# pragmas
+# --------------------------------------------------------------------------
+
+def test_pragma_suppresses_same_line():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.device_get(x)  # mtlint: allow-host-sync(the one D2H)\n"
+    )
+    active, suppressed = lint_source(src, path=HOT)
+    assert not [f for f in active if f.check == "host-sync"]
+    assert len(suppressed) == 1
+
+
+def test_pragma_standalone_covers_next_line():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    # mtlint: allow-host-sync(documented)\n"
+        "    return jax.device_get(x)\n"
+    )
+    active, suppressed = lint_source(src, path=HOT)
+    assert not [f for f in active if f.check == "host-sync"]
+    assert len(suppressed) == 1
+
+
+def test_pragma_requires_reason():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.device_get(x)  # mtlint: allow-host-sync()\n"
+    )
+    active, _ = lint_source(src, path=HOT)
+    assert [f for f in active if f.check == "pragma"]
+
+
+def test_pragma_wrong_check_does_not_suppress():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.device_get(x)  # mtlint: allow-bare-timer(nope)\n"
+    )
+    active, _ = lint_source(src, path=HOT)
+    assert [f for f in active if f.check == "host-sync"]
+
+
+# --------------------------------------------------------------------------
+# baseline + CLI exit codes
+# --------------------------------------------------------------------------
+
+def _tree(tmp_path, body):
+    pkg = tmp_path / "moolib_tpu" / "engine"
+    pkg.mkdir(parents=True)
+    (pkg / "hot.py").write_text(body)
+    return tmp_path
+
+
+DIRTY = "import jax\ndef f(x):\n    return jax.device_get(x)\n"
+
+
+def test_cli_clean_tree_exits_zero(tmp_path):
+    root = _tree(tmp_path, "def f(x):\n    return x\n")
+    assert mtlint_main([str(root / "moolib_tpu"), "--root", str(root), "--no-baseline"]) == 0
+
+
+def test_cli_violation_exits_one(tmp_path):
+    root = _tree(tmp_path, DIRTY)
+    assert mtlint_main([str(root / "moolib_tpu"), "--root", str(root), "--no-baseline"]) == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    root = _tree(tmp_path, DIRTY)
+    bl = root / "baseline.json"
+    args = [str(root / "moolib_tpu"), "--root", str(root), "--baseline", str(bl)]
+    assert mtlint_main(args + ["--write-baseline"]) == 0
+    data = json.loads(bl.read_text())
+    assert data["entries"] and data["entries"][0]["check"] == "host-sync"
+    # baselined finding no longer fails the gate
+    assert mtlint_main(args) == 0
+    # ...but a NEW violation still does (count-aware: 2 found vs 1 baselined)
+    (root / "moolib_tpu" / "engine" / "hot.py").write_text(
+        DIRTY + "def g(y):\n    return jax.device_get(y)\n"
+    )
+    assert mtlint_main(args) == 1
+
+
+def test_baseline_stale_detection(tmp_path):
+    root = _tree(tmp_path, DIRTY)
+    bl = root / "baseline.json"
+    args = [str(root / "moolib_tpu"), "--root", str(root), "--baseline", str(bl)]
+    assert mtlint_main(args + ["--write-baseline"]) == 0
+    # fix the violation: --prune-baseline reports the now-stale entry...
+    (root / "moolib_tpu" / "engine" / "hot.py").write_text("def f(x):\n    return x\n")
+    assert mtlint_main(args + ["--prune-baseline"]) == 1
+    # ...and re-writing shrinks the baseline to empty
+    assert mtlint_main(args + ["--write-baseline"]) == 0
+    assert json.loads(bl.read_text())["entries"] == []
+
+
+# --------------------------------------------------------------------------
+# the real tree
+# --------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """The gate ci.sh enforces: the committed tree + committed baseline has
+    zero new findings.  Run in-process — the checks are stdlib-only."""
+    assert mtlint_main([]) == 0
+
+
+def test_all_checks_registered():
+    names = set(all_checks())
+    assert {
+        "host-sync",
+        "donation-safety",
+        "raw-rng",
+        "recompile-risk",
+        "bare-timer",
+        "blocking-under-lock",
+        "metric-docs",
+    } <= names
+
+
+def test_cli_module_entrypoint():
+    out = subprocess.run(
+        [sys.executable, "-m", "moolib_tpu.analysis", "--list"],
+        capture_output=True, text=True, check=True,
+    )
+    assert "host-sync" in out.stdout

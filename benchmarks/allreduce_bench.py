@@ -37,6 +37,19 @@ Prints one line per size: elements, MB, milliseconds, MB/s (bytes, not the
 reference's ambiguous "M/s" element count).  max_peer_tx counts LOGICAL
 per-peer payload bytes (a memfd-multicast share writes those bytes once but
 accounts them on every receiver's connection).
+
+``--sharded`` A/Bs the sharded hierarchical gradient plane (docs/DESIGN.md
+§6d: reduce-scatter between hosts + owner redistribution) against the
+legacy full-tree plane over a REAL Accumulator cohort — the sharded plane
+is Accumulator protocol, not a raw ``Group.all_reduce`` option, so the arm
+drives the trained gradient path end to end.  Each row adds the per-host
+DCN gradient bytes per round (``accum_interhost_bytes_total{kind="grad"}``):
+the sharded claim is that column, (N-1)/N of the payload per host vs the
+full payload on the legacy plane.  ``--sharded --smoke`` is the CI gate:
+bit-exactness vs the legacy plane AND a numpy reference, plus the byte
+ratio bound — single process by default, or one rank per process via
+WORLD_SIZE/RANK/BROKER_ADDR (scripts/ci.sh runs the 2-process form so the
+inter-host byte drop is measured across real process boundaries).
 """
 
 from __future__ import annotations
@@ -345,6 +358,226 @@ def bench_smoke(args):
     print("smoke: bucketed/owned/legacy/ring/q8 allreduce results verified")
 
 
+def _int_grad_trees(world_size, size):
+    """Deterministic integer-valued f32 gradient trees (exact under any
+    summation order): every rank rebuilds every peer's contribution and the
+    numpy reference without communicating."""
+    return [
+        {"g": np.random.default_rng(1000 + r).integers(-32, 33, size).astype(np.float32)}
+        for r in range(world_size)
+    ]
+
+
+def _accum_grad_bytes(kind="grad"):
+    """Process-local ``accum_interhost_bytes_total`` for one kind label."""
+    from moolib_tpu import telemetry
+
+    for m in telemetry.get_registry().collect():
+        if m.name == "accum_interhost_bytes_total":
+            return sum(v for labels, v in m.samples() if labels.get("kind") == kind)
+    return 0.0
+
+
+class _AccumCohort:
+    """N Accumulator peers + broker on loopback (or one rank per process,
+    same WORLD_SIZE/RANK/BROKER_ADDR contract as :class:`_Cohort`).  Rounds
+    are lockstep by construction — a peer's ``has_gradients()`` only rises
+    once the cohort round completes — so toggling the plane between rounds
+    stays wire-consistent on every rank."""
+
+    def __init__(self, args, params):
+        from moolib_tpu import Accumulator, Broker
+
+        world_size = int(os.environ.get("WORLD_SIZE", args.world_size))
+        rank = os.environ.get("RANK")
+        broker_addr = os.environ.get("BROKER_ADDR", args.broker_addr)
+        self.world_size = world_size
+        self.local_ranks = list(range(world_size)) if rank is None else [int(rank)]
+        self.broker = None
+        if rank is None or int(rank) == 0:
+            self.broker = Broker()
+            self.broker.set_name("broker")
+            if rank is None:
+                self.broker.listen(broker_addr)
+            else:
+                host, _, port = broker_addr.rpartition(":")
+                self.broker.listen(
+                    f":{port}" if host in ("", "127.0.0.1", "0.0.0.0") else broker_addr
+                )
+        self.accs = []
+        for i in self.local_ranks:
+            acc = Accumulator("bench", {k: np.copy(v) for k, v in params.items()})
+            acc.set_name(f"rank{i}")
+            acc._rpc.set_timeout(60)
+            acc.listen(":0")
+            acc.connect(broker_addr)
+            self.accs.append(acc)
+
+    def pump(self):
+        if self.broker is not None:
+            self.broker.update()
+        for a in self.accs:
+            a.update()
+            if a.wants_state():
+                a.set_state({"step": 0})
+
+    def converge(self):
+        deadline = time.time() + 120
+        ok = lambda: all(  # noqa: E731
+            a.connected() and len(a._group.members()) == self.world_size
+            for a in self.accs
+        )
+        while not ok() and time.time() < deadline:
+            self.pump()
+            time.sleep(0.005)
+        assert ok(), "accumulator cohort never converged"
+
+    def set_sharded(self, enabled):
+        for a in self.accs:
+            a.set_sharded_allreduce(enabled)
+
+    def round(self, trees):
+        """One gradient round: every local peer contributes its tree, wait
+        for the cohort result, hand it back, re-arm for the next round."""
+        for a, t in zip(self.accs, trees):
+            a.reduce_gradients(1, t)
+        deadline = time.time() + 120
+        while not all(a.has_gradients() for a in self.accs):
+            assert time.time() < deadline, "gradient round wedged"
+            self.pump()
+            time.sleep(0.001)
+        outs = [
+            {k: np.asarray(v) for k, v in a.gradients().items()} for a in self.accs
+        ]
+        for a in self.accs:
+            a.zero_gradients()
+        return outs
+
+    def close(self):
+        for a in self.accs:
+            a.close()
+        if self.broker is not None:
+            self.broker.close()
+
+
+def bench_sharded(args):
+    """A/B rows: legacy full-tree vs sharded hierarchical gradient rounds
+    over a real Accumulator cohort, plus a ratio section pinning the
+    per-host byte claim as data rows (banner-keyed so fold_capture merges
+    fresh captures over stale ones instead of accumulating duplicates)."""
+    import moolib_tpu.buckets as buckets
+
+    if args.bucket_bytes:
+        buckets.set_bucket_bytes(args.bucket_bytes)
+    cohort = _AccumCohort(args, {"g": np.zeros(8, np.float32)})
+    cohort.converge()
+    n = cohort.world_size
+    local_n = len(cohort.accs)
+
+    def run_rows(sharded):
+        cohort.set_sharded(sharded)
+        plane = "sharded-hier" if sharded else "legacy full-tree"
+        print(
+            f"# accum grad rounds ({plane}), {n} hosts, loopback "
+            f"(grad_MB_host = per-host DCN gradient bytes per round, "
+            f"accum_interhost_bytes_total{{kind=grad}})"
+        )
+        print(f"{'elems':>10} {'MB':>8} {'ms':>9} {'MB/s':>10} {'grad_MB_host':>13}")
+        per_host = {}
+        for size in args.sizes:
+            trees = _int_grad_trees(n, size)
+            local = [trees[i] for i in cohort.local_ranks]
+            cohort.round(local)  # warmup: layouts, codecs, transport upgrades
+            b0 = _accum_grad_bytes()
+            times = []
+            for _ in range(args.iters):
+                t0 = time.perf_counter()
+                cohort.round(local)
+                times.append(time.perf_counter() - t0)
+            dt = statistics.median(times)
+            gb = (_accum_grad_bytes() - b0) / args.iters / local_n / 1e6
+            mb = size * 4 / 1e6
+            print(f"{size:>10} {mb:>8.2f} {dt*1e3:>9.2f} {mb/dt:>10.1f} {gb:>13.3f}")
+            per_host[size] = gb
+        return per_host
+
+    legacy = run_rows(False)
+    shard = run_rows(True)
+    print(
+        f"# sharded/legacy per-host grad bytes per round "
+        f"(ideal (N-1)/N = {(n - 1) / n:.3f} for {n} hosts)"
+    )
+    print(f"{'elems':>10} {'ratio':>8}")
+    for size in args.sizes:
+        if legacy[size] > 0:
+            print(f"{size:>10} {shard[size] / legacy[size]:>8.3f}")
+    cohort.close()
+
+
+def bench_sharded_smoke(args):
+    """CI gate for the sharded plane: one legacy and one sharded round over
+    the SAME contributions must be bit-identical to each other and to the
+    numpy reference, and the sharded per-host grad bytes must come in under
+    (N-1)/N + 0.05 of legacy (0.55x for 2 hosts — the ISSUE acceptance
+    bound).  In multi-process mode every rank gates on its OWN counters, so
+    a 2-process run proves the drop across real process boundaries."""
+    cohort = _AccumCohort(args, {"g": np.zeros(8, np.float32)})
+    cohort.converge()
+    n = cohort.world_size
+    size = 200_000
+    trees = _int_grad_trees(n, size)
+    local = [trees[i] for i in cohort.local_ranks]
+    # Mirror the accumulator's averaging expression (f32 sum / python int)
+    # so the reference check is bit-exact, not approximate.
+    total = np.sum(
+        np.stack([t["g"] for t in trees]), axis=0, dtype=np.float64
+    ).astype(np.float32)
+    ref = total / n
+    fails = []
+
+    def run_plane(sharded):
+        cohort.set_sharded(sharded)
+        cohort.round(local)  # warmup (layouts, transport upgrades)
+        b0 = _accum_grad_bytes()
+        outs = cohort.round(local)
+        return outs, (_accum_grad_bytes() - b0) / len(cohort.accs)
+
+    legacy_outs, legacy_b = run_plane(False)
+    shard_outs, shard_b = run_plane(True)
+    for tag, outs in (("legacy", legacy_outs), ("sharded", shard_outs)):
+        for o in outs:
+            if o["g"].tobytes() != ref.tobytes():
+                fails.append(f"{tag}: not bit-exact vs numpy reference")
+                break
+    for lo, so in zip(legacy_outs, shard_outs):
+        if lo["g"].tobytes() != so["g"].tobytes():
+            fails.append("sharded differs bit-wise from legacy")
+            break
+    bound = (n - 1) / n + 0.05
+    if legacy_b <= 0 or shard_b <= 0:
+        fails.append(
+            f"byte counters did not move (legacy={legacy_b}, sharded={shard_b})"
+        )
+    elif shard_b > bound * legacy_b:
+        fails.append(
+            f"per-host grad bytes ratio {shard_b / legacy_b:.3f} > bound {bound:.3f}"
+        )
+    cohort.close()
+    if fails:
+        for f in fails:
+            print("SMOKE FAIL:", f)
+        raise SystemExit(1)
+    print(
+        f"smoke: sharded allreduce bit-exact vs legacy and numpy reference "
+        f"({n} hosts)"
+    )
+    print(
+        f"smoke: per-host grad bytes/round sharded {shard_b / 1e6:.2f} MB vs "
+        f"legacy {legacy_b / 1e6:.2f} MB "
+        f"(ratio {shard_b / legacy_b:.3f} <= {bound:.3f})"
+    )
+
+
 def bench_ici(args):
     import jax
     import jax.numpy as jnp
@@ -428,6 +661,12 @@ def main(argv=None):
     p.add_argument("--smoke", action="store_true",
                    help="fast correctness pass (CI): bucketed vs legacy vs "
                    "numpy reference, then one bandwidth line")
+    p.add_argument("--sharded", action="store_true",
+                   help="A/B the sharded hierarchical gradient plane "
+                   "(DESIGN.md §6d) against the legacy full-tree plane over "
+                   "a real Accumulator cohort; with --smoke, gate "
+                   "bit-exactness vs numpy and the per-host byte ratio "
+                   "instead of printing sweep rows")
     p.add_argument(
         "--sizes",
         type=int,
@@ -435,7 +674,11 @@ def main(argv=None):
         default=[400, 10_000, 100_000, 1_000_000, 2_621_440],
     )
     args = p.parse_args(argv)
-    if args.smoke:
+    if args.sharded and args.smoke:
+        bench_sharded_smoke(args)
+    elif args.sharded:
+        bench_sharded(args)
+    elif args.smoke:
         bench_smoke(args)
     elif args.mode == "rpc":
         bench_rpc(args)

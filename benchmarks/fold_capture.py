@@ -247,7 +247,54 @@ def parse_allreduce(path):
         return None
     keep = re.compile(r"^(#|smoke:|\s*elems\s|\s*\d+\s)")
     lines = [l for l in txt.splitlines() if l.strip() and keep.match(l)]
-    return lines if any(re.match(r"\s*\d+\s", l) for l in lines) else None
+    # Data rows OR smoke verdict lines qualify: the --sharded --smoke gate
+    # prints only ``smoke:`` lines, and its byte-ratio verdict is a capture
+    # worth folding (it merges as the banner-keyed ``smoke`` section).
+    has_rows = any(re.match(r"\s*\d+\s", l) for l in lines)
+    return lines if has_rows or any(l.startswith("smoke:") for l in lines) else None
+
+
+def _split_allreduce_sections(lines):
+    """Group allreduce stdout into banner-keyed sections: a section is a
+    ``#`` banner line plus the header/data rows that follow it; lines
+    before any banner (the ``--smoke`` modes print no banner) form a
+    leading ``smoke`` section."""
+    secs = []
+    for l in lines or []:
+        if l.startswith("#"):
+            secs.append((l.strip(), [l]))
+        elif l.startswith("smoke:"):
+            # Consecutive smoke verdict lines are ONE section regardless of
+            # what banner precedes them — a fresh smoke capture must replace
+            # the stored verdict, not duplicate it inside a banner section.
+            if secs and secs[-1][0] == "smoke":
+                secs[-1][1].append(l)
+            else:
+                secs.append(("smoke", [l]))
+        elif not secs:
+            secs.append(("smoke", [l]))
+        else:
+            secs[-1][1].append(l)
+    return secs
+
+
+def merge_allreduce_sections(old_lines, new_lines):
+    """allreduce sections MERGE banner-keyed instead of clobbering: a
+    ``--sharded`` A/B capture must not erase the committed tree/ring sweep
+    rows, and a fresh sweep must not erase the sharded A/B record (the
+    sharded arm keys its ratio claim as data rows under a stable banner
+    for exactly this reason).  A fresh section replaces the stored section
+    with the same banner; every other stored section is kept in its
+    original order, fresh sections appended after."""
+    new = _split_allreduce_sections(new_lines)
+    fresh = {k for k, _ in new}
+    out = []
+    for key, ls in _split_allreduce_sections(old_lines):
+        if key not in fresh:
+            out.extend(ls)
+    for _, ls in new:
+        out.extend(ls)
+    return out
 
 
 def parse_agent_lines(path):
@@ -333,8 +380,10 @@ def fold_local(log_path, json_path):
     the log belongs to — ``allreduce_rpc`` for an allreduce_bench capture,
     ``agent_small`` for an agent_bench one, ``serve_qps`` for a
     ``serve_bench --qps`` one (detected by content) — has its stdout
-    replaced; every other section (rpc, envpool, ...) is preserved
-    verbatim — same row-preservation policy as the BENCH_TPU merges above."""
+    updated; every other section (rpc, envpool, ...) is preserved verbatim.
+    The allreduce_rpc and serve_qps sections merge rows (banner-keyed /
+    row-keyed) instead of clobbering — same row-preservation policy as the
+    BENCH_TPU merges above."""
     if os.path.exists(json_path):
         # A corrupt record must ABORT, not be clobbered (curated history).
         with open(json_path) as f:
@@ -374,6 +423,8 @@ def fold_local(log_path, json_path):
     sec["rc"] = 0
     if section == "serve_qps":
         lines = merge_qps_rows(sec.get("stdout"), lines)
+    elif section == "allreduce_rpc":
+        lines = merge_allreduce_sections(sec.get("stdout"), lines)
     sec["stdout"] = lines
     sec["stderr"] = []
     try:

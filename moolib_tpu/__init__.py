@@ -38,6 +38,7 @@ __all__ = [
     "EnvStepper",
     "EnvStepperFuture",
     "Future",
+    "GradientShardingError",
     "Group",
     "Queue",
     "RestartPolicy",
@@ -65,6 +66,7 @@ _LAZY = {
     "Group": "group",
     "AllReduce": "group",
     "Accumulator": "accumulator",
+    "GradientShardingError": "accumulator",
     "Batcher": "batcher",
     "EnvPool": "envpool",
     "EnvRunner": "envpool",

@@ -135,6 +135,30 @@ _M_D2H_OVERLAP = _REG.histogram(
     "device-to-host transfer time overlapped with bucket staging (async "
     "copy_to_host issued for every leaf before the first bucket fills)",
 )
+# Sharded hierarchical reduce (docs/DESIGN.md §6d): per-kind inter-host
+# bytes (the reduce-scatter contribution vs the owned-shard redistribution),
+# the fraction of the payload this host owns, and the wall time of the
+# in-mesh share-down/redistribution (observed by parallel.redistribute).
+_M_INTERHOST = _REG.counter(
+    "accum_interhost_bytes_total",
+    "bytes shipped on the inter-host (RPC/DCN) plane for gradient rounds: "
+    "kind='grad' is the reduce contribution at send time (post-compression; "
+    "sharded rounds ship (N-1)/N of the flat payload vs the full tree's "
+    "1/1), kind='gather' is the owned-shard result redistribution "
+    "(all-gather; fans out locally via the multicast share-down)",
+    ("kind",),
+)
+_M_SHARD_FRACTION = _REG.gauge(
+    "accum_shard_fraction",
+    "fraction of the flat gradient payload this host owns (reduces locally) "
+    "in sharded rounds — ~1/N of the cohort",
+    ("accumulator", "peer"),
+)
+_M_PSUM = _REG.histogram(
+    "accum_psum_seconds",
+    "host wall time in the in-mesh share-down / resharding of reduced "
+    "tensors (parallel.redistribute: device placement + collective dispatch)",
+)
 
 _MODEL_PUSH_INTERVAL = 600.0  # reference: regular model broadcast every 600 s
 _BUFFERS_PUSH_INTERVAL = 12.0  # reference: buffers broadcast every 12 s
@@ -150,6 +174,51 @@ def _tree_add(a, b):
 
 def _tree_zeros_like(t):
     return jax.tree_util.tree_map(lambda x: np.zeros_like(np.asarray(x)), t)
+
+
+class GradientShardingError(RuntimeError):
+    """The gradient tree's device sharding changed between
+    ``reduce_gradients`` calls while the sharded reduce plane was active.
+
+    The sharded layout (bucket cuts, per-host ranges) is cohort wire
+    protocol, keyed on the sharding signature at first staging — a silent
+    re-layout (or a silent fall-back to full-tree payloads) would desync the
+    op shapes across hosts mid-epoch.  Fix the step to produce a stable
+    sharding, or consume pending results and restart the plane."""
+
+
+class _ShardedRound:
+    """Book-keeping for one sharded hierarchical round (docs/DESIGN.md §6d):
+    a scatter phase (one bucketed sub-op per owned range; the owner
+    contributes None and folds its local slice into the wire partial) and a
+    gather phase (the owner redistributes its true sum; everyone else
+    contributes None).  Completion is counted on the gather ops — gather g
+    can only resolve after scatter g did (the owner's contribution depends
+    on it), so all scatter work is transitively covered."""
+
+    __slots__ = (
+        "rank", "ranges", "layout", "treedef", "flat", "stats", "meta_group",
+        "wire", "item", "round", "gather", "results", "meta", "err",
+        "remaining",
+    )
+
+    def __init__(self, rank, ranges, layout, treedef, flat, stats,
+                 meta_group, wire, item, remaining):
+        self.rank = rank
+        self.ranges = ranges
+        self.layout = layout
+        self.treedef = treedef
+        self.flat = flat
+        self.stats = stats
+        self.meta_group = meta_group
+        self.wire = wire
+        self.item = item
+        self.round = None
+        self.gather = {}
+        self.results = {}
+        self.meta = None
+        self.err = None
+        self.remaining = remaining
 
 
 class _Round:
@@ -356,6 +425,13 @@ class Accumulator:
         # refcount-guarded buffer pool in moolib_tpu.buckets.
         self._flat_layouts: Dict = {}
         self._bucketed = True  # False = legacy per-leaf dict payloads
+        # Sharded hierarchical reduce (docs/DESIGN.md §6d): each host owns a
+        # disjoint ~1/N range of the flat payload (reduce-scatter between
+        # hosts + all-gather of owned true sums).  Layouts are keyed on the
+        # gradient tree's sharding signature — a mid-run signature change is
+        # a GradientShardingError, never a silent re-layout (wire protocol).
+        self._sharded = False
+        self._sharded_layouts: Dict = {}
         # Debug checksums (reference src/accumulator.cc:324-370): verify the
         # applied gradient result is bit-identical cohort-wide per round.
         self._debug_checksums = False
@@ -691,6 +767,24 @@ class Accumulator:
         ``moolib_tpu.buckets.set_bucket_bytes`` / ``MOOLIB_BUCKET_BYTES``."""
         self._bucketed = bool(enabled)
 
+    def set_sharded_allreduce(self, enabled: bool = True) -> None:
+        """Shard the RPC-plane gradient reduce across the cohort
+        (docs/DESIGN.md §6d): each of the N hosts owns a disjoint ~1/N range
+        of the flat payload.  A round is a reduce-scatter — every host ships
+        only the N-1 ranges it does NOT own, the owner contributes nothing
+        and folds its local slice into the wire partial — followed by an
+        all-gather of the owned true sums (each range fans out locally via
+        the multicast share-down).  Contributed gradient bytes per host drop
+        from 1x to (N-1)/N x the flat payload;
+        ``accum_interhost_bytes_total{kind}`` is the measured artifact.
+
+        Must be set identically on every peer (op names and range boundaries
+        are wire protocol).  Composes with wire compression and virtual
+        batching; the ICI plane supersedes it when eligible; the chunked-ring
+        setting is ignored (the scatter already is the ring's reduce-scatter
+        half, minus the hop latency).  Requires the bucketed data plane."""
+        self._sharded = bool(enabled)
+
     @staticmethod
     def _leaf_spec(leaf):
         """(shape, dtype) of a gradient leaf WITHOUT forcing a device
@@ -708,6 +802,38 @@ class Accumulator:
         if layout is None:
             layout = buckets.BucketLayout(shapes, dtype)
             self._flat_layouts[key] = layout
+        return layout
+
+    def _sharded_flat_layout(self, treedef, shapes, dtype, leaves):
+        """Shard-pinned layout for the sharded reduce plane, cached per
+        (treedef, shapes, dtype, bucket size) and GUARDED by the gradient
+        tree's sharding signature: a later call whose leaves carry a
+        different device sharding raises :class:`GradientShardingError` —
+        the layout is cohort wire protocol, so a silent re-layout (or a
+        silent fall-back to full-tree payloads) would desync op shapes
+        across hosts mid-epoch."""
+        key = (treedef, tuple(shapes), np.dtype(dtype).str, buckets.bucket_bytes())
+        sig = tuple(
+            buckets.sharding_signature(s, getattr(l, "sharding", None))
+            for s, l in zip(shapes, leaves)
+        )
+        layout = self._sharded_layouts.get(key)
+        if layout is not None:
+            if layout.shard_sig != sig:
+                raise GradientShardingError(
+                    f"accumulator {self._name}: gradient sharding changed "
+                    f"mid-run — first staged with signature "
+                    f"{layout.shard_sig!r}, now {sig!r}.  The sharded-reduce "
+                    "layout is cohort wire protocol; produce a stable "
+                    "sharding from the train step (or disable "
+                    "set_sharded_allreduce before changing it)"
+                )
+            return layout
+        layout = buckets.BucketLayout.from_shardings(
+            treedef, shapes,
+            [getattr(l, "sharding", None) for l in leaves], dtype,
+        )
+        self._sharded_layouts[key] = layout
         return layout
 
     def _flat_stage_dtype(self, treedef, specs, ring: bool,
@@ -729,7 +855,7 @@ class Accumulator:
             return None
         return dtypes.pop()
 
-    def _stage_flat(self, gradients, ring: bool):
+    def _stage_flat(self, gradients, ring: bool, sharded: bool = False):
         """Flatten a gradient pytree into a pooled flat host buffer.
 
         Returns ``(flat, layout, treedef)`` or None when the tree is not
@@ -757,7 +883,12 @@ class Accumulator:
                 leaf.copy_to_host_async()
                 d2h += 1
         t_fill = time.monotonic()
-        layout = self._flat_layout(treedef, [s for s, _ in specs], stage_dtype)
+        if sharded:
+            layout = self._sharded_flat_layout(
+                treedef, [s for s, _ in specs], stage_dtype, leaves
+            )
+        else:
+            layout = self._flat_layout(treedef, [s for s, _ in specs], stage_dtype)
         flat = buckets.lease(layout.total, stage_dtype)
         layout.fill(flat, leaves)
         if self._wire_q8:
@@ -848,6 +979,7 @@ class Accumulator:
                 self._reduce_bytes["rpc"] += nb
                 _M_REDUCE_BYTES.inc(nb, plane="rpc")
                 _M_BUCKET_BYTES.inc(nb, plane="rpc")
+                _M_INTERHOST.inc(nb, kind="grad")
             _M_BUCKET_ROUNDS.inc(plane="rpc")
             _M_BUCKETS.inc(layout.n_buckets, plane="rpc")
             self._inflight.append(round_)
@@ -884,6 +1016,228 @@ class Accumulator:
                     time.monotonic() - round_.t0, plane=round_.plane
                 )
             self._drain_rounds_locked()
+
+    def _start_sharded_round(self, kind: str, stats: Dict[str, int], staged,
+                             fire_stats=None) -> None:
+        """Issue one sharded hierarchical round (docs/DESIGN.md §6d).
+
+        The flat payload is partitioned into N near-equal ranges on the
+        bucket grid (``buckets.shard_ranges`` — pure function of protocol
+        values, identical on every host).  Phase 1, reduce-scatter: one
+        bucketed sub-op per range; the range's OWNER contributes ``None``
+        (near-zero wire cost, a template gives the shape) while every other
+        host contributes its zero-copy slice view — so each host ships
+        (N-1)/N of the payload instead of all of it.  When the owner's op
+        resolves it folds its own local slice into the wire partial,
+        producing the true cohort sum of the range.  Phase 2, all-gather:
+        the owner redistributes the true sum on a second op (everyone else
+        contributes ``None``); the share-down terminus is the memfd
+        multicast, so each range lands once per host.  Round counts ride as
+        allreduce meta on the first non-empty gather op."""
+        flat, layout, treedef = staged
+        with self._lock:
+            if kind == "full":
+                if not self.connected():
+                    utils.log_verbose(
+                        "accumulator %s: dropping gradient contribution (not connected)",
+                        self._name,
+                    )
+                    buckets.release(flat)
+                    return
+                if len(self._inflight) >= self._parallel_gradients:
+                    buckets.release(flat)
+                    raise RpcError(
+                        f"{len(self._inflight)} gradient reductions already in flight "
+                        f"(parallel_gradients={self._parallel_gradients})"
+                    )
+                if self._has_gradients:
+                    buckets.release(flat)
+                    raise RpcError("unconsumed gradients; call zero_gradients() first")
+            members = list(self._group.members())
+            me = self._rpc.get_name()
+            n = len(members)
+            if n <= 1 or me not in members:
+                # Degenerate cohort: nothing to shard.  The flat tree round
+                # costs identical bytes here (zero — single member
+                # short-circuits) and keeps the op protocol trivial.
+                self._start_flat_round(kind, stats, staged, False,
+                                       fire_stats=fire_stats)
+                return
+            rank = members.index(me)
+            ranges = buckets.shard_ranges(layout.total, n, layout.bucket_elems)
+            nonempty = [g for g, (gs, ge) in enumerate(ranges) if ge > gs]
+            if self._wire_q8:
+                wire = "q8"
+            elif self._wire_dtype is not None:
+                wire = np.dtype(self._wire_dtype).name
+            else:
+                wire = None
+            item = 1 if wire == "q8" else (
+                np.dtype(wire).itemsize if wire else layout.dtype.itemsize
+            )
+            sr = _ShardedRound(
+                rank, ranges, layout, treedef, flat, dict(stats),
+                meta_group=nonempty[0], wire=wire, item=item,
+                remaining=len(nonempty),
+            )
+            round_ = _Round(
+                None, kind=("full" if kind == "full" else "grad"),
+                stats=fire_stats,
+            )
+            sr.round = round_
+            own = ranges[rank]
+            _M_SHARD_FRACTION.set(
+                (own[1] - own[0]) / layout.total if layout.total else 0.0,
+                accumulator=self._name, peer=me,
+            )
+            _M_BUCKET_ROUNDS.inc(plane="rpc")
+            self._inflight.append(round_)
+            # Phase 1 — reduce-scatter contributions.
+            for g in nonempty:
+                gs, ge = ranges[g]
+                owner = g == rank
+                value = None if (owner or flat is None) else flat[gs:ge]
+                template = (
+                    np.broadcast_to(np.zeros((), layout.dtype), (ge - gs,))
+                    if value is None else None
+                )
+                fut = self._group.all_reduce(
+                    f"__accum_sg{g}:{self._name}", value, op="sum",
+                    wire=wire, bucketed=True, template=template, owned=True,
+                )
+                if value is not None:
+                    nb = (ge - gs) * item
+                    self._reduce_bytes["rpc"] += nb
+                    _M_REDUCE_BYTES.inc(nb, plane="rpc")
+                    _M_BUCKET_BYTES.inc(nb, plane="rpc")
+                    _M_INTERHOST.inc(nb, kind="grad")
+                    _M_BUCKETS.inc(-(-(ge - gs) // layout.bucket_elems), plane="rpc")
+                if owner:
+                    fut.add_done_callback(
+                        lambda f, sr=sr: self._on_shard_scatter_done(sr, f)
+                    )
+            # Phase 2 — gather ops for the ranges we do NOT own (contribute
+            # nothing; receive the owner's true sum via the share-down).
+            # Our own range's gather is issued by the scatter callback once
+            # the wire partial lands.
+            for g in nonempty:
+                if g == rank:
+                    continue
+                gs, ge = ranges[g]
+                template = np.broadcast_to(np.zeros((), layout.dtype), (ge - gs,))
+                kw = dict(op="sum", wire=wire, bucketed=True,
+                          template=template, owned=True)
+                if g == sr.meta_group:
+                    kw.update(meta=dict(stats), meta_op=_count_reduce_op)
+                gfut = self._group.all_reduce(
+                    f"__accum_pg{g}:{self._name}", None, **kw
+                )
+                sr.gather[g] = gfut
+                gfut.add_done_callback(
+                    lambda f, sr=sr, g=g: self._on_shard_gather_done(sr, g, f)
+                )
+
+    def _on_shard_scatter_done(self, sr, fut):
+        """Own scatter op resolved: fold the local slice into the wire
+        partial — the owner now holds the TRUE cohort sum of its range —
+        and issue the gather op that redistributes it."""
+        err = fut.exception()
+        value = None if err is not None else fut.result(0)
+        with self._lock:
+            sr.err = sr.err or err
+            gs, ge = sr.ranges[sr.rank]
+            local = sr.flat[gs:ge] if sr.flat is not None else None
+            true = None
+            if err is None:
+                if value is not None and local is not None:
+                    # np.add allocates a fresh writable buffer: adopted
+                    # result views may be read-only memfd pages.
+                    true = np.add(np.asarray(value), local)
+                elif local is not None:
+                    # owned=True hands the buffer to the op (in-place folds);
+                    # never hand it a live view of the staging flat.
+                    true = local.copy()
+                elif value is not None:
+                    true = np.array(np.asarray(value))
+            template = None
+            if true is None:
+                template = np.broadcast_to(
+                    np.zeros((), sr.layout.dtype), (ge - gs,)
+                )
+            kw = dict(op="sum", wire=sr.wire, bucketed=True,
+                      template=template, owned=True)
+            if sr.meta_group == sr.rank:
+                kw.update(meta=dict(sr.stats), meta_op=_count_reduce_op)
+            gfut = self._group.all_reduce(
+                f"__accum_pg{sr.rank}:{self._name}", true, **kw
+            )
+            if true is not None:
+                _M_INTERHOST.inc((ge - gs) * sr.item, kind="gather")
+            sr.gather[sr.rank] = gfut
+            gfut.add_done_callback(
+                lambda f, sr=sr, g=sr.rank: self._on_shard_gather_done(sr, g, f)
+            )
+
+    def _on_shard_gather_done(self, sr, g, fut):
+        err = fut.exception()
+        res = meta = None
+        if err is None:
+            r = fut.result(0)
+            if g == sr.meta_group:
+                res, meta = r
+            else:
+                res = r
+        with self._lock:
+            sr.err = sr.err or err
+            if meta is not None:
+                sr.meta = meta
+            sr.results[g] = res
+            sr.remaining -= 1
+            if sr.remaining == 0:
+                self._finish_sharded_locked(sr)
+
+    def _finish_sharded_locked(self, sr):
+        """All gather ops resolved: assemble the full result flat from the
+        per-range true sums (every range's bytes arrived via the share-down,
+        so the assembly is host copies only) and hand the round to the
+        shared drain logic."""
+        buckets.release(sr.flat)
+        round_ = sr.round
+        norm = None
+        if sr.err is None:
+            flat = None
+            if any(r is not None for r in sr.results.values()):
+                flat = buckets.lease(sr.layout.total, sr.layout.dtype)
+                for g, (gs, ge) in enumerate(sr.ranges):
+                    if ge <= gs:
+                        continue
+                    r = sr.results.get(g)
+                    if r is None:
+                        flat[gs:ge] = 0
+                    else:
+                        np.copyto(flat[gs:ge], np.asarray(r), casting="unsafe")
+            grads = None
+            if flat is not None:
+                grads = jax.tree_util.tree_unflatten(
+                    sr.treedef, sr.layout.unflatten(flat)
+                )
+                # Eager pool offer (buckets.lease contract): the unflatten
+                # views keep the buffer alive; the refcount probe skips it
+                # until the consumer drops the result tree.
+                buckets.release(flat)
+            norm = {"grads": grads, "wire": None}
+            norm.update(
+                sr.meta
+                or {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
+            )
+        round_.done = True
+        round_.error = sr.err
+        round_.result = norm
+        if sr.err is None:
+            _M_REDUCE_LATENCY.observe(
+                time.monotonic() - round_.t0, plane=round_.plane
+            )
+        self._drain_rounds_locked()
 
     def set_ici_backend(self, enabled: bool = True) -> None:
         """Reduce gradients with an XLA collective over the device mesh (ICI
@@ -1247,6 +1601,17 @@ class Accumulator:
             self._start_round("count", stats, local)
             return
         use_ring = self._use_ring_locked()
+        if self._bucketed and self._sharded:
+            # Sharded hierarchical reduce (docs/DESIGN.md §6d): stage into a
+            # shard-pinned layout (signature-guarded — a mid-run sharding
+            # change raises GradientShardingError, never a silent fall-back
+            # to full-tree payloads) and run reduce-scatter + all-gather.
+            # The chunked-ring setting is ignored: the scatter already is
+            # the ring's reduce-scatter half.
+            staged = self._stage_flat(gradients, ring=False, sharded=True)
+            if staged is not None:
+                self._start_sharded_round("full", stats, staged)
+                return
         if self._bucketed:
             # Flat-bucket data plane (docs/DESIGN.md "Gradient data plane"):
             # one staging pass into a pooled flat buffer (D2H issued async
@@ -1299,6 +1664,15 @@ class Accumulator:
             self._start_round("count", stats, None)
             return
         use_ring = self._use_ring_locked()
+        if self._bucketed and self._sharded:
+            # Skip rounds must issue the same op set as contributing peers
+            # (the per-range ops are the round protocol): a plain layout from
+            # the param tree yields identical ranges — shard_ranges depends
+            # only on (total, N, bucket grid), never on the pinned cuts.
+            staged = self._stage_flat_skip(False)
+            if staged is not None:
+                self._start_sharded_round("full", stats, staged)
+                return
         if self._bucketed:
             staged = self._stage_flat_skip(use_ring)
             if staged is not None:
@@ -1356,6 +1730,7 @@ class Accumulator:
                     nb = _tree_nbytes(gradients)
                     self._reduce_bytes["rpc"] += nb
                     _M_REDUCE_BYTES.inc(nb, plane="rpc")
+                    _M_INTERHOST.inc(nb, kind="grad")
                 self._inflight.append(round_)
                 fut.add_done_callback(lambda f, r=round_: self._on_ring_round_done(r, f))
                 return
@@ -1378,6 +1753,7 @@ class Accumulator:
                     nb = _tree_nbytes(gradients)
                     self._reduce_bytes["rpc"] += nb
                     _M_REDUCE_BYTES.inc(nb, plane="rpc")
+                    _M_INTERHOST.inc(nb, kind="grad")
             self._inflight.append(round_)
             fut.add_done_callback(lambda f, r=round_: self._on_round_done(r, f))
 
@@ -1642,17 +2018,24 @@ class Accumulator:
             # the flat buffer (EF-q8 once, on the flat) and ships as
             # per-bucket pipelined ops; counts settled in phase 1 ride as
             # zeros (protocol uniformity, like the legacy paths below).
+            # With the sharded plane on, the one fire allreduce per virtual
+            # batch is itself sharded (reduce-scatter + all-gather).
+            sharded = self._sharded
+            ring = False if sharded else use_ring
             staged = (
-                self._stage_flat(grads, ring=use_ring)
+                self._stage_flat(grads, ring=ring, sharded=sharded)
                 if grads is not None
-                else self._stage_flat_skip(use_ring)
+                else self._stage_flat_skip(ring)
             )
             if staged is not None:
                 zero = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
                 fire_stats = dict(self._fire_stats)
                 self._fire_accum = None
                 self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
-                self._start_flat_round("grad", zero, staged, use_ring, fire_stats=fire_stats)
+                if sharded:
+                    self._start_sharded_round("grad", zero, staged, fire_stats=fire_stats)
+                else:
+                    self._start_flat_round("grad", zero, staged, use_ring, fire_stats=fire_stats)
                 return
         if use_ring:
             # Phase 2 over the chunked ring: the accumulated f32 sum ships
@@ -1676,6 +2059,7 @@ class Accumulator:
                 nb = _tree_nbytes(grads)
                 self._reduce_bytes["rpc"] += nb
                 _M_REDUCE_BYTES.inc(nb, plane="rpc")
+                _M_INTERHOST.inc(nb, kind="grad")
             self._fire_accum = None
             self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
             self._inflight.append(round_)
@@ -1708,6 +2092,7 @@ class Accumulator:
             nb = _tree_nbytes(grads)
             self._reduce_bytes["rpc"] += nb
             _M_REDUCE_BYTES.inc(nb, plane="rpc")
+            _M_INTERHOST.inc(nb, kind="grad")
         self._fire_accum = None
         self._fire_stats = {"num_gradients": 0, "num_skipped": 0, "batch_size": 0}
         self._inflight.append(round_)
@@ -2015,6 +2400,11 @@ class Accumulator:
                 # cohort-wide, docs/DESIGN.md "Gradient data plane").
                 "bucketed": self._bucketed,
                 "bucket_bytes": buckets.bucket_bytes(),
+                # Sharded hierarchical reduce (docs/DESIGN.md §6d): enabled
+                # flag + cached shard-pinned layouts (sharding-signature
+                # guarded; see GradientShardingError).
+                "sharded": self._sharded,
+                "sharded_layouts": len(self._sharded_layouts),
                 # q8 over the chunked ring rides as contributor-side EF
                 # quantization + bf16 hop transport (set_chunked_allreduce).
                 "ring_q8_mode": (

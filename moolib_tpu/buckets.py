@@ -107,6 +107,41 @@ class BucketLayout:
         test asserts two processes at the same model produce equal ones)."""
         return (self.dtype.str, self.bucket_elems, self.total, self.shapes)
 
+    @classmethod
+    def from_shardings(cls, treedef, shapes: Sequence[Tuple[int, ...]],
+                       shardings: Sequence, dtype=np.float32,
+                       bucket_bytes_: Optional[int] = None
+                       ) -> "ShardedBucketLayout":
+        """Layout for a tree of (possibly) device-sharded leaves: bucket
+        boundaries are pinned to per-leaf shard boundaries, so a device
+        shard's flat range never straddles a bucket — staging the addressable
+        shard and slicing a per-host reduce range both stay zero-copy views.
+
+        ``shardings`` is the flat list of per-leaf sharding objects (``None``
+        for plain host arrays), aligned with ``shapes``; ``treedef`` is
+        recorded for error messages only (the layout math is a function of
+        shapes/dtype/shard counts alone, so every host at the same model and
+        mesh computes the same layout — the cohort-wide wire contract).
+        """
+        sig = tuple(
+            sharding_signature(s, sh) for s, sh in zip(shapes, shardings)
+        )
+        cuts: List[int] = []
+        off = 0
+        for shape, entry in zip(shapes, sig):
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if entry is not None:
+                counts = entry[1]
+                nshards = int(np.prod(counts, dtype=np.int64)) if counts else 1
+                if nshards > 1 and n % nshards == 0:
+                    step = n // nshards
+                    cuts.extend(off + j * step for j in range(1, nshards))
+            off += n
+        return ShardedBucketLayout(
+            shapes, dtype, cuts, sig, treedef=treedef,
+            bucket_bytes_=bucket_bytes_,
+        )
+
     def fill(self, flat: np.ndarray, leaves: Sequence) -> None:
         """Copy ``leaves`` into ``flat`` in layout order — exactly one pass,
         dtype conversion fused into the copy (no per-leaf staging array)."""
@@ -120,6 +155,85 @@ class BucketLayout:
             flat[off:off + n].reshape(s)
             for off, n, s in zip(self.offsets, self.sizes, self.shapes)
         ]
+
+
+class ShardedBucketLayout(BucketLayout):
+    """A :class:`BucketLayout` whose bucket boundaries are additionally
+    pinned to device-shard boundaries (``BucketLayout.from_shardings``).
+
+    The uniform ``bucket_elems`` grid stays intact — extra cut points are
+    inserted where a leaf's shard boundary falls inside a bucket, splitting
+    that bucket in two.  The per-host reduce ranges (``shard_ranges``) are
+    derived from the uniform grid only, so a host that has never seen a
+    sharded gradient tree (e.g. it only ever skipped) computes the identical
+    ranges — the ranges are the wire protocol, the pinned bounds are a local
+    zero-copy/quantization alignment property.
+    """
+
+    __slots__ = ("shard_cuts", "shard_sig")
+
+    def __init__(self, shapes, dtype, shard_cuts: Sequence[int],
+                 shard_sig: tuple, treedef=None,
+                 bucket_bytes_: Optional[int] = None):
+        super().__init__(shapes, dtype, bucket_bytes_)
+        cuts = sorted({int(c) for c in shard_cuts if 0 < int(c) < self.total})
+        self.shard_cuts = tuple(cuts)
+        self.shard_sig = shard_sig
+        if cuts:
+            edges = sorted(
+                {0, self.total, *cuts,
+                 *(k * self.bucket_elems for k in range(1, self.n_buckets))}
+            )
+            self.bounds = tuple(zip(edges[:-1], edges[1:]))
+            self.n_buckets = len(self.bounds)
+
+    def signature(self) -> tuple:
+        return super().signature() + (self.shard_cuts, self.shard_sig)
+
+
+def sharding_signature(shape: Tuple[int, ...], sharding) -> Optional[tuple]:
+    """Process-independent identity of one leaf's device sharding, or None
+    for plain host arrays / replicated leaves: ``(spec_str, per_axis_shard
+    counts)``.  Derived without device objects (device ids differ across
+    hosts; the partition function does not), so equal meshes + equal specs
+    give equal signatures cohort-wide — the key the Accumulator's sharded
+    layout cache is guarded by."""
+    if sharding is None:
+        return None
+    try:
+        ss = sharding.shard_shape(tuple(int(d) for d in shape))
+        counts = tuple(
+            int(d // s) if s else 1 for d, s in zip(shape, ss)
+        )
+        if all(c <= 1 for c in counts):
+            return None  # fully replicated: indistinguishable from host data
+        spec = getattr(sharding, "spec", None)
+        return (str(spec), counts)
+    except Exception:  # noqa: BLE001 — opaque sharding types degrade gracefully
+        return (f"opaque:{type(sharding).__name__}", ())
+
+
+def shard_ranges(total: int, n: int, align: int = 1
+                 ) -> List[Tuple[int, int]]:
+    """Partition ``[0, total)`` into ``n`` contiguous near-equal ranges with
+    boundaries aligned to multiples of ``align`` (the bucket grid) — the
+    per-host ownership map of the sharded hierarchical allreduce.  Pure
+    function of ``(total, n, align)``: every cohort member computes the same
+    ranges from protocol-level values alone.  Ranges may be empty when
+    ``total < n`` after alignment; small payloads fall back to element
+    granularity so every host still owns ~1/n of the work."""
+    total, n, align = int(total), int(n), max(1, int(align))
+    if n < 1:
+        raise ValueError("shard_ranges: need n >= 1")
+    if align * n > total:
+        align = 1  # small payload: alignment would starve trailing hosts
+    cuts = [0]
+    for i in range(1, n):
+        ideal = (i * total) // n
+        c = ((ideal + align // 2) // align) * align
+        cuts.append(min(total, max(cuts[-1], c)))
+    cuts.append(total)
+    return list(zip(cuts[:-1], cuts[1:]))
 
 
 # --------------------------------------------------------------------- pool

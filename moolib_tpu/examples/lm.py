@@ -129,6 +129,13 @@ def make_flags(argv=None):
     p.add_argument("--virtual_batch_size", type=int, default=0,
                    help="global batch per optimizer step (0: one reduction "
                    "per contribution)")
+    p.add_argument("--shard_grads", action="store_true",
+                   help="hierarchical reduce plane (DESIGN.md §6d): the "
+                   "jitted step psums grads over the in-mesh dp axis and "
+                   "returns them fsdp-sharded; the Accumulator then "
+                   "reduce-scatters only (N-1)/N of the flat payload "
+                   "between hosts.  Composes --mesh with the elastic "
+                   "cohort (--address/--connect); requires both")
     p.add_argument("--wire_dtype", default=None, choices=[None, "bf16", "int8"])
     p.add_argument("--localdir", default=None,
                    help="per-peer scratch dir: the autoscaler's decommission "
@@ -193,19 +200,32 @@ def train(flags, on_stats=None) -> dict:
     _faults.install_from_env()  # opt-in chaos (MOOLIB_FAULTS; no-op unset)
     if flags.seq_len % 2:
         raise ValueError("--seq_len must be even")
-    if flags.address or flags.connect or getattr(flags, "broker_addrs", None):
+    elastic = bool(
+        flags.address or flags.connect or getattr(flags, "broker_addrs", None)
+    )
+    if getattr(flags, "shard_grads", False) and not elastic:
+        raise ValueError(
+            "--shard_grads is the hierarchical (inter-host) reduce plane; "
+            "it requires the elastic cohort (--address/--connect).  A "
+            "standalone mesh run already reduces over ICI inside the step."
+        )
+    if elastic:
         # Elastic DP rides the plain single-device step: drop the PARSER
         # DEFAULTS that only make sense in-mesh so `--connect HOST` works
-        # as documented; an explicitly-requested mesh is a real conflict.
+        # as documented; an explicitly-requested mesh is a real conflict —
+        # unless --shard_grads composes the two planes hierarchically
+        # (in-mesh psum inside the jitted step, sharded RPC rounds between
+        # hosts; DESIGN.md §6d).
         if flags.mesh == "dp=2,sp=4":
             flags["mesh"] = ""
         if flags.attention == "ring" and not flags.mesh:
             flags["attention"] = "dense"
-        if flags.mesh:
+        if flags.mesh and not getattr(flags, "shard_grads", False):
             raise ValueError(
                 "elastic DP (--address/--connect) composes with the plain "
                 "single-device step; in-mesh parallelism belongs inside a "
-                "static cohort (use the vtrace agent's --mesh for that shape)"
+                "static cohort (use the vtrace agent's --mesh for that "
+                "shape, or pass --shard_grads for the hierarchical plane)"
             )
     mesh = parallel.parse_mesh_spec(flags.mesh)
     axes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh is not None else {}
@@ -330,9 +350,10 @@ def train(flags, on_stats=None) -> dict:
             if not flags.quiet:
                 print(f"resumed from checkpoint step {start_step}", flush=True)
 
-    if flags.address or flags.connect or getattr(flags, "broker_addrs", None):
+    if elastic:
         return _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
-                              on_stats=on_stats, ckpt=ckpt, start_step=start_step)
+                              on_stats=on_stats, ckpt=ckpt, start_step=start_step,
+                              mesh=mesh)
 
     if mesh is None:
         jstep = jax.jit(step)
@@ -410,12 +431,21 @@ def train(flags, on_stats=None) -> dict:
 
 
 def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
-                   on_stats=None, ckpt=None, start_step=0) -> dict:
+                   on_stats=None, ckpt=None, start_step=0, mesh=None) -> dict:
     """Elastic data-parallel LM training over the Accumulator cohort: the
     wants/has gradient protocol the RL agents ride (leader election, model
     sync, virtual batches, wire compression), applied unchanged to
     TransformerLM — the elastic plane is model-agnostic by construction.
     Peers join/leave freely; a joiner adopts the leader's model + opt state.
+
+    With ``--shard_grads`` + ``--mesh`` the two reduce planes compose
+    hierarchically (DESIGN.md §6d): the jitted grad step psums over the
+    in-mesh ``dp`` axis and returns fsdp-sharded grads
+    (``make_train_step(grad_spec=...)``), the Accumulator's sharded rounds
+    reduce-scatter only (N-1)/N of the flat payload between hosts, and the
+    optimizer apply runs sharded (ZeRO-style — adamw is elementwise, so the
+    sharded apply is bit-identical to the replicated one) before
+    ``parallel.redistribute`` fans the updated params back across the mesh.
 
     Fault domains (docs/RESILIENCE.md): the leader checkpoints on an
     interval and on the way out (so a kill resumes from the newest intact
@@ -494,6 +524,11 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
         # Leader election prefers the restored peer (checkpoint.py docs).
         acc.set_model_version(start_step)
     acc.listen()
+    shard_grads = bool(getattr(flags, "shard_grads", False))
+    if shard_grads:
+        # Wire protocol: every cohort peer must enable the sharded plane
+        # (the per-range ops replace the single full-tree op).
+        acc.set_sharded_allreduce(True)
     if flags.virtual_batch_size:
         acc.set_virtual_batch_size(flags.virtual_batch_size)
     if flags.wire_dtype == "bf16":
@@ -517,13 +552,58 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
             lambda v: announced_version.__setitem__(0, v)
         )
 
-    jgrad = jax.jit(lambda p, t: jax.value_and_grad(loss_fn, has_aux=True)(p, t))
-
     def apply_fn(p, s, g):
         updates, s = opt.update(g, s, p)
         return optax.apply_updates(p, updates), s
 
-    japply = jax.jit(apply_fn)
+    use_mesh = shard_grads and mesh is not None
+    if use_mesh:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        m_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        tok_spec = P("dp", None) if m_axes.get("dp", 1) > 1 else P()
+        tok_sharding = NamedSharding(mesh, tok_spec)
+        # In-mesh half of the hierarchy: grads psum over dp INSIDE the jit
+        # and come back fsdp-sharded ("params" mirrors the param shardings),
+        # ready for the Accumulator's shard-aligned staging.
+        gstep = parallel.make_train_step(
+            lambda p, b, r: loss_fn(p, b),
+            mesh=mesh, params_sharding="fsdp", grad_spec="params",
+            batch_spec=tok_spec,
+        )
+        p_sh_cache: dict = {}
+
+        def _p_sh(tree):
+            if "v" not in p_sh_cache:
+                p_sh_cache["v"] = parallel.param_shardings(tree, mesh, "fsdp")
+            return p_sh_cache["v"]
+
+        japply_cache: dict = {}
+
+        def japply(p, s, g):
+            # ZeRO-style sharded apply: params/grads pinned to the fsdp
+            # shardings, so each device updates only its owned shard
+            # (adamw is elementwise — bit-identical to a replicated apply).
+            p_sh = _p_sh(p)
+            if "fn" not in japply_cache:
+                japply_cache["fn"] = jax.jit(
+                    apply_fn,
+                    in_shardings=(p_sh, None, p_sh),
+                    out_shardings=(p_sh, None),
+                )
+            pdev = parallel.redistribute(p, p_sh)
+            gdev = parallel.redistribute(g, p_sh)
+            return japply_cache["fn"](pdev, s, gdev)
+
+        grad_rng = jax.random.key(flags.seed)
+
+        def jgrad(p, t):
+            loss, aux, grads = gstep(p, jax.device_put(t, tok_sharding), grad_rng)
+            return (loss, aux), grads
+
+    else:
+        jgrad = jax.jit(lambda p, t: jax.value_and_grad(loss_fn, has_aux=True)(p, t))
+        japply = jax.jit(apply_fn)
 
     steps_done = start_step
     loss_v = acc_v = None

@@ -111,6 +111,16 @@ def make_flags(argv=None):
         help="compress gradient allreduce payloads (bf16: 2x, int8+EF: 4x)",
     )
     p.add_argument(
+        "--shard_grads",
+        action="store_true",
+        help="hierarchical reduce plane (DESIGN.md §6d): with --mesh the "
+        "jitted step already psums grads over in-mesh dp; this additionally "
+        "makes the Accumulator's inter-host rounds sharded — each host "
+        "reduce-scatters a disjoint 1/N slice of the flat payload, cutting "
+        "contributed bytes to (N-1)/N.  Composes with --actor_mesh/Sebulba "
+        "and wire compression; every cohort peer must pass it",
+    )
+    p.add_argument(
         "--chunked",
         action="store_true",
         help="force gradient rounds over the chunked ring allreduce "
@@ -497,6 +507,12 @@ def train(flags, on_stats=None) -> dict:
             # the devices (below, as `mesh`) form the learner; trajectories
             # hop between them through the Batcher's device_put.
             actor_mesh, mesh = parallel.split_mesh(mesh, flags.actor_mesh)
+            # split_mesh partitions by construction; the explicit check
+            # keeps a future hand-rolled spec from wedging the cohort at
+            # the first cross-program collective (a clear error instead).
+            parallel.check_disjoint(mesh, actor_mesh,
+                                    what_a="--mesh (learner remainder)",
+                                    what_b="--actor_mesh")
         if flags.batch_size % mesh.shape.get("dp", 1):
             raise ValueError("the dp mesh axis size must divide --batch_size")
         sp = mesh.shape.get("sp", 1)
@@ -604,6 +620,13 @@ def train(flags, on_stats=None) -> dict:
     )
     accumulator.set_virtual_batch_size(flags.virtual_batch_size)
     accumulator.set_model_version(model_version)
+    if flags.shard_grads:
+        # Hierarchical inter-host rounds (DESIGN.md §6d).  Wire protocol:
+        # identical on every cohort peer.  Grads arrive already sharded when
+        # --mesh is set (grad_fn's out_shardings), so the flat layout pins
+        # bucket cuts to the shard boundaries; without a mesh the rounds
+        # still shard by flat range.
+        accumulator.set_sharded_allreduce(True)
     if flags.ici:
         accumulator.set_ici_backend(True)
     if flags.wire_dtype == "bf16":

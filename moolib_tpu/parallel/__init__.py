@@ -8,6 +8,7 @@ control plane around it.
 
 from .mesh import (  # noqa: F401
     AXES,
+    check_disjoint,
     initialize_distributed,
     local_batch_size,
     make_mesh,
@@ -21,6 +22,7 @@ from .collectives import (  # noqa: F401
     all_gather_axis,
     axis_size,
     pcast,
+    redistribute,
     reduce_scatter_axis,
     ring_permute,
     shard_map,

@@ -12,6 +12,17 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
+
+# Shared with the accumulator's sharded rounds (registration is idempotent):
+# one histogram covers every in-mesh share-down / resharding hop so the
+# hierarchical plane's device-redistribution cost reads off a single series.
+_M_PSUM = telemetry.get_registry().histogram(
+    "accum_psum_seconds",
+    "host wall time in the in-mesh share-down / resharding of reduced "
+    "tensors (parallel.redistribute and the sharded-round share-down)",
+)
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     """``jax.shard_map`` across jax versions: newer jax exposes it at top
@@ -76,3 +87,34 @@ def all_gather_axis(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
 
 def reduce_scatter_axis(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def redistribute(tree: Any, shardings: Any, block: bool = False) -> Any:
+    """Reshard a pytree onto target shardings (mesh-to-mesh redistribution).
+
+    The all-gather-by-multicast half of the hierarchical reduce plane
+    (DESIGN.md §6d), following the portable-collective redistribution recipe
+    of arxiv 2112.01075: each leaf is ``device_put`` to its target
+    ``NamedSharding``/``Sharding``, which XLA lowers to the minimal transfer
+    between the source and target layouts (all-gather when un-sharding a
+    ZeRO-applied update, plain layout change otherwise).  ``shardings`` is a
+    pytree of shardings matching ``tree`` or a single sharding broadcast to
+    every leaf.  With ``block=True`` the call waits for the transfers so the
+    recorded wall time covers the copies, not just their dispatch.  Host
+    time lands in ``accum_psum_seconds``.
+    """
+    is_single = not isinstance(shardings, (dict, list, tuple)) and not hasattr(
+        shardings, "keys"
+    )
+    with _M_PSUM.time():
+        if is_single:
+            out = jax.tree_util.tree_map(lambda x: jax.device_put(x, shardings), tree)
+        else:
+            out = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), tree, shardings
+            )
+        if block:
+            for leaf in jax.tree_util.tree_leaves(out):
+                if hasattr(leaf, "block_until_ready"):
+                    leaf.block_until_ready()
+        return out

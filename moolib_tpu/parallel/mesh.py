@@ -141,6 +141,29 @@ def split_mesh(mesh: Mesh, actor_devices: int) -> Tuple[Mesh, Mesh]:
     return actor, learner
 
 
+def check_disjoint(
+    mesh_a: Mesh, mesh_b: Mesh, what_a: str = "--mesh", what_b: str = "--actor_mesh"
+) -> None:
+    """Raise a clear ValueError when two meshes share devices.
+
+    Overlapping actor/learner meshes don't fail fast on their own — the two
+    jit'd programs contend for the same chips and the cohort *wedges* at the
+    first cross-program collective instead of erroring.  The example agents
+    call this at flag-parse time so the operator sees which device ids
+    collide and which flags produced them.
+    """
+    ids_a = {d.id for d in mesh_a.devices.flat}
+    ids_b = {d.id for d in mesh_b.devices.flat}
+    shared = sorted(ids_a & ids_b)
+    if shared:
+        raise ValueError(
+            f"{what_a} and {what_b} overlap on device ids {shared}: the two "
+            f"meshes must be disjoint ({what_a} spans {sorted(ids_a)}, "
+            f"{what_b} spans {sorted(ids_b)}). Use split_mesh() or shift one "
+            "spec onto different devices."
+        )
+
+
 def named(mesh: Mesh, *spec) -> NamedSharding:
     """Shorthand: ``named(mesh, "dp", None)`` → NamedSharding over P(dp, ∅)."""
     return NamedSharding(mesh, P(*spec))

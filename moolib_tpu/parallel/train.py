@@ -19,6 +19,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
+from ..utils import init_compile_cache
 from .mesh import replicated
 
 # Host-side view of the jitted step: dispatch wall time (async — the device
@@ -115,11 +116,12 @@ def auto_shardings(
 
 def make_train_step(
     loss_fn: Callable,
-    optimizer: optax.GradientTransformation,
+    optimizer: Optional[optax.GradientTransformation] = None,
     mesh: Optional[Mesh] = None,
     params_sharding=None,
     batch_spec: Optional[P] = None,
     donate: bool = True,
+    grad_spec=None,
 ):
     """Build ``step(params, opt_state, batch, rng) -> (params, opt_state,
     loss, aux)``.
@@ -127,6 +129,16 @@ def make_train_step(
     ``loss_fn(params, batch, rng) -> (loss, aux)`` must return the *local
     mean* loss; with the batch sharded over ``dp`` XLA turns the global mean
     gradient into an all-reduce over ICI automatically.
+
+    With ``grad_spec=`` (requires ``mesh=``) the optimizer apply is elided
+    and the step instead returns ``(loss, aux, grads)`` — the hierarchical
+    learner's in-mesh half (DESIGN.md §6d): the psum over the mesh's ``dp``
+    axis happens INSIDE the jitted step (pinned by the grads' out_shardings,
+    so "replicated" compiles to an all-reduce and "fsdp"/"params" to a
+    reduce-scatter over ICI), and the caller hands the already-reduced
+    sharded grads to ``Accumulator.reduce_gradients`` for the inter-host
+    round.  ``grad_spec`` is a mode string ("replicated" / "fsdp" /
+    "params" to mirror ``params_sharding``) or a sharding pytree.
     """
 
     def step(params, opt_state, batch, rng):
@@ -134,6 +146,15 @@ def make_train_step(
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, aux
+
+    def grad_step(params, batch, rng):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch, rng)
+        return loss, aux, grads
+
+    if grad_spec is not None and mesh is None:
+        raise ValueError("grad_spec= requires mesh=")
+    if grad_spec is None and optimizer is None:
+        raise ValueError("make_train_step needs an optimizer unless grad_spec= is given")
 
     if mesh is None:
         return _instrument_step(jax.jit(step, donate_argnums=(0, 1) if donate else ()))
@@ -161,8 +182,51 @@ def make_train_step(
 
     compiled = {}
 
+    if grad_spec is not None:
+        gs = grad_spec
+        if isinstance(gs, str):
+            if gs not in ("replicated", "fsdp", "params"):
+                raise ValueError(
+                    f"unknown grad_spec {gs!r} (expected 'replicated', 'fsdp', "
+                    "'params', or a sharding pytree)"
+                )
+            g_resolved = {}
+
+            def get_gs(params):
+                if "v" not in g_resolved:
+                    if gs == "params":
+                        g_resolved["v"] = get_ps(params)
+                    else:
+                        g_resolved["v"] = param_shardings(params, mesh, gs)
+                return g_resolved["v"]
+
+        else:
+
+            def get_gs(params):
+                return gs
+
+        def sharded_grad_step(params, batch, rng):
+            if "fn" not in compiled:
+                # Persistent compile cache so a multi-host restart replays
+                # the pjit'd step from disk instead of recompiling
+                # (utils/compile_cache.py; no-op unless configured).
+                init_compile_cache()
+                compiled["fn"] = jax.jit(
+                    grad_step,
+                    in_shardings=(
+                        get_ps(params),
+                        jax.tree_util.tree_map(lambda _: bsharding, batch),
+                        rep,
+                    ),
+                    out_shardings=(rep, None, get_gs(params)),
+                )
+            return compiled["fn"](params, batch, rng)
+
+        return _instrument_step(sharded_grad_step)
+
     def sharded_step(params, opt_state, batch, rng):
         if "fn" not in compiled:
+            init_compile_cache()
             p_sh = get_ps(params)
             o_sh = jax.tree_util.tree_map(
                 lambda _: rep, opt_state,

@@ -101,6 +101,52 @@ step "allreduce smoke (bucketed vs legacy vs numpy reference: tree + ring + q8, 
 # match the legacy path / numpy reference; also prints loopback MB/s.
 python benchmarks/allreduce_bench.py --smoke || fail=1
 
+step "sharded hierarchical allreduce tests (shard-aligned layouts, typed sharding guard, skip/vbatch composition)"
+python -m pytest tests/test_sharded_allreduce.py -q || fail=1
+
+step "sharded allreduce 2-process smoke (per-host grad bytes must drop by the shard factor)"
+# Two real processes over loopback run one legacy and one sharded gradient
+# round on identical contributions (DESIGN.md §6d): results must be
+# bit-identical to the legacy plane AND a numpy reference, and each rank's
+# own accum_interhost_bytes_total{kind="grad"} per round must come in at
+# <= 0.55x legacy for 2 hosts ((N-1)/N + margin) — the byte drop is
+# measured across real process boundaries, not simulated in one process.
+shard_port=$((21000 + RANDOM % 20000))
+shard_log0="${TMPDIR:-/tmp}/moolib_ci_sharded_r0.log"
+shard_log1="${TMPDIR:-/tmp}/moolib_ci_sharded_r1.log"
+WORLD_SIZE=2 RANK=1 BROKER_ADDR="127.0.0.1:${shard_port}" \
+  python benchmarks/allreduce_bench.py rpc --sharded --smoke > "$shard_log1" 2>&1 &
+shard_pid=$!
+WORLD_SIZE=2 RANK=0 BROKER_ADDR="127.0.0.1:${shard_port}" \
+  python benchmarks/allreduce_bench.py rpc --sharded --smoke > "$shard_log0" 2>&1
+shard_rc0=$?
+wait "$shard_pid"; shard_rc1=$?
+cat "$shard_log0"
+if [ "$shard_rc0" = 0 ] && [ "$shard_rc1" = 0 ]; then
+  python benchmarks/fold_capture.py --local "$shard_log0" || fail=1
+else
+  echo "sharded 2-process smoke failed (rc0=$shard_rc0 rc1=$shard_rc1)"
+  cat "$shard_log1"
+  fail=1
+fi
+
+step "sharded allreduce A/B rows (legacy vs sharded per-host bytes; folds into BENCH_LOCAL.json banner-keyed)"
+# The measured claim as committed data: per-host grad bytes per round on
+# both planes plus the ratio section.  fold_capture merges banner-keyed,
+# so these rows coexist with the committed tree/ring sweep instead of
+# clobbering it (and vice versa).
+shard_ab_log="${TMPDIR:-/tmp}/moolib_ci_sharded_ab.log"
+python benchmarks/allreduce_bench.py rpc --sharded --world_size 2 --iters 3 \
+  --sizes 10000 100000 1000000 \
+  --broker_addr "127.0.0.1:$((21000 + RANDOM % 20000))" > "$shard_ab_log" 2>&1
+shard_ab_rc=$?
+cat "$shard_ab_log"
+if [ "$shard_ab_rc" = 0 ]; then
+  python benchmarks/fold_capture.py --local "$shard_ab_log" || fail=1
+else
+  fail=1
+fi
+
 step "chaos soak (seeded, ~80 s smoke: worker/peer kills + respawn SLO, RPC frame chaos, forced-kill resume)"
 # Exits non-zero if any phase stalls past its watchdog/deadline, or the
 # respawned peer misses its recovery bound (docs/RESILIENCE.md recovery

@@ -37,6 +37,66 @@ print("COMPILE_SECONDS=%%.4f" %% (time.perf_counter() - t0), flush=True)
 """
 
 
+_SHARDED_CHILD = r"""
+import sys
+import numpy as np
+sys.path.insert(0, %(root)r)
+import jax, jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from moolib_tpu import parallel
+from moolib_tpu.utils import compile_cache
+
+# The child never calls init_compile_cache itself: the sharded step path
+# must do the wiring on its own before its first jit.  All inputs are
+# plain numpy — jax memoizes its cache-enabled decision at the FIRST
+# compile of the process, so even a jnp.zeros() here would lock the cache
+# off before the step's init ran (which is exactly why the step does the
+# wiring before ITS first jit).
+assert compile_cache.compile_cache_dir() is None
+
+def loss_fn(params, batch, rng):
+    pred = batch["x"] @ params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+mesh = parallel.make_mesh({"dp": 8})
+step = parallel.make_train_step(
+    loss_fn, mesh=mesh, grad_spec="replicated", batch_spec=P(None, "dp")
+)
+params = {"w": np.zeros((64, 64), np.float32)}
+batch = {
+    "x": np.ones((1, 8, 64), np.float32),
+    "y": np.zeros((1, 8, 64), np.float32),
+}
+loss, aux, grads = step(params, batch, np.uint32(0))
+jax.block_until_ready(grads)
+d = compile_cache.compile_cache_dir()
+assert d, "sharded grad step did not initialize the compile cache"
+print("CACHE_DIR=" + d, flush=True)
+"""
+
+
+def test_sharded_grad_step_initializes_cache(tmp_path):
+    """The mesh-sharded grad step (DESIGN.md §6d) must wire the persistent
+    cache itself before its first jit — a restarted pod-scale learner
+    replays the pjit'd step from disk without the caller remembering to."""
+    cache = str(tmp_path / "jax_cache")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        MOOLIB_COMPILE_CACHE=cache,
+        MOOLIB_COMPILE_CACHE_MIN_COMPILE_SECS="0.0",
+        PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", _SHARDED_CHILD % {"root": ROOT}],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "CACHE_DIR=" in out.stdout, out.stdout
+    assert os.listdir(cache), "sharded step persisted no cache entries"
+
+
 def _run_incarnation(cache_dir: str) -> float:
     env = dict(
         os.environ,

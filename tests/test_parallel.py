@@ -206,3 +206,120 @@ def test_flash_attention_return_lse():
     np.testing.assert_allclose(
         np.asarray(lse2), want_lse[:, :160], rtol=1e-4, atol=1e-4
     )
+
+
+# ---------------------------------------------------------------- mesh specs
+def test_parse_mesh_spec_multi_axis():
+    mesh = parallel.parse_mesh_spec("dp=2,tp=2")
+    assert mesh.shape == {"dp": 2, "tp": 2}
+    # Whitespace and trailing commas are operator input, not wire protocol.
+    mesh = parallel.parse_mesh_spec(" dp=2 , sp=2 ,")
+    assert mesh.shape == {"dp": 2, "sp": 2}
+    # -1 absorbs every remaining device (8 on the virtual CPU mesh).
+    mesh = parallel.parse_mesh_spec("tp=2,dp=-1")
+    assert mesh.shape == {"tp": 2, "dp": 4}
+    assert parallel.parse_mesh_spec("") is None
+
+
+def test_parse_mesh_spec_non_power_of_two():
+    # prod(sizes) < device count: the spec takes the FIRST prod devices, so
+    # odd cohort shapes (3 of 8) are legal without -1 arithmetic.
+    mesh = parallel.parse_mesh_spec("dp=3")
+    assert mesh.shape == {"dp": 3}
+    assert len(list(mesh.devices.flat)) == 3
+    mesh = parallel.parse_mesh_spec("dp=3,tp=2")
+    assert mesh.shape == {"dp": 3, "tp": 2}
+    # -1 with a non-dividing known axis must error loudly, not truncate.
+    with pytest.raises(ValueError):
+        parallel.parse_mesh_spec("dp=-1,tp=3")
+    # At most one axis may absorb.
+    with pytest.raises(ValueError):
+        parallel.parse_mesh_spec("dp=-1,tp=-1")
+
+
+def test_split_mesh_non_power_of_two():
+    # 8 devices, 3 actors: learner keeps the odd remainder as pure dp.
+    actor, learner = parallel.split_mesh(parallel.make_mesh({"dp": 8}), 3)
+    assert actor.shape == {"dp": 3}
+    assert learner.shape == {"dp": 5}
+    # Non-dp axes survive when they still divide the remainder...
+    actor, learner = parallel.split_mesh(parallel.make_mesh({"dp": 4, "tp": 2}), 2)
+    assert learner.shape == {"dp": 3, "tp": 2}
+    # ...and collapse into dp when they no longer fit.
+    actor, learner = parallel.split_mesh(parallel.make_mesh({"dp": 4, "tp": 2}), 3)
+    assert learner.shape == {"dp": 5}
+    for bad in (0, 8, 9):
+        with pytest.raises(ValueError):
+            parallel.split_mesh(parallel.make_mesh({"dp": 8}), bad)
+
+
+def test_check_disjoint_overlap_error_names_flags():
+    devs = jax.devices()
+    a = parallel.make_mesh({"dp": 4}, devs[:4])
+    b = parallel.make_mesh({"dp": 4}, devs[4:])
+    parallel.check_disjoint(a, b)  # disjoint: no error
+    overlap = parallel.make_mesh({"dp": 4}, devs[2:6])
+    with pytest.raises(ValueError) as ei:
+        parallel.check_disjoint(a, overlap, what_a="--mesh", what_b="--actor_mesh")
+    msg = str(ei.value)
+    # The operator must see which flags collided and on which device ids.
+    assert "--mesh" in msg and "--actor_mesh" in msg
+    assert "2" in msg and "3" in msg
+    # split_mesh output always passes by construction.
+    actor, learner = parallel.split_mesh(parallel.make_mesh({"dp": 8}), 2)
+    parallel.check_disjoint(learner, actor)
+
+
+# ------------------------------------------------------- grad_spec train step
+def test_grad_step_matches_direct_grad():
+    """The hierarchical learner's in-mesh half (DESIGN.md §6d): the
+    grad_spec= path must return the same dp-reduced gradients as unsharded
+    single-device autodiff, with the requested output sharding."""
+    mesh = parallel.make_mesh({"dp": 4}, jax.devices()[:4])
+    rng = np.random.default_rng(11)
+    params = {"w": jnp.asarray(rng.normal(size=(512, 512)).astype(np.float32) * 0.02)}
+    batch = {
+        "x": jnp.asarray(rng.normal(size=(1, 8, 512)).astype(np.float32)),
+        "y": jnp.asarray(rng.normal(size=(1, 8, 512)).astype(np.float32)),
+    }
+
+    def loss_fn(params, batch, rng_key):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    gstep = parallel.make_train_step(
+        loss_fn, mesh=mesh, grad_spec="replicated", batch_spec=P(None, "dp")
+    )
+    loss, _, grads = gstep(params, batch, jax.random.key(0))
+    want = jax.grad(lambda p: loss_fn(p, batch, None)[0])(params)
+    np.testing.assert_allclose(
+        np.asarray(grads["w"]), np.asarray(want["w"]), rtol=1e-5, atol=1e-6
+    )
+    assert np.isfinite(float(loss))
+
+    # grad_spec="params" mirrors the fsdp param sharding: XLA lowers the dp
+    # reduction to a reduce-scatter and the grads come back shard-laid-out.
+    fstep = parallel.make_train_step(
+        loss_fn, mesh=mesh, params_sharding="fsdp", grad_spec="params",
+        batch_spec=P(None, "dp"),
+    )
+    _, _, fgrads = fstep(params, batch, jax.random.key(0))
+    assert fgrads["w"].sharding.spec == P("dp", None)
+    np.testing.assert_allclose(
+        np.asarray(fgrads["w"]), np.asarray(want["w"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_grad_spec_validation():
+    def loss_fn(params, batch, rng_key):
+        return jnp.float32(0.0), {}
+
+    with pytest.raises(ValueError, match="requires mesh"):
+        parallel.make_train_step(loss_fn, grad_spec="replicated")
+    with pytest.raises(ValueError, match="needs an optimizer"):
+        parallel.make_train_step(loss_fn)
+    mesh = parallel.make_mesh({"dp": 8})
+    with pytest.raises(ValueError, match="unknown grad_spec"):
+        parallel.make_train_step(loss_fn, mesh=mesh, grad_spec="zero")(
+            {"w": jnp.zeros(4)}, {"x": jnp.zeros((1, 8))}, jax.random.key(0)
+        )

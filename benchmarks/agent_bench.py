@@ -200,6 +200,7 @@ def main(argv=None):
             "rollout": mode,
             "value": round(out["sps"], 1),
             "steady_sps": out.get("steady_sps"),
+            "mfu": out.get("mfu"),
             "host_boundary_bytes_per_frame": bpf,
             "act_rtt_floor_ms": None if rtt_ms is None else round(rtt_ms, 2),
             "unit": "env_frames/s",

@@ -321,6 +321,49 @@ def parse_agent_lines(path):
     return keep or None
 
 
+def _agent_row_key(line):
+    """Merge key for an agent_small section row: (metric, rollout, scale).
+    Summary rows (rollout_ab / jax_vs_device) carry no rollout field and
+    key as one comparison row per scale that each fresh A/B run replaces."""
+    try:
+        row = json.loads(line)
+    except json.JSONDecodeError:
+        return line
+    return (row.get("metric"), row.get("rollout"), row.get("scale"))
+
+
+def merge_agent_rows(old_lines, new_lines):
+    """agent_small rows MERGE instead of clobber: a single-rollout re-run
+    (``--rollout device``) must not erase the committed legacy/jax rows.
+    A fresh row replaces the stored row with the same key; derived columns
+    a short smoke re-run didn't produce (``mfu`` is null until the learn
+    section has run long enough) carry forward from the stored row so a
+    quick capture can't blank the devmon MFU record."""
+    old_by_key = {}
+    for l in old_lines or []:
+        old_by_key[_agent_row_key(l)] = l
+    fresh = set()
+    merged_new = []
+    for l in new_lines:
+        k = _agent_row_key(l)
+        fresh.add(k)
+        prev = old_by_key.get(k)
+        if prev is not None:
+            try:
+                row, prow = json.loads(l), json.loads(prev)
+            except json.JSONDecodeError:
+                merged_new.append(l)
+                continue
+            if isinstance(row, dict) and isinstance(prow, dict):
+                if row.get("mfu") is None and prow.get("mfu") is not None:
+                    row["mfu"] = prow["mfu"]
+                    row["mfu_carried"] = True  # not re-measured this capture
+                l = json.dumps(row)
+        merged_new.append(l)
+    kept = [l for l in (old_lines or []) if _agent_row_key(l) not in fresh]
+    return kept + merged_new
+
+
 def parse_serve_qps(path):
     """serve_bench --qps stdout: the baseline closed-loop row plus one
     ``{"metric": "serve_qps", ...}`` line per target (no platform gate —
@@ -381,9 +424,9 @@ def fold_local(log_path, json_path):
     ``agent_small`` for an agent_bench one, ``serve_qps`` for a
     ``serve_bench --qps`` one (detected by content) — has its stdout
     updated; every other section (rpc, envpool, ...) is preserved verbatim.
-    The allreduce_rpc and serve_qps sections merge rows (banner-keyed /
-    row-keyed) instead of clobbering — same row-preservation policy as the
-    BENCH_TPU merges above."""
+    The allreduce_rpc, serve_qps, and agent_small sections merge rows
+    (banner-keyed / row-keyed) instead of clobbering — same
+    row-preservation policy as the BENCH_TPU merges above."""
     if os.path.exists(json_path):
         # A corrupt record must ABORT, not be clobbered (curated history).
         with open(json_path) as f:
@@ -423,6 +466,8 @@ def fold_local(log_path, json_path):
     sec["rc"] = 0
     if section == "serve_qps":
         lines = merge_qps_rows(sec.get("stdout"), lines)
+    elif section == "agent_small":
+        lines = merge_agent_rows(sec.get("stdout"), lines)
     elif section == "allreduce_rpc":
         lines = merge_allreduce_sections(sec.get("stdout"), lines)
     sec["stdout"] = lines

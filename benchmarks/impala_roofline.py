@@ -7,8 +7,11 @@ the chip's compute/bandwidth ratio states which resource bounds the step —
 the profile-backed statement that must accompany the MFU number.  Optionally
 captures a jax profiler trace (--trace_dir) for later inspection.
 
-Peak FLOP/s comes from bench.py's table; HBM bandwidth ~819 GB/s for v5e,
-~1228 GB/s v4, ~2765 GB/s v5p (public spec sheets).
+Peak FLOP/s and HBM bandwidth come from the canonical per-chip tables in
+``moolib_tpu.telemetry.devmon`` (env-overridable via
+``MOOLIB_DEVMON_PEAK_FLOPS`` / ``MOOLIB_DEVMON_PEAK_BW``) — the same numbers
+the always-on ``step_mfu`` gauge is computed against, so this script and
+production telemetry can never disagree about the denominator.
 
     JAX_PLATFORMS='' python benchmarks/impala_roofline.py
 """
@@ -21,15 +24,6 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-_PEAK_BW = [("v6", 1640e9), ("v5p", 2765e9), ("v5 lite", 819e9),
-            ("v5e", 819e9), ("v5", 2765e9), ("v4", 1228e9),
-            ("v3", 900e9), ("v2", 700e9)]
-
-
-def _bw_for(kind: str):
-    k = kind.lower()
-    return next((p for s, p in _PEAK_BW if s in k), None)
 
 
 def analytic_mxu_ceiling(channels=None, obs=None,
@@ -137,17 +131,16 @@ def main():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     import bench  # repo-root bench.py: the exact step the benchmark times
+    from moolib_tpu.telemetry import devmon
 
     device = jax.devices()[0]
     step, params, opt_state, batch = bench.build_step()
     compiled = step.lower(params, opt_state, batch).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0] if cost else {}
-    flops = float(cost.get("flops", 0.0))
-    byts = float(cost.get("bytes accessed", 0.0))
-    pf = bench._peak_for(device.device_kind)
-    pb = _bw_for(device.device_kind)
+    # XLA-counted step cost via the shared devmon path (the FLOPs/bytes
+    # arithmetic that used to live here, hand-duplicated).
+    sc = devmon.step_cost("roofline.step", step, params, opt_state, batch)
+    flops = sc.flops if sc is not None else 0.0
+    byts = sc.bytes_accessed if sc is not None else 0.0
 
     out = {
         "device": device.device_kind,
@@ -158,21 +151,22 @@ def main():
         "arithmetic_intensity_flop_per_byte": round(flops / byts, 1) if byts else None,
     }
     out["geometry_mxu_ceiling"] = ceiling
-    if pf and pb and byts:
-        # Ridge point: AI below peak_flops/peak_bw means HBM-bound.
-        ridge = pf / pb
-        ai = flops / byts
-        out["ridge_flop_per_byte"] = round(ridge, 1)
-        out["min_step_ms_compute"] = round(flops / pf * 1e3, 3)
-        out["min_step_ms_memory"] = round(byts / pb * 1e3, 3)
-        bw_ceiling = round(min(1.0, ai / ridge), 3)
+    rf = devmon.roofline(flops, byts, device.device_kind) if flops and byts else None
+    if rf is not None and rf.get("roofline_mfu_ceiling") is not None:
+        out["ridge_flop_per_byte"] = round(rf["ridge_flop_per_byte"], 1)
+        out["min_step_ms_compute"] = round(rf["min_step_s_compute"] * 1e3, 3)
+        out["min_step_ms_memory"] = round(rf["min_step_s_memory"] * 1e3, 3)
+        out["peak_source"] = rf["peak_source"]
+        bw_ceiling = round(rf["roofline_mfu_ceiling"], 3)
         out["roofline_mfu_ceiling"] = bw_ceiling
         # The binding constraint is whichever ceiling is lower: HBM traffic
         # (classic roofline) or MXU lane occupancy (narrow-channel geometry).
         if ceiling < bw_ceiling:
             out["bound"] = "MXU lane occupancy (channels < 128)"
+        elif rf["bound"] == "memory":
+            out["bound"] = "memory (HBM bandwidth)"
         else:
-            out["bound"] = "memory (HBM bandwidth)" if ai < ridge else "compute (MXU)"
+            out["bound"] = "compute (MXU)"
         out["mfu_ceiling"] = round(min(ceiling, bw_ceiling), 4)
 
     if args.trace_dir:

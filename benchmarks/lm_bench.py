@@ -22,10 +22,6 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from timing import marginal_time  # noqa: E402
 
-# Dense bf16 peak FLOP/s per device kind (same table as bench.py).
-_PEAK = [("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
-         ("v5", 459e12), ("v4", 275e12), ("v3", 61.5e12), ("v2", 22.5e12)]
-
 
 def main():
     import jax
@@ -43,8 +39,15 @@ def main():
             "lm_bench needs an accelerator backend "
             "(MOOLIB_ALLOW_CPU=1 for a labeled plumbing-proof run)"
         )
+    from moolib_tpu.telemetry import devmon
+
     dev = jax.devices()[0]
-    peak = next((p for s, p in _PEAK if s in dev.device_kind.lower()), None)
+    # Canonical per-chip peak from devmon (env-overridable); a "nominal"
+    # source means the kind is unknown (CPU plumbing) — report mfu as null
+    # there rather than against a made-up denominator.
+    peak, peak_src = devmon.peak_flops(dev.device_kind)
+    if peak_src == "nominal":
+        peak = None
     # Model scale is env-tunable; the default (d=1024, L=12, ~220M params)
     # keeps per-layer matmuls at 1024x4096 — big enough to fill the MXU,
     # where the earlier d=512 draft would cap MFU well below the 35% target.
@@ -150,6 +153,12 @@ def main():
                 up, s = opt.update(g, s, p)
                 return optax.apply_updates(p, up), s, loss
 
+            # XLA-counted step cost (lower() only — runs nothing, so the
+            # donated param/opt buffers below are still intact afterwards).
+            sc = devmon.step_cost(
+                f"lm_bench.step.T{T}.B{B}", step, params, opt_state, toks
+            )
+
             # The chain state persists across run() calls: step donates its
             # param/opt buffers, so re-starting a chain from an earlier state
             # would dereference deleted arrays on an accelerator backend.
@@ -191,6 +200,12 @@ def main():
         # would make the JSON line unparseable for strict consumers.
         mfu = flops / sec / peak if peak else None
         mfu_attn = (flops + attn_flops) / sec / peak if peak else None
+        # XLA's own count of the compiled step (includes attention scores,
+        # excludes nothing the compiler sees) — the column the always-on
+        # step_mfu gauge would report, alongside the 6ND convention rows.
+        mfu_xla = (
+            sc.flops / sec / peak if (peak and sc is not None) else None
+        )
         print(f"{T:>6} {B:>3} {str(remat):>5} {sec * 1e3:>9.2f} "
               f"{tokens_s:>10.0f} {'n/a' if mfu is None else round(mfu, 3):>6} "
               f"{'n/a' if mfu_attn is None else round(mfu_attn, 3):>7}")
@@ -200,7 +215,8 @@ def main():
              "step_ms": round(sec * 1e3, 2),
              "tokens_per_s": round(tokens_s, 1),
              "mfu_6nd": None if mfu is None else round(mfu, 4),
-             "mfu_attn": None if mfu_attn is None else round(mfu_attn, 4)}
+             "mfu_attn": None if mfu_attn is None else round(mfu_attn, 4),
+             "mfu_xla": None if mfu_xla is None else round(mfu_xla, 4)}
         )
     print(json.dumps({"lm_train": {
         "platform": dev.platform, "device_kind": dev.device_kind,

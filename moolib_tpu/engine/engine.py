@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
+from ..telemetry import devmon
 from ..models.transformer import TransformerLM
 from ..ops.paged_attention import PagedState
 from ..serving import bucket, bucket_shapes
@@ -181,14 +182,23 @@ class ContinuousBatchingEngine:
             "prefill_tokens": 0, "prefill_pad_tokens": 0, "steps": 0,
         }
 
-        self._step_jit = jax.jit(
-            self._step_impl, donate_argnums=(1, 2, 3, 4, 5, 6)
+        # devmon wrappers: the decode step must stay ONE compile for the
+        # engine's lifetime (tests assert _cache_size, which forwards
+        # through the wrapper); prefill/join legitimately compile per
+        # bucket, and the detector's flight events name any trace beyond
+        # that contract.
+        self._step_jit = devmon.instrument_jit(
+            jax.jit(self._step_impl, donate_argnums=(1, 2, 3, 4, 5, 6)),
+            "engine.step",
         )
         # Prefill/join jits cache by shape: one trace per prompt bucket
         # (and per block-count bucket for join) — never per request.
-        self._prefill_jit = jax.jit(self._prefill_impl)
-        self._join_jit = jax.jit(
-            self._join_impl, donate_argnums=(0, 1, 2, 3, 4, 5)
+        self._prefill_jit = devmon.instrument_jit(
+            jax.jit(self._prefill_impl), "engine.prefill"
+        )
+        self._join_jit = devmon.instrument_jit(
+            jax.jit(self._join_impl, donate_argnums=(0, 1, 2, 3, 4, 5)),
+            "engine.join",
         )
 
     # ------------------------------------------------------------- placement

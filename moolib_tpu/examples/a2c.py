@@ -143,6 +143,9 @@ def train(flags, on_stats=None) -> dict:
             partial(a2c_loss, model=model, discounting=flags.discounting), has_aux=True
         )
     )
+    # Recompile detector (telemetry.devmon): flags shape churn in either jit.
+    act_step = telemetry.devmon.instrument_jit(act_step, "a2c.act_step")
+    grad_fn = telemetry.devmon.instrument_jit(grad_fn, "a2c.grad")
 
     broker: Optional[Broker] = None
     if flags.connect is None:

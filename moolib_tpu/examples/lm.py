@@ -377,11 +377,17 @@ def train(flags, on_stats=None) -> dict:
             out_shardings=(p_sh, None, rep, rep),
         )
         put = lambda x: jax.device_put(x, tok_sharding)
+    jstep = telemetry.devmon.instrument_jit(jstep, "lm.step")
 
     # Compile outside the clock (jit time would dominate tokens_per_s on
     # short runs); the warmup step's outputs are discarded.
     _, _, wl, _ = jstep(params, opt_state, put(tokens0))
     float(wl)
+    # Device performance plane: XLA-counted step cost (flops + bytes) for
+    # the MFU/roofline numbers in the log line and out["mfu"].
+    step_cost = telemetry.devmon.step_cost(
+        "lm.step", jstep, params, opt_state, put(tokens0)
+    )
     start = time.time()
     last_ckpt = start
     loss = acc = None
@@ -397,8 +403,24 @@ def train(flags, on_stats=None) -> dict:
             steps_done = i + 1
             if steps_done % flags.log_interval == 0:
                 loss_v, acc_v = float(loss), float(acc)
+                telemetry.devmon.sample_memory()
+                mfu_info = None
+                step_s = timer.summary().get("train_step")
+                if step_cost is not None and step_s:
+                    mfu_info = telemetry.devmon.publish_step(
+                        "lm.step", step_cost, step_s
+                    )
                 if not flags.quiet:
-                    print(f"step={steps_done} loss={loss_v:.4f} acc={acc_v:.3f}", flush=True)
+                    mfu_s = (
+                        f" mfu={mfu_info['mfu']:.3%} bound={mfu_info['bound']}"
+                        if mfu_info is not None
+                        else ""
+                    )
+                    print(
+                        f"step={steps_done} loss={loss_v:.4f} "
+                        f"acc={acc_v:.3f}{mfu_s}",
+                        flush=True,
+                    )
                 if on_stats is not None:
                     on_stats({"step": steps_done, "loss": loss_v, "acc": acc_v})
             if ckpt is not None and time.time() - last_ckpt > flags.checkpoint_interval:
@@ -421,10 +443,19 @@ def train(flags, on_stats=None) -> dict:
     loss_v = None if loss is None else float(loss)  # force the async chain
     acc_v = None if acc is None else float(acc)
     elapsed = time.time() - start
+    # Final MFU: short runs can end between log ticks; compute from the
+    # train_step EMA so out["mfu"] is populated whenever steps ran.
+    mfu_v = None
+    step_s = timer.summary().get("train_step")
+    if step_cost is not None and step_s:
+        fin = telemetry.devmon.publish_step("lm.step", step_cost, step_s)
+        if fin is not None:
+            mfu_v = fin["mfu"]
     return {
         "steps": steps_done,
         "loss": loss_v,
         "acc": acc_v,
+        "mfu": mfu_v,
         "tokens_per_s": (steps_done - start_step)
         * flags.batch_size * flags.seq_len / max(elapsed, 1e-6),
     }

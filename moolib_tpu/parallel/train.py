@@ -10,6 +10,7 @@ the backward pass.
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -19,6 +20,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
+from ..telemetry import devmon
 from ..utils import init_compile_cache
 from .mesh import replicated
 
@@ -32,9 +34,20 @@ _M_DISPATCH = _REG.histogram(
     "host time in the jitted train step call (dispatch, not device time)",
 )
 
+# Each built step gets its own devmon name: two different train steps in
+# one process (tests, A/B runs) must not read as each other's recompiles.
+_STEP_SEQ = itertools.count()
 
-def _instrument_step(fn):
+
+def _instrument_step(fn, name: Optional[str] = None):
+    if name is None:
+        n = next(_STEP_SEQ)
+        name = "parallel.train_step" + (f"#{n}" if n else "")
+
     def timed_step(*args, **kwargs):
+        # Recompile detector (telemetry.devmon): a shape/dtype signature
+        # change here means XLA is retracing the train step mid-run.
+        devmon.observe_call(name, args, kwargs)
         with _M_DISPATCH.time():
             out = fn(*args, **kwargs)
         _M_STEPS.inc()

@@ -70,6 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import telemetry
+from .telemetry import devmon
 
 _REG = telemetry.get_registry()
 # Host-boundary accounting: every byte the actor path moves between host and
@@ -198,9 +199,11 @@ def _build_jits(model, unroll_length: int):
         # learner's unroll and must outlive this copy.
         return {k: jnp.zeros_like(v).at[0].set(v[unroll_length]) for k, v in buf.items()}
 
+    # Recompile detector (telemetry.devmon): a geometry change slipping
+    # through the cache key would silently recompile per call here.
     return (
-        jax.jit(_step, donate_argnums=(1,)),
-        jax.jit(_carry),
+        devmon.instrument_jit(jax.jit(_step, donate_argnums=(1,)), "rollout.step"),
+        devmon.instrument_jit(jax.jit(_carry), "rollout.carry"),
     )
 
 
@@ -430,10 +433,10 @@ def _build_anakin_jits(model, env, unroll_length: int):
         return _finish(params, carry, (last_row, rows))
 
     return (
-        jax.jit(_step, donate_argnums=(1,)),
-        jax.jit(_carry_buf),
-        jax.jit(_unroll_first),
-        jax.jit(_unroll_next),
+        devmon.instrument_jit(jax.jit(_step, donate_argnums=(1,)), "anakin.step"),
+        devmon.instrument_jit(jax.jit(_carry_buf), "anakin.carry"),
+        devmon.instrument_jit(jax.jit(_unroll_first), "anakin.unroll_first"),
+        devmon.instrument_jit(jax.jit(_unroll_next), "anakin.unroll_next"),
     )
 
 

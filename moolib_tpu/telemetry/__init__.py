@@ -36,6 +36,8 @@ once; everything defaults to off):
 - ``MOOLIB_TELEMETRY_SIGUSR1`` — ``0`` disables the dump-on-signal
   handler (installed by default when ``init_from_env`` runs on the main
   thread).
+- ``MOOLIB_DEVMON_INTERVAL`` / ``MOOLIB_DEVMON_HBM_WARN_FRACTION`` —
+  device performance plane knobs (:mod:`moolib_tpu.telemetry.devmon`).
 
 The metric name reference lives in docs/TELEMETRY.md.
 """
@@ -84,6 +86,7 @@ from .flightrec import (  # noqa: F401
 )
 from .cohort import CohortCounters  # noqa: F401
 from .aggregator import CohortAggregator, install_rpc_handlers  # noqa: F401
+from . import devmon  # noqa: F401
 from . import profiling  # noqa: F401
 from .recovery import (  # noqa: F401
     RECOVERY_BUCKETS,
@@ -114,6 +117,7 @@ __all__ = [
     "child_span",
     "current_context",
     "decode_context",
+    "devmon",
     "dump_diagnostics",
     "encode_context",
     "flight_event",
@@ -176,6 +180,13 @@ def init_from_env() -> dict:
                 _warn(f"jsonl exporter disabled ({e!r})")
         if os.environ.get("MOOLIB_TELEMETRY_SIGUSR1", "1") != "0":
             install_signal_dump(run_dir)
+        try:
+            # Device performance plane: jax.monitoring compile listeners
+            # (only when jax is already in the process) and the optional
+            # periodic HBM sampler (MOOLIB_DEVMON_INTERVAL).
+            devmon.install_from_env()
+        except Exception as e:  # noqa: BLE001 — same degradation contract
+            _warn(f"devmon disabled ({e!r})")
         return {"http_port": _http_port, "run_dir": run_dir}
 
 

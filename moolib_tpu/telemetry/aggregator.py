@@ -52,6 +52,16 @@ _M_SCRAPE_ERRORS = _REG.counter(
 _M_PEERS = _REG.gauge(
     "aggregator_peers", "peers in the last fused snapshot"
 )
+_M_SKEW = _REG.gauge(
+    "cohort_step_skew_ratio",
+    "slowest peer's fused per-step seconds / cohort median (step_skew)",
+)
+_M_PEER_STEP = _REG.gauge(
+    "cohort_peer_step_seconds",
+    "per-peer fused step seconds (train dispatch + psum share-down) from "
+    "the last two scrapes",
+    ("peer",),
+)
 
 _INSTALLED_FLAG = "_moolib_telemetry_handlers"
 
@@ -132,6 +142,12 @@ class CohortAggregator:
         self._roster: Dict[str, str] = {}  # name -> role
         self._fused: Dict[str, Any] = {"time": 0.0, "peers": {}, "errors": {}}
         self._last_steps: Dict[str, tuple] = {}  # peer -> (time, steps)
+        # step_skew() state: peer -> (dispatch_sum, dispatch_count,
+        # psum_sum, psum_count) from the previous call, so per-peer step
+        # time reflects the window BETWEEN skew computations, not lifetime.
+        self._skew_state: Dict[str, tuple] = {}
+        self._straggler_streak: tuple = (None, 0)  # (peer, consecutive flags)
+        self._straggler_announced: Optional[str] = None
 
     # ------------------------------------------------------------ discovery
     def discover(self) -> Dict[str, str]:
@@ -232,11 +248,116 @@ class CohortAggregator:
             s = autoscaler.sample_from_snapshot(name, row)
             if s.steps is not None:
                 prev = self._last_steps.get(name)
-                if prev is not None and s.time > prev[0]:
+                # A counter BELOW the previous reading means the peer
+                # restarted (registry counters never decrease): treat it as
+                # fresh rather than publishing a negative rate the policy
+                # would read as a stall.
+                if prev is not None and s.time > prev[0] and s.steps >= prev[1]:
                     s.step_rate = (s.steps - prev[1]) / (s.time - prev[0])
                 self._last_steps[name] = (s.time, s.steps)
             out.append(s)
+        # Peers that left the cohort must not pin their last reading forever
+        # (a name reused by a respawned peer would inherit a stale delta).
+        for gone in set(self._last_steps) - set(peers):
+            del self._last_steps[gone]
         return out
+
+    # ----------------------------------------------------------- cohort skew
+    @staticmethod
+    def _hist_totals(metrics_snap: Dict[str, Any], name: str) -> tuple:
+        """(sum, count) across every series of one histogram family in a
+        peer's snapshot — the cumulative figures the skew deltas work on."""
+        fam = metrics_snap.get(name) or {}
+        total, count = 0.0, 0.0
+        for s in fam.get("series", ()):
+            v = s.get("value")
+            if isinstance(v, dict):
+                total += float(v.get("sum", 0.0))
+                count += float(v.get("count", 0.0))
+        return total, count
+
+    def step_skew(self, threshold: float = 1.5, sustain: int = 3) -> Dict[str, Any]:
+        """Per-peer straggler attribution from the last fused scrape
+        (devmon's cohort sub-plane, docs/TELEMETRY.md "Device performance
+        plane").
+
+        Fuses each peer's ``train_step_dispatch_seconds`` and
+        ``accum_psum_seconds`` histograms into one per-step wall figure —
+        computed over the window since the previous ``step_skew`` call
+        (cumulative sum/count deltas), so a recovered peer stops looking
+        slow one window later.  Publishes ``cohort_step_skew_ratio``
+        (slowest / cohort median) and ``cohort_peer_step_seconds{peer}``;
+        when the SAME peer stays above ``threshold`` for ``sustain``
+        consecutive calls, one ``devmon.straggler`` flight event names it
+        (re-armed when the peer recovers or the straggler moves).
+
+        Returns ``{"ratio", "peers": {name: {...}}, "straggler",
+        "sustained"}``; ratio 1.0 with no straggler when fewer than two
+        peers report step timings.
+        """
+        with self._lock:
+            peers = dict(self._fused["peers"])
+        cur: Dict[str, tuple] = {}
+        per_peer: Dict[str, Dict[str, float]] = {}
+        for name, row in peers.items():
+            met = row.get("metrics") or {}
+            d_sum, d_cnt = self._hist_totals(met, "train_step_dispatch_seconds")
+            p_sum, p_cnt = self._hist_totals(met, "accum_psum_seconds")
+            cur[name] = (d_sum, d_cnt, p_sum, p_cnt)
+            prev = self._skew_state.get(name)
+            # Window deltas when we have a previous reading and the counters
+            # moved forward (a restart resets them — fall back to lifetime).
+            if prev is not None and d_cnt > prev[1] and d_sum >= prev[0]:
+                dd_sum, dd_cnt = d_sum - prev[0], d_cnt - prev[1]
+                dp_sum = max(0.0, p_sum - prev[2])
+                dp_cnt = max(0.0, p_cnt - prev[3])
+            else:
+                dd_sum, dd_cnt, dp_sum, dp_cnt = d_sum, d_cnt, p_sum, p_cnt
+            if dd_cnt <= 0:
+                continue  # no step timing from this peer (e.g. pure server)
+            dispatch = dd_sum / dd_cnt
+            psum = dp_sum / dp_cnt if dp_cnt > 0 else 0.0
+            per_peer[name] = {
+                "step_seconds": dispatch + psum,
+                "dispatch_seconds": dispatch,
+                "psum_seconds": psum,
+            }
+        self._skew_state = cur  # prune dead peers with the same assignment
+        for name, row in per_peer.items():
+            _M_PEER_STEP.set(row["step_seconds"], peer=name)
+        if len(per_peer) < 2:
+            _M_SKEW.set(1.0)
+            self._straggler_streak = (None, 0)
+            self._straggler_announced = None
+            return {"ratio": 1.0, "peers": per_peer, "straggler": None,
+                    "sustained": False}
+        times = sorted(r["step_seconds"] for r in per_peer.values())
+        median = times[len(times) // 2]
+        slowest = max(per_peer, key=lambda n: per_peer[n]["step_seconds"])
+        ratio = (per_peer[slowest]["step_seconds"] / median) if median > 0 else 1.0
+        _M_SKEW.set(ratio)
+        candidate = slowest if ratio >= threshold else None
+        last_peer, streak = self._straggler_streak
+        streak = streak + 1 if (candidate and candidate == last_peer) else (
+            1 if candidate else 0
+        )
+        self._straggler_streak = (candidate, streak)
+        if candidate != self._straggler_announced:
+            self._straggler_announced = None
+        sustained = bool(candidate) and streak >= sustain
+        if sustained and self._straggler_announced != candidate:
+            self._straggler_announced = candidate
+            from .flightrec import flight_event
+
+            flight_event(
+                "devmon.straggler",
+                peer=candidate,
+                ratio=round(ratio, 2),
+                step_seconds=round(per_peer[candidate]["step_seconds"], 4),
+                median_seconds=round(median, 4),
+            )
+        return {"ratio": ratio, "peers": per_peer, "straggler": candidate,
+                "sustained": sustained}
 
 
 def fused_prometheus_text(peers: Dict[str, Dict[str, Any]]) -> str:

@@ -1,0 +1,651 @@
+"""Device performance plane: compile, HBM, and MFU accounting.
+
+The cohort observability plane can say *that* a step is slow; this module
+says *why*.  Four sub-planes, all publishing through the process metrics
+registry (docs/TELEMETRY.md "Device performance plane"):
+
+- **Compile observability** — :func:`install_compile_listeners` subscribes
+  to ``jax.monitoring`` (backend compile durations, persistent-cache
+  hits/misses) and :func:`instrument_jit` wraps a jitted callable with a
+  recompile detector: every *new* abstract input signature increments
+  ``jit_compiles_total{fn}``, and a signature change after the first compile
+  emits one ``devmon.recompile`` flight event carrying the signature diff
+  plus a stderr WARN — the dynamic counterpart of the static
+  ``recompile-risk`` lint (docs/ANALYSIS.md).
+- **Memory** — :func:`sample_memory` polls ``device.memory_stats()`` into
+  ``hbm_bytes_{in_use,peak,limit}{device}`` gauges with high-watermark
+  tracking and an OOM-margin warning (``MOOLIB_DEVMON_HBM_WARN_FRACTION``).
+  Backends without allocator stats (CPU) fall back to host RSS under
+  ``device="host"`` so the gauges populate everywhere.
+- **Step cost / MFU** — :func:`step_cost` pulls XLA's counted flops and
+  bytes accessed from ``jitted.lower(...).compile().cost_analysis()``
+  (cached per abstract signature) and :func:`publish_step` combines it with
+  a measured step time into ``step_mfu{fn}`` / ``step_bytes_per_flop{fn}``
+  gauges plus a roofline classification (compute- vs memory-bound).  The
+  peak FLOP/s and HBM-bandwidth tables live here — the one home for numbers
+  ``benchmarks/impala_roofline.py`` and the examples used to hand-maintain.
+- **Cohort skew** — lives on
+  :meth:`moolib_tpu.telemetry.aggregator.CohortAggregator.step_skew`, which
+  fuses per-peer step timings scraped over RPC; this module only documents
+  the gauges it publishes.
+
+Everything here is jax-optional at import time: the telemetry package must
+stay importable from env workers that never touch jax, so jax imports are
+deferred into the functions that need them.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import metrics
+from .flightrec import flight_event
+
+__all__ = [
+    "StepCost",
+    "install_compile_listeners",
+    "install_from_env",
+    "instrument_jit",
+    "last_recompile",
+    "observe_call",
+    "peak_bandwidth",
+    "peak_flops",
+    "publish_step",
+    "reset_for_tests",
+    "roofline",
+    "sample_memory",
+    "start",
+    "step_cost",
+    "stop",
+    "summary_text",
+]
+
+_REG = metrics.get_registry()
+_M_COMPILES = _REG.counter(
+    "jit_compiles_total",
+    "distinct abstract input signatures seen per instrumented jit "
+    "(each one is an XLA compile)",
+    ("fn",),
+)
+_M_RECOMPILES = _REG.counter(
+    "jit_recompiles_total",
+    "signature changes after the first compile (each emitted a "
+    "devmon.recompile flight event)",
+    ("fn",),
+)
+_M_COMPILE_SECONDS = _REG.histogram(
+    "jit_compile_seconds",
+    "backend (XLA) compile wall time, from jax.monitoring",
+)
+_M_CACHE_HITS = _REG.counter(
+    "jit_cache_hits_total", "persistent compile-cache hits (jax.monitoring)"
+)
+_M_CACHE_MISSES = _REG.counter(
+    "jit_cache_misses_total", "persistent compile-cache misses (jax.monitoring)"
+)
+_M_HBM_IN_USE = _REG.gauge(
+    "hbm_bytes_in_use", "allocator bytes in use per device (host RSS on CPU)",
+    ("device",),
+)
+_M_HBM_PEAK = _REG.gauge(
+    "hbm_bytes_peak", "allocator peak bytes in use per device", ("device",)
+)
+_M_HBM_LIMIT = _REG.gauge(
+    "hbm_bytes_limit", "allocator byte limit per device (host MemTotal on CPU)",
+    ("device",),
+)
+_M_STEP_MFU = _REG.gauge(
+    "step_mfu",
+    "model FLOPs utilization: XLA-counted flops / step seconds / peak FLOP/s",
+    ("fn",),
+)
+_M_STEP_BPF = _REG.gauge(
+    "step_bytes_per_flop",
+    "XLA-counted bytes accessed per flop for the step (arithmetic intensity^-1)",
+    ("fn",),
+)
+_M_STEP_FLOPS = _REG.gauge(
+    "step_flops", "XLA-counted model flops per step", ("fn",)
+)
+_M_STEP_BYTES = _REG.gauge(
+    "step_bytes_accessed", "XLA-counted bytes accessed per step", ("fn",)
+)
+
+# Peak dense (bf16) FLOP/s and HBM bandwidth per chip, from public spec
+# sheets.  Substring-matched against ``device.device_kind`` — order matters
+# ("v5p" and "v5 lite" before "v5").  These tables are the canonical home;
+# impala_roofline.py and the benchmarks consume them from here.
+_PEAK_FLOPS: List[Tuple[str, float]] = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+_PEAK_BW: List[Tuple[str, float]] = [
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5 lite", 819e9),
+    ("v5e", 819e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+# Unknown device kinds (the CPU backend above all) get a *nominal* peak so
+# step_mfu stays finite and tracks relative regressions; the absolute value
+# is meaningless there and publish_step says so via ``peak_source``.
+NOMINAL_PEAK_FLOPS = 1e12
+NOMINAL_PEAK_BW = 100e9
+
+_lock = threading.RLock()
+# fn name -> {"seen": set, "last": sig, "compiles": int, "recompiles": int,
+#             "last_diff": str|None}
+_JIT_STATE: Dict[str, Dict[str, Any]] = {}
+_COST_CACHE: Dict[Tuple[str, Any], Optional["StepCost"]] = {}
+_WATERMARKS: Dict[str, float] = {}  # device label -> peak bytes_in_use seen
+_HBM_WARNED: Dict[str, bool] = {}  # device label -> currently above threshold
+_LAST_MEMORY: Dict[str, Dict[str, float]] = {}
+_listeners_installed = False
+_thread: Optional[threading.Thread] = None
+_thread_stop = threading.Event()
+
+
+# --------------------------------------------------------------------- compile
+def install_compile_listeners() -> bool:
+    """Subscribe to ``jax.monitoring``: backend compile durations feed
+    ``jit_compile_seconds``; persistent compile-cache hit/miss events feed
+    ``jit_cache_{hits,misses}_total``.  Idempotent; returns False when the
+    listeners were already installed (or jax.monitoring is unavailable)."""
+    global _listeners_installed
+    with _lock:
+        if _listeners_installed:
+            return False
+        try:
+            from jax import monitoring  # deferred: telemetry imports without jax
+        except Exception:  # noqa: BLE001 — no jax, no compile plane
+            return False
+
+        def _on_duration(key: str, dur: float, **kw) -> None:
+            if "backend_compile" in key:
+                _M_COMPILE_SECONDS.observe(dur)
+
+        def _on_event(key: str, **kw) -> None:
+            if key.endswith("cache_hits"):
+                _M_CACHE_HITS.inc()
+            elif key.endswith("cache_misses"):
+                _M_CACHE_MISSES.inc()
+
+        try:
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            monitoring.register_event_listener(_on_event)
+        except Exception:  # noqa: BLE001 — observability must not break startup
+            return False
+        _listeners_installed = True
+        return True
+
+
+def _leaf_sig(x) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{tuple(shape)}/{dtype}"
+    return type(x).__name__
+
+
+def _signature(args, kwargs):
+    """Cheap abstract signature of a call: the treedef plus per-leaf
+    (shape, dtype) strings — exactly what decides whether jax.jit retraces
+    (python-scalar leaves collapse to their type: jit weak-types them, so
+    value changes don't recompile and must not count here)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (str(treedef), tuple(_leaf_sig(l) for l in leaves))
+
+
+def _diff_sigs(old, new) -> str:
+    """Compact human diff between two signatures, for the flight event."""
+    if old[0] != new[0]:
+        return f"tree structure changed: {old[0]} -> {new[0]}"
+    parts = []
+    o, n = old[1], new[1]
+    for i in range(max(len(o), len(n))):
+        ov = o[i] if i < len(o) else "<absent>"
+        nv = n[i] if i < len(n) else "<absent>"
+        if ov != nv:
+            parts.append(f"leaf[{i}]: {ov} -> {nv}")
+    return "; ".join(parts) or "signatures differ"
+
+
+class _InstrumentedJit:
+    """Callable wrapper around a jitted function that tracks abstract input
+    signatures.  Attribute access (``lower``, ``_cache_size``, ...) forwards
+    to the wrapped jit so AOT paths and tests see the real object."""
+
+    __slots__ = ("_fn", "_name")
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self._name = name
+
+    def __call__(self, *args, **kwargs):
+        try:
+            record_signature(self._name, _signature(args, kwargs))
+        except Exception:  # noqa: BLE001 — accounting must never break the step
+            pass
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def instrument_jit(fn, name: str):
+    """Wrap a jitted callable with the recompile detector (idempotent on
+    already-wrapped callables)."""
+    if isinstance(fn, _InstrumentedJit):
+        return fn
+    return _InstrumentedJit(fn, name)
+
+
+def record_signature(name: str, sig) -> bool:
+    """Feed one observed call signature to the detector; returns True when
+    the signature is new (== an XLA compile).  A new signature after the
+    first emits exactly one ``devmon.recompile`` flight event + WARN;
+    returning to an already-seen signature is silent (jit serves it from
+    cache — no compile happened)."""
+    with _lock:
+        st = _JIT_STATE.get(name)
+        if st is None:
+            st = _JIT_STATE[name] = {
+                "seen": set(), "last": None, "compiles": 0,
+                "recompiles": 0, "last_diff": None,
+            }
+        fresh = sig not in st["seen"]
+        if fresh:
+            st["seen"].add(sig)
+            st["compiles"] += 1
+            recompile = st["last"] is not None
+            if recompile:
+                st["recompiles"] += 1
+                st["last_diff"] = _diff_sigs(st["last"], sig)
+        prev_diff = st["last_diff"]
+        st["last"] = sig
+    if fresh:
+        _M_COMPILES.inc(fn=name)
+        if recompile:
+            _M_RECOMPILES.inc(fn=name)
+            flight_event("devmon.recompile", fn=name, diff=prev_diff)
+            sys.stderr.write(
+                f"moolib_tpu.devmon: WARN recompile of {name!r}: {prev_diff}\n"
+            )
+    return fresh
+
+
+def observe_call(name: str, args=(), kwargs=None) -> None:
+    """Record one call's abstract signature for ``name`` without wrapping
+    the callable — the seam for step functions that are closures rather
+    than raw jits (parallel/train.py).  Never raises."""
+    try:
+        record_signature(name, _signature(args, kwargs or {}))
+    except Exception:  # noqa: BLE001 — accounting must never break the step
+        pass
+
+
+def last_recompile(name: str) -> Optional[str]:
+    """The most recent signature diff that triggered a recompile of ``name``
+    (None when the fn never recompiled)."""
+    with _lock:
+        st = _JIT_STATE.get(name)
+        return st["last_diff"] if st else None
+
+
+# ---------------------------------------------------------------------- memory
+def _host_memory() -> Optional[Dict[str, float]]:
+    """RSS + MemTotal fallback for backends without allocator stats."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        rss = rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+    limit = 0.0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    limit = float(line.split()[1]) * 1024.0
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
+    return {"bytes_in_use": float(rss), "bytes_limit": limit}
+
+
+def _warn_fraction() -> float:
+    try:
+        return float(os.environ.get("MOOLIB_DEVMON_HBM_WARN_FRACTION", "0.9"))
+    except ValueError:
+        return 0.9
+
+
+def sample_memory() -> Dict[str, Dict[str, float]]:
+    """One memory sample across ``jax.local_devices()`` into the
+    ``hbm_bytes_*`` gauges, with high-watermark tracking and an OOM-margin
+    warning: crossing ``MOOLIB_DEVMON_HBM_WARN_FRACTION`` of the limit emits
+    a ``devmon.hbm_pressure`` flight event once per excursion (re-armed when
+    usage drops back under).  Devices without ``memory_stats()`` (CPU)
+    collapse into one host-RSS sample under ``device="host"``."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no jax backend, fall through to host
+        devices = []
+    out: Dict[str, Dict[str, float]] = {}
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:  # noqa: BLE001 — per-device stats are best-effort
+            ms = None
+        if not ms:
+            continue
+        label = f"{d.platform}:{d.id}"
+        out[label] = {
+            "bytes_in_use": float(ms.get("bytes_in_use", 0.0)),
+            "bytes_peak": float(
+                ms.get("peak_bytes_in_use", ms.get("bytes_in_use", 0.0))
+            ),
+            "bytes_limit": float(ms.get("bytes_limit", 0.0)),
+        }
+    if not out:
+        host = _host_memory()
+        if host is not None:
+            out["host"] = {
+                "bytes_in_use": host["bytes_in_use"],
+                "bytes_peak": host["bytes_in_use"],
+                "bytes_limit": host["bytes_limit"],
+            }
+    frac = _warn_fraction()
+    for label, row in out.items():
+        with _lock:
+            wm = max(_WATERMARKS.get(label, 0.0), row["bytes_in_use"],
+                     row.get("bytes_peak", 0.0))
+            _WATERMARKS[label] = wm
+            _LAST_MEMORY[label] = dict(row)
+        row["bytes_peak"] = max(row.get("bytes_peak", 0.0), wm)
+        _M_HBM_IN_USE.set(row["bytes_in_use"], device=label)
+        _M_HBM_PEAK.set(row["bytes_peak"], device=label)
+        _M_HBM_LIMIT.set(row["bytes_limit"], device=label)
+        limit = row["bytes_limit"]
+        if limit > 0:
+            over = row["bytes_in_use"] / limit >= frac
+            with _lock:
+                warned = _HBM_WARNED.get(label, False)
+                _HBM_WARNED[label] = over
+            if over and not warned:
+                flight_event(
+                    "devmon.hbm_pressure",
+                    device=label,
+                    in_use=int(row["bytes_in_use"]),
+                    limit=int(limit),
+                    fraction=round(row["bytes_in_use"] / limit, 3),
+                )
+                sys.stderr.write(
+                    f"moolib_tpu.devmon: WARN {label} at "
+                    f"{row['bytes_in_use'] / limit:.0%} of its memory limit\n"
+                )
+    return out
+
+
+# --------------------------------------------------------------- step cost/MFU
+class StepCost:
+    """XLA-counted cost of one step: flops + bytes accessed."""
+
+    __slots__ = ("flops", "bytes_accessed")
+
+    def __init__(self, flops: float, bytes_accessed: float):
+        self.flops = float(flops)
+        self.bytes_accessed = float(bytes_accessed)
+
+    @property
+    def arithmetic_intensity(self) -> Optional[float]:
+        return self.flops / self.bytes_accessed if self.bytes_accessed else None
+
+    def __repr__(self):
+        return f"StepCost(flops={self.flops:.3g}, bytes_accessed={self.bytes_accessed:.3g})"
+
+
+def step_cost(name: str, jitted, *args, **kwargs) -> Optional["StepCost"]:
+    """XLA cost analysis of ``jitted(*args, **kwargs)``, cached per abstract
+    signature (lowering is pure: donated buffers are NOT consumed).  When
+    the step already compiled with these avals the ``.compile()`` here is a
+    jit-cache hit, so calling this after the first real step is cheap.
+    Returns None when the backend offers no usable analysis."""
+    try:
+        sig = (name, _signature(args, kwargs))
+    except Exception:  # noqa: BLE001 — unflattenable args: no analysis
+        return None
+    with _lock:
+        if sig in _COST_CACHE:
+            return _COST_CACHE[sig]
+    cost = None
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+        try:
+            analysis = lowered.compile().cost_analysis()
+        except Exception:  # noqa: BLE001 — fall back to unoptimized analysis
+            analysis = lowered.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        if analysis:
+            flops = float(analysis.get("flops", 0.0))
+            byts = float(analysis.get("bytes accessed", 0.0))
+            if flops > 0:
+                cost = StepCost(flops, byts)
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        cost = None
+    with _lock:
+        _COST_CACHE[sig] = cost
+    if cost is not None:
+        _M_STEP_FLOPS.set(cost.flops, fn=name)
+        _M_STEP_BYTES.set(cost.bytes_accessed, fn=name)
+    return cost
+
+
+def peak_flops(device_kind: Optional[str] = None) -> Tuple[float, str]:
+    """Peak dense FLOP/s for a device kind: ``MOOLIB_DEVMON_PEAK_FLOPS``
+    override > spec table > nominal (unknown kinds — CPU).  Returns
+    ``(flops_per_s, source)`` with source in {"env", "table", "nominal"}."""
+    env = os.environ.get("MOOLIB_DEVMON_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env), "env"
+        except ValueError:
+            pass
+    k = (device_kind or "").lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in k:
+            return peak, "table"
+    return NOMINAL_PEAK_FLOPS, "nominal"
+
+
+def peak_bandwidth(device_kind: Optional[str] = None) -> Tuple[float, str]:
+    """Peak HBM bytes/s for a device kind (same resolution order as
+    :func:`peak_flops`; override knob ``MOOLIB_DEVMON_PEAK_BW``)."""
+    env = os.environ.get("MOOLIB_DEVMON_PEAK_BW")
+    if env:
+        try:
+            return float(env), "env"
+        except ValueError:
+            pass
+    k = (device_kind or "").lower()
+    for sub, bw in _PEAK_BW:
+        if sub in k:
+            return bw, "table"
+    return NOMINAL_PEAK_BW, "nominal"
+
+
+def _device_kind() -> Optional[str]:
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — no backend: nominal peaks apply
+        return None
+
+
+def roofline(
+    flops: float, bytes_accessed: float, device_kind: Optional[str] = None
+) -> Dict[str, Any]:
+    """Roofline classification for a step: arithmetic intensity vs the
+    chip's ridge point (peak_flops / peak_bw).  AI below the ridge means the
+    step is HBM-bound; above, compute-bound."""
+    pf, pf_src = peak_flops(device_kind)
+    pb, pb_src = peak_bandwidth(device_kind)
+    out: Dict[str, Any] = {
+        "peak_flops": pf,
+        "peak_bw": pb,
+        "peak_source": pf_src if pf_src == pb_src else f"{pf_src}/{pb_src}",
+    }
+    if not bytes_accessed or not flops:
+        out["bound"] = None
+        return out
+    ai = flops / bytes_accessed
+    ridge = pf / pb
+    out["arithmetic_intensity_flop_per_byte"] = ai
+    out["ridge_flop_per_byte"] = ridge
+    out["min_step_s_compute"] = flops / pf
+    out["min_step_s_memory"] = bytes_accessed / pb
+    out["roofline_mfu_ceiling"] = min(1.0, ai / ridge)
+    out["bound"] = "memory" if ai < ridge else "compute"
+    return out
+
+
+def publish_step(
+    name: str,
+    cost: Optional["StepCost"],
+    step_seconds: float,
+    device_kind: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """Combine an XLA step cost with a measured step time into the
+    ``step_mfu{fn}`` / ``step_bytes_per_flop{fn}`` gauges plus the roofline
+    verdict.  Returns ``{"mfu", "bytes_per_flop", "bound", ...}`` (None when
+    there is nothing to publish)."""
+    if cost is None or step_seconds <= 0 or cost.flops <= 0:
+        return None
+    if device_kind is None:
+        device_kind = _device_kind()
+    roof = roofline(cost.flops, cost.bytes_accessed, device_kind)
+    mfu = cost.flops / step_seconds / roof["peak_flops"]
+    bpf = cost.bytes_accessed / cost.flops
+    _M_STEP_MFU.set(mfu, fn=name)
+    _M_STEP_BPF.set(bpf, fn=name)
+    return {
+        "mfu": mfu,
+        "bytes_per_flop": bpf,
+        "bound": roof.get("bound"),
+        "peak_source": roof["peak_source"],
+        "roofline": roof,
+    }
+
+
+# ------------------------------------------------------------------- lifecycle
+def start(interval: float) -> bool:
+    """Background memory-sampling thread (daemon; one per process)."""
+    global _thread
+    with _lock:
+        if _thread is not None and _thread.is_alive():
+            return False
+        _thread_stop.clear()
+
+        def _loop():
+            while not _thread_stop.wait(interval):
+                try:
+                    sample_memory()
+                except Exception:  # noqa: BLE001 — sampling must never crash the run
+                    pass
+
+        _thread = threading.Thread(target=_loop, name="devmon-mem", daemon=True)
+        _thread.start()
+        return True
+
+
+def stop() -> None:
+    global _thread
+    with _lock:
+        t, _thread = _thread, None
+    if t is not None:
+        _thread_stop.set()
+        t.join(timeout=1.0)
+
+
+def install_from_env() -> dict:
+    """Wire the device plane per the environment: compile listeners when jax
+    is already in the process (env workers that never import jax skip them),
+    and the periodic memory sampler when ``MOOLIB_DEVMON_INTERVAL`` > 0.
+    Called by :func:`moolib_tpu.telemetry.init_from_env`; idempotent."""
+    listeners = False
+    if "jax" in sys.modules:
+        listeners = install_compile_listeners()
+    interval = 0.0
+    raw = os.environ.get("MOOLIB_DEVMON_INTERVAL")
+    if raw:
+        try:
+            interval = float(raw)
+        except ValueError:
+            interval = 0.0
+    started = start(interval) if interval > 0 else False
+    return {"listeners": listeners, "interval": interval if started else None}
+
+
+def summary_text() -> str:
+    """Devmon section for :func:`~moolib_tpu.telemetry.exporters.dump_diagnostics`:
+    per-device HBM watermarks, compile counts, and the last recompile
+    signature diff per fn.  Formats already-collected dicts only — safe from
+    a signal handler."""
+    with _lock:
+        jits = {k: dict(v) for k, v in _JIT_STATE.items()}
+        marks = dict(_WATERMARKS)
+        mem = {k: dict(v) for k, v in _LAST_MEMORY.items()}
+    lines = ["--- devmon (device performance plane) ---\n"]
+    if marks:
+        for label in sorted(marks):
+            row = mem.get(label, {})
+            lines.append(
+                f"memory {label}: watermark={marks[label] / 1e6:.1f}MB"
+                f" in_use={row.get('bytes_in_use', 0.0) / 1e6:.1f}MB"
+                f" limit={row.get('bytes_limit', 0.0) / 1e6:.1f}MB\n"
+            )
+    else:
+        lines.append("memory: no samples yet\n")
+    if jits:
+        for name in sorted(jits):
+            st = jits[name]
+            lines.append(
+                f"jit {name}: compiles={st['compiles']}"
+                f" recompiles={st['recompiles']}\n"
+            )
+            if st["last_diff"]:
+                lines.append(f"  last recompile: {st['last_diff']}\n")
+    else:
+        lines.append("jit: no instrumented callables yet\n")
+    return "".join(lines)
+
+
+def reset_for_tests() -> None:
+    """Drop detector / cost-cache / watermark state (test isolation only;
+    registered metrics reset separately via the registry)."""
+    stop()
+    with _lock:
+        _JIT_STATE.clear()
+        _COST_CACHE.clear()
+        _WATERMARKS.clear()
+        _HBM_WARNED.clear()
+        _LAST_MEMORY.clear()

@@ -253,6 +253,14 @@ def dump_diagnostics(
         parts.append(_lockgraph.diagnostics_tail())
     except Exception:  # noqa: BLE001 — diagnostics must never throw
         pass
+    # Device performance plane: HBM watermarks, compile counts, and the
+    # last recompile signature diff — the "why is the hardware idle" tail.
+    try:
+        from . import devmon as _devmon
+
+        parts.append(_devmon.summary_text())
+    except Exception:  # noqa: BLE001 — diagnostics must never throw
+        pass
     parts.append("--- end telemetry dump ---\n")
     out.write("".join(parts))
     try:

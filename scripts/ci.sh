@@ -51,6 +51,12 @@ python -m moolib_tpu.analysis || fail=1
 step "telemetry tests"
 python -m pytest tests/test_telemetry.py tests/test_profiling.py -q || fail=1
 
+step "device performance plane tests (recompile detector, HBM gauges, MFU, cohort skew, bench gate)"
+python -m pytest tests/test_devmon.py -q || fail=1
+
+step "bench gate self-check (committed BENCH_LOCAL.json passes its own gate at default tolerances)"
+python scripts/bench_gate.py --smoke || fail=1
+
 step "distributed tracing tests (context propagation, sibling resend spans under frame faults)"
 python -m pytest tests/test_tracing_distributed.py -q || fail=1
 
@@ -90,6 +96,14 @@ python benchmarks/agent_bench.py --scale small --rollout all --check > "$agent_l
 agent_rc=$?
 cat "$agent_log"
 if [ "$agent_rc" = 0 ]; then
+  # Regression gate BEFORE the fold (fold_capture mutates BENCH_LOCAL.json,
+  # so gating after would compare the fresh rows against themselves).  Smoke
+  # numbers on a loaded CI box are noisy: the tolerances here are loosened
+  # to catch collapses, not single-digit drift — the default thresholds
+  # apply when gating curated captures by hand (docs/TELEMETRY.md).
+  python scripts/bench_gate.py --smoke --log "$agent_log" \
+    --throughput-floor 0.5 --latency-ceiling 3.0 \
+    --allow-new-section all || fail=1
   python benchmarks/fold_capture.py --local "$agent_log" || fail=1
 else
   fail=1
@@ -123,6 +137,9 @@ shard_rc0=$?
 wait "$shard_pid"; shard_rc1=$?
 cat "$shard_log0"
 if [ "$shard_rc0" = 0 ] && [ "$shard_rc1" = 0 ]; then
+  python scripts/bench_gate.py --smoke --log "$shard_log0" \
+    --throughput-floor 0.5 --latency-ceiling 3.0 \
+    --allow-new-section all || fail=1
   python benchmarks/fold_capture.py --local "$shard_log0" || fail=1
 else
   echo "sharded 2-process smoke failed (rc0=$shard_rc0 rc1=$shard_rc1)"
@@ -142,6 +159,9 @@ python benchmarks/allreduce_bench.py rpc --sharded --world_size 2 --iters 3 \
 shard_ab_rc=$?
 cat "$shard_ab_log"
 if [ "$shard_ab_rc" = 0 ]; then
+  python scripts/bench_gate.py --smoke --log "$shard_ab_log" \
+    --throughput-floor 0.5 --latency-ceiling 3.0 \
+    --allow-new-section all || fail=1
   python benchmarks/fold_capture.py --local "$shard_ab_log" || fail=1
 else
   fail=1
@@ -213,6 +233,9 @@ python benchmarks/serve_bench.py --qps 100 --seconds 6 --engine \
 ab_rc=$?
 cat "$ab_log"
 if [ "$ab_rc" = 0 ]; then
+  python scripts/bench_gate.py --smoke --log "$ab_log" \
+    --throughput-floor 0.5 --latency-ceiling 3.0 \
+    --allow-new-section all || fail=1
   python benchmarks/fold_capture.py --local "$ab_log" || fail=1
 else
   fail=1

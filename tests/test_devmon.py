@@ -1,0 +1,510 @@
+"""Device performance plane (telemetry.devmon + CohortAggregator.step_skew +
+scripts/bench_gate.py): recompile detection, memory gauges, XLA step cost /
+MFU, cohort straggler attribution, and the bench regression gate."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from moolib_tpu import telemetry
+from moolib_tpu.telemetry import devmon
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(ROOT, "scripts", "bench_gate.py")
+
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+import bench_gate  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _devmon_state():
+    devmon.reset_for_tests()
+    yield
+    devmon.reset_for_tests()
+
+
+def _events(name):
+    return [
+        (n, args)
+        for _, n, args in telemetry.get_flight_recorder().events()
+        if n == name
+    ]
+
+
+def _counter(name):
+    return telemetry.get_registry().counter_values().get(name, 0.0)
+
+
+# --------------------------------------------------------------- recompiles
+def test_recompile_detector_fires_once_on_shape_change():
+    import jax
+    import jax.numpy as jnp
+
+    telemetry.get_flight_recorder().clear()
+    f = devmon.instrument_jit(jax.jit(lambda x: x * 2 + 1), "t.shapechange")
+    a = jnp.ones((4, 4), jnp.float32)
+    b = jnp.ones((8, 4), jnp.float32)
+    f(a)
+    f(a)  # cache hit: no new signature
+    assert _counter('jit_compiles_total{fn="t.shapechange"}') == 1
+    assert not _events("devmon.recompile")
+    f(b)  # recompile: exactly one event carrying the diff
+    evs = _events("devmon.recompile")
+    assert len(evs) == 1
+    assert evs[0][1]["fn"] == "t.shapechange"
+    assert "(4, 4)/float32 -> (8, 4)/float32" in evs[0][1]["diff"]
+    assert devmon.last_recompile("t.shapechange") == evs[0][1]["diff"]
+    f(a)  # returning to a SEEN signature is a jit-cache hit: silent
+    f(b)
+    assert len(_events("devmon.recompile")) == 1
+    assert _counter('jit_compiles_total{fn="t.shapechange"}') == 2
+    assert _counter('jit_recompiles_total{fn="t.shapechange"}') == 1
+
+
+def test_stable_loop_is_silent():
+    import jax
+    import jax.numpy as jnp
+
+    telemetry.get_flight_recorder().clear()
+    f = devmon.instrument_jit(jax.jit(lambda x: x + 1), "t.stable")
+    x = jnp.zeros((3,), jnp.float32)
+    for _ in range(5):
+        x = f(x)
+    assert _counter('jit_compiles_total{fn="t.stable"}') == 1
+    assert not _events("devmon.recompile")
+    assert devmon.last_recompile("t.stable") is None
+
+
+def test_instrument_jit_forwards_attributes_and_is_idempotent():
+    import jax
+
+    f = jax.jit(lambda x: x)
+    g = devmon.instrument_jit(f, "t.fwd")
+    assert devmon.instrument_jit(g, "other") is g
+    # AOT surface must survive the wrap (tests elsewhere rely on it).
+    assert callable(g.lower)
+
+
+def test_observe_call_never_raises():
+    class Unflattenable:
+        __slots__ = ()
+
+    devmon.observe_call("t.closure", (object(),), {"k": Unflattenable()})
+    devmon.observe_call("t.closure", (object(),))
+
+
+# ------------------------------------------------------------------- memory
+def test_memory_gauges_populate_on_any_backend():
+    out = devmon.sample_memory()
+    if not out:
+        pytest.skip("no device memory_stats and no /proc on this platform")
+    snap = telemetry.get_registry().snapshot()
+    labels = {
+        s["labels"]["device"] for s in snap["hbm_bytes_in_use"]["series"]
+    }
+    for label, row in out.items():
+        assert label in labels
+        assert row["bytes_in_use"] > 0
+    # Watermark tracking survives a second (possibly lower) sample.
+    devmon.sample_memory()
+    assert "memory" in devmon.summary_text()
+
+
+def test_hbm_pressure_warns_once_per_excursion(monkeypatch):
+    telemetry.get_flight_recorder().clear()
+    monkeypatch.setenv("MOOLIB_DEVMON_HBM_WARN_FRACTION", "0.000001")
+    out = devmon.sample_memory()
+    if not any(r.get("bytes_limit", 0) > 0 for r in out.values()):
+        pytest.skip("no memory limit reading on this platform")
+    devmon.sample_memory()  # still over: no second event
+    evs = _events("devmon.hbm_pressure")
+    labels = {e[1]["device"] for e in evs}
+    assert len(evs) == len(labels)  # at most one per device
+    monkeypatch.setenv("MOOLIB_DEVMON_HBM_WARN_FRACTION", "2.0")
+    devmon.sample_memory()  # drops back under: re-armed
+    monkeypatch.setenv("MOOLIB_DEVMON_HBM_WARN_FRACTION", "0.000001")
+    devmon.sample_memory()
+    assert len(_events("devmon.hbm_pressure")) >= len(evs) + 1
+
+
+# ------------------------------------------------------------- step cost/MFU
+def test_step_cost_counts_flops_for_lm_like_step():
+    import jax
+    import jax.numpy as jnp
+
+    def step(w, x):
+        return jnp.tanh(x @ w).sum()
+
+    j = jax.jit(step)
+    w = jnp.ones((64, 64), jnp.float32)
+    x = jnp.ones((8, 64), jnp.float32)
+    sc = devmon.step_cost("t.lmstep", j, w, x)
+    if sc is None:
+        pytest.skip("cost analysis unavailable on this backend")
+    # The matmul alone is 2*8*64*64 = 65536 flops.
+    assert sc.flops >= 2 * 8 * 64 * 64
+    assert sc.bytes_accessed > 0
+    # Golden sanity bound: a dense step's bytes/flop sits well inside
+    # (0.001, 100) — orders of magnitude outside means the fields swapped.
+    bpf = sc.bytes_accessed / sc.flops
+    assert 1e-3 < bpf < 100
+    # Cached per signature: same call returns the same object, no re-lower.
+    assert devmon.step_cost("t.lmstep", j, w, x) is sc
+    snap = telemetry.get_registry().snapshot()
+    assert any(
+        s["labels"]["fn"] == "t.lmstep" and s["value"] > 0
+        for s in snap["step_flops"]["series"]
+    )
+
+
+def test_publish_step_finite_mfu_and_roofline():
+    cost = devmon.StepCost(flops=1e9, bytes_accessed=1e8)
+    out = devmon.publish_step("t.pub", cost, step_seconds=0.01,
+                              device_kind="weird-cpu")
+    assert out is not None
+    assert 0 < out["mfu"] < 1  # 1e9/0.01/1e12 = 1e-4 against the nominal peak
+    assert out["peak_source"] == "nominal"
+    assert out["bound"] in ("memory", "compute")
+    snap = telemetry.get_registry().snapshot()
+    vals = {
+        s["labels"]["fn"]: s["value"] for s in snap["step_mfu"]["series"]
+    }
+    assert vals["t.pub"] == pytest.approx(out["mfu"])
+    # Degenerate inputs publish nothing instead of inf/NaN.
+    assert devmon.publish_step("t.pub", cost, 0.0) is None
+    assert devmon.publish_step("t.pub", None, 1.0) is None
+
+
+def test_peak_tables_and_env_override(monkeypatch):
+    assert devmon.peak_flops("TPU v4") == (275e12, "table")
+    assert devmon.peak_flops("TPU v5 lite") == (197e12, "table")
+    assert devmon.peak_flops("TPU v5p") == (459e12, "table")
+    assert devmon.peak_flops("cpu") == (devmon.NOMINAL_PEAK_FLOPS, "nominal")
+    monkeypatch.setenv("MOOLIB_DEVMON_PEAK_FLOPS", "123e9")
+    assert devmon.peak_flops("TPU v4") == (123e9, "env")
+    monkeypatch.setenv("MOOLIB_DEVMON_PEAK_BW", "7e9")
+    assert devmon.peak_bandwidth("TPU v4") == (7e9, "env")
+
+
+def test_roofline_classification():
+    # AI = 10, nominal ridge = 1e12/100e9 = 10 -> exactly at the ridge is
+    # compute; far below is memory-bound.
+    mem = devmon.roofline(1e6, 1e9, "cpu")
+    assert mem["bound"] == "memory"
+    comp = devmon.roofline(1e12, 1e6, "cpu")
+    assert comp["bound"] == "compute"
+    assert comp["roofline_mfu_ceiling"] == 1.0
+    assert devmon.roofline(0.0, 1e6, "cpu")["bound"] is None
+
+
+# -------------------------------------------------------------- cohort skew
+class _FakeRpc:
+    def get_name(self):
+        return "observer"
+
+
+def _hist_fam(total, count):
+    return {
+        "kind": "histogram",
+        "help": "",
+        "buckets": [0.1, 1.0],
+        "series": [
+            {"labels": {}, "value": {"buckets": [1, 1, 0], "sum": total,
+                                     "count": count}}
+        ],
+    }
+
+
+def _peer_row(t, dispatch_sum, count, psum_sum=0.0, psum_count=0.0, steps=None):
+    met = {
+        "train_step_dispatch_seconds": _hist_fam(dispatch_sum, count),
+        "accum_psum_seconds": _hist_fam(psum_sum, psum_count),
+    }
+    if steps is not None:
+        met["train_steps_total"] = {
+            "kind": "counter", "help": "",
+            "series": [{"labels": {}, "value": steps}],
+        }
+    return {"time": t, "pid": 1, "metrics": met}
+
+
+def _agg():
+    return telemetry.CohortAggregator(_FakeRpc(), "broker")
+
+
+def test_step_skew_flags_delayed_peer():
+    telemetry.get_flight_recorder().clear()
+    agg = _agg()
+    fused = {"time": 1.0, "errors": {}, "peers": {
+        "fast-1": _peer_row(1.0, dispatch_sum=10.0, count=100),   # 0.1 s/step
+        "fast-2": _peer_row(1.0, dispatch_sum=11.0, count=100),
+        "slow": _peer_row(1.0, dispatch_sum=40.0, count=100,      # 0.4 + psum
+                          psum_sum=10.0, psum_count=100),
+    }}
+    agg._fused = fused
+    out = agg.step_skew(threshold=1.5, sustain=3)
+    assert out["straggler"] == "slow"
+    assert out["ratio"] > 1.5
+    assert out["peers"]["slow"]["psum_seconds"] == pytest.approx(0.1)
+    assert not out["sustained"]
+    assert not _events("devmon.straggler")
+    agg.step_skew(threshold=1.5, sustain=3)
+    out = agg.step_skew(threshold=1.5, sustain=3)  # third consecutive flag
+    assert out["sustained"]
+    evs = _events("devmon.straggler")
+    assert len(evs) == 1 and evs[0][1]["peer"] == "slow"
+    # Sustained again: announced once per excursion, not per call.
+    agg.step_skew(threshold=1.5, sustain=3)
+    assert len(_events("devmon.straggler")) == 1
+    vals = telemetry.get_registry().snapshot()["cohort_step_skew_ratio"]
+    assert vals["series"][0]["value"] == pytest.approx(out["ratio"])
+
+
+def test_step_skew_single_peer_is_neutral():
+    agg = _agg()
+    agg._fused = {"time": 1.0, "errors": {}, "peers": {
+        "only": _peer_row(1.0, dispatch_sum=10.0, count=10),
+    }}
+    out = agg.step_skew()
+    assert out == {"ratio": 1.0, "peers": {
+        "only": {"step_seconds": 1.0, "dispatch_seconds": 1.0,
+                 "psum_seconds": 0.0}}, "straggler": None, "sustained": False}
+
+
+def test_step_skew_uses_window_deltas():
+    agg = _agg()
+    agg._fused = {"time": 1.0, "errors": {}, "peers": {
+        "a": _peer_row(1.0, dispatch_sum=100.0, count=100),  # slow history
+        "b": _peer_row(1.0, dispatch_sum=10.0, count=100),
+    }}
+    agg.step_skew()
+    # Peer "a" recovered: the WINDOW delta is 10 steps at 0.1 s/step even
+    # though its lifetime mean is still 1.0 s/step.
+    agg._fused = {"time": 2.0, "errors": {}, "peers": {
+        "a": _peer_row(2.0, dispatch_sum=101.0, count=110),
+        "b": _peer_row(2.0, dispatch_sum=11.0, count=110),
+    }}
+    out = agg.step_skew(threshold=1.5)
+    assert out["peers"]["a"]["step_seconds"] == pytest.approx(0.1)
+    assert out["straggler"] is None
+
+
+def test_peer_samples_parity_and_counter_reset():
+    from moolib_tpu import autoscaler
+
+    agg = _agg()
+    row = _peer_row(100.0, dispatch_sum=1.0, count=10, steps=500.0)
+    row["metrics"]["serve_qps"] = {
+        "kind": "gauge", "help": "",
+        "series": [{"labels": {}, "value": 7.5}],
+    }
+    agg._fused = {"time": 100.0, "errors": {}, "peers": {"p1": row}}
+    (s,) = agg.peer_samples()
+    # Parity: the aggregator extracts exactly what sample_from_snapshot does.
+    ref = autoscaler.sample_from_snapshot("p1", row)
+    for f in ("steps", "serve_qps", "queue_depth", "vbatch_fill",
+              "serve_depth", "serve_wait", "slot_occupancy"):
+        assert getattr(s, f) == getattr(ref, f)
+    assert s.step_rate is None  # first scrape: no delta yet
+    # Second scrape: positive rate from the delta.
+    row2 = _peer_row(110.0, dispatch_sum=2.0, count=20, steps=600.0)
+    agg._fused = {"time": 110.0, "errors": {}, "peers": {"p1": row2}}
+    (s2,) = agg.peer_samples()
+    assert s2.step_rate == pytest.approx(10.0)
+    # Counter reset (peer restarted): fresh baseline, NOT a negative rate.
+    row3 = _peer_row(120.0, dispatch_sum=0.1, count=1, steps=50.0)
+    agg._fused = {"time": 120.0, "errors": {}, "peers": {"p1": row3}}
+    (s3,) = agg.peer_samples()
+    assert s3.step_rate is None
+    # ... and the reset reading seeds the next delta.
+    row4 = _peer_row(130.0, dispatch_sum=0.2, count=2, steps=150.0)
+    agg._fused = {"time": 130.0, "errors": {}, "peers": {"p1": row4}}
+    (s4,) = agg.peer_samples()
+    assert s4.step_rate == pytest.approx(10.0)
+
+
+def test_peer_samples_prunes_departed_peers():
+    agg = _agg()
+    agg._fused = {"time": 1.0, "errors": {}, "peers": {
+        "p1": _peer_row(1.0, 1.0, 10, steps=100.0),
+        "p2": _peer_row(1.0, 1.0, 10, steps=100.0),
+    }}
+    agg.peer_samples()
+    assert set(agg._last_steps) == {"p1", "p2"}
+    agg._fused = {"time": 2.0, "errors": {}, "peers": {
+        "p1": _peer_row(2.0, 2.0, 20, steps=200.0),
+    }}
+    agg.peer_samples()
+    # A departed peer's baseline must not outlive it (a respawn reusing the
+    # name would inherit a stale delta).
+    assert set(agg._last_steps) == {"p1"}
+
+
+# --------------------------------------------------------------- bench gate
+def _baseline_capture():
+    return {
+        "agent_small": {"stdout": [
+            json.dumps({"metric": "impala_agent_sps", "rollout": "device",
+                        "scale": "small", "steady_sps": 1000.0}),
+            json.dumps({"metric": "impala_agent_sps", "rollout": "jax",
+                        "scale": "small", "steady_sps": 2000.0}),
+        ]},
+        "serve_qps": {"stdout": [
+            json.dumps({"metric": "serve_qps", "engine": True,
+                        "qps_target": 8, "achieved_qps": 8.0,
+                        "tokens_per_s": 160.0, "p99_ms": 50.0}),
+        ]},
+    }
+
+
+def test_gate_passes_on_identical_capture():
+    base = _baseline_capture()
+    failures, report = bench_gate.gate(base, base)
+    assert not failures
+    assert all(r["ratio"] == pytest.approx(1.0)
+               for r in report if "ratio" in r)
+
+
+def test_gate_fails_on_throughput_regression():
+    base = _baseline_capture()
+    fresh = json.loads(json.dumps(base))
+    row = json.loads(fresh["agent_small"]["stdout"][0])
+    row["steady_sps"] = 800.0  # 20% down: ratio 0.8 < floor 0.85
+    fresh["agent_small"]["stdout"][0] = json.dumps(row)
+    failures, _ = bench_gate.gate(base, fresh)
+    assert len(failures) == 1
+    f = failures[0]
+    assert f["section"] == "agent_small"
+    assert "device" in f["key"]
+    assert f["field"] == "steady_sps"
+    assert "0.80" in f["reason"]
+
+
+def test_gate_fails_on_latency_regression():
+    base = _baseline_capture()
+    fresh = json.loads(json.dumps(base))
+    row = json.loads(fresh["serve_qps"]["stdout"][0])
+    row["p99_ms"] = 75.0  # ratio 1.5 > ceiling 1.3
+    fresh["serve_qps"]["stdout"][0] = json.dumps(row)
+    failures, _ = bench_gate.gate(base, fresh)
+    assert len(failures) == 1
+    assert failures[0]["field"] == "p99_ms"
+    assert "1.50" in failures[0]["reason"]
+
+
+def test_gate_new_section_needs_allow_list():
+    base = _baseline_capture()
+    fresh = json.loads(json.dumps(base))
+    fresh["brand_new"] = {"stdout": ["whatever"]}
+    failures, _ = bench_gate.gate(base, fresh)
+    assert any(f["section"] == "brand_new" for f in failures)
+    failures, report = bench_gate.gate(
+        base, fresh, allow_new_sections=("brand_new",)
+    )
+    assert not failures
+    assert any(r.get("verdict") == "NEW (allowed)" for r in report)
+    failures, _ = bench_gate.gate(base, fresh, allow_new_sections=("all",))
+    assert not failures
+
+
+def test_gate_zero_parsed_rows_is_a_failure():
+    base = _baseline_capture()
+    fresh = json.loads(json.dumps(base))
+    fresh["agent_small"]["stdout"] = ["not json at all"]
+    failures, _ = bench_gate.gate(base, fresh)
+    assert any("zero gateable rows" in f["reason"] for f in failures)
+
+
+def test_gate_cli_smoke_and_regression(tmp_path):
+    base = _baseline_capture()
+    bpath = tmp_path / "base.json"
+    bpath.write_text(json.dumps(base))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, GATE, "--smoke", "--baseline", str(bpath)],
+        capture_output=True, text=True, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "bench_gate: OK" in r.stdout
+    # Degraded capture: non-zero exit, stderr names the failing row.
+    fresh = json.loads(json.dumps(base))
+    row = json.loads(fresh["agent_small"]["stdout"][1])
+    row["steady_sps"] = 100.0
+    fresh["agent_small"]["stdout"][1] = json.dumps(row)
+    cpath = tmp_path / "fresh.json"
+    cpath.write_text(json.dumps(fresh))
+    r = subprocess.run(
+        [sys.executable, GATE, "--baseline", str(bpath),
+         "--capture", str(cpath)],
+        capture_output=True, text=True, env=env,
+    )
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stderr and "jax" in r.stderr
+
+
+def test_gate_cli_malformed_capture(tmp_path):
+    cpath = tmp_path / "weird.json"
+    cpath.write_text(json.dumps({"weird": 1}))
+    r = subprocess.run(
+        [sys.executable, GATE, "--capture", str(cpath)],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert r.returncode == 2
+    assert "malformed" in r.stderr
+
+
+def test_gate_committed_record_passes_itself():
+    # The acceptance contract: the committed BENCH_LOCAL.json gates clean
+    # against itself (every ratio exactly 1.0).
+    baseline = bench_gate.load_capture(
+        os.path.join(ROOT, "BENCH_LOCAL.json")
+    )
+    failures, report = bench_gate.gate(baseline, baseline)
+    assert not failures
+    assert any(r.get("verdict") == "ok" for r in report)
+
+
+# ----------------------------------------------------------- fold integration
+def test_fold_merge_agent_rows_carries_mfu_forward():
+    import fold_capture
+
+    old = [
+        json.dumps({"metric": "impala_agent_sps", "rollout": "device",
+                    "scale": "small", "steady_sps": 1000.0, "mfu": 0.12}),
+        json.dumps({"metric": "impala_agent_sps", "rollout": "legacy",
+                    "scale": "small", "steady_sps": 500.0}),
+    ]
+    new = [
+        json.dumps({"metric": "impala_agent_sps", "rollout": "device",
+                    "scale": "small", "steady_sps": 1100.0, "mfu": None}),
+    ]
+    merged = [json.loads(l) for l in fold_capture.merge_agent_rows(old, new)]
+    by_mode = {r["rollout"]: r for r in merged}
+    # Legacy row untouched (single-mode re-run must not clobber it) ...
+    assert by_mode["legacy"]["steady_sps"] == 500.0
+    # ... fresh throughput wins, and the unmeasured mfu carries forward.
+    assert by_mode["device"]["steady_sps"] == 1100.0
+    assert by_mode["device"]["mfu"] == 0.12
+    assert by_mode["device"]["mfu_carried"] is True
+    # A fresh measured mfu replaces the stored one.
+    new2 = [json.dumps({"metric": "impala_agent_sps", "rollout": "device",
+                        "scale": "small", "steady_sps": 900.0, "mfu": 0.2})]
+    merged2 = [json.loads(l) for l in fold_capture.merge_agent_rows(old, new2)]
+    dev = next(r for r in merged2 if r["rollout"] == "device")
+    assert dev["mfu"] == 0.2 and "mfu_carried" not in dev
+
+
+# ------------------------------------------------------------------ summary
+def test_summary_text_in_dump_diagnostics():
+    import io
+
+    devmon.observe_call("t.dump", ((1, 2),))
+    buf = io.StringIO()
+    telemetry.dump_diagnostics(file=buf)
+    out = buf.getvalue()
+    assert "devmon (device performance plane)" in out
+    assert "t.dump" in out

@@ -70,6 +70,7 @@ MODULES = [
     ("moolib_tpu.telemetry.devmon", "Telemetry: device performance plane"),
     ("moolib_tpu.telemetry.flightrec", "Telemetry: flight recorder"),
     ("moolib_tpu.telemetry.profiling", "Telemetry: on-demand device profiling"),
+    ("moolib_tpu.telemetry.timeline", "Telemetry: fused step timeline / overlap attribution"),
     ("moolib_tpu.telemetry.recovery", "Telemetry: recovery-phase accounting"),
     ("moolib_tpu.utils", "Utilities"),
     ("moolib_tpu.utils.nest", "Utilities: nest"),
@@ -80,6 +81,13 @@ MODULES = [
     ("moolib_tpu.utils.compile_cache", "Utilities: persistent compile cache"),
     ("moolib_tpu.envs.atari", "Envs: Atari preprocessing"),
     ("moolib_tpu.envs.jax_envs", "Envs: pure-JAX on-device family (Anakin)"),
+]
+
+# Operator-facing entry points that live outside the package (scripts/ is
+# not importable).  Loaded by file path; pages land as mt_scripts_<name>.md.
+SCRIPTS = [
+    ("scripts/mtop.py", "Scripts: live cohort console (mtop)"),
+    ("scripts/trace_merge.py", "Scripts: cohort trace merge"),
 ]
 
 
@@ -180,8 +188,39 @@ def render_module(modpath: str, title: str) -> str:
     return "\n".join(lines).rstrip() + "\n"
 
 
+def render_script(relpath: str, title: str) -> str:
+    """A scripts/ entry point: same rendering as a module, loaded by file
+    path (scripts/ is intentionally not a package).  Public surface =
+    module docstring + non-underscore top-level callables."""
+    import importlib.util
+
+    name = "mt_" + relpath.replace("/", "_").removesuffix(".py")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, relpath)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    lines = [f"# {title}", "", f"``{relpath}``", ""]
+    mdoc = _doc(mod)
+    if mdoc:
+        lines += [mdoc, ""]
+    for oname in vars(mod):
+        if oname.startswith("_"):
+            continue
+        obj = getattr(mod, oname)
+        if inspect.ismodule(obj) or getattr(obj, "__module__", name) != name:
+            continue
+        if inspect.isclass(obj):
+            lines += _render_class(oname, obj)
+        elif callable(obj):
+            lines += _render_callable(oname, obj)
+    return "\n".join(lines).rstrip() + "\n"
+
+
 def render_all() -> dict:
     pages = {}
+    entries = []  # (display path, title, fname) in index order
     for modpath, title in MODULES:
         fname = modpath.replace("moolib_tpu", "mt").replace(".", "_") + ".md"
         try:
@@ -189,10 +228,18 @@ def render_all() -> dict:
         except Exception as e:  # noqa: BLE001 — a missing optional dep must
             # not take down the whole reference build
             pages[fname] = f"# {title}\n\n``{modpath}``\n\nimport failed: {e}\n"
+        entries.append((modpath, title, fname))
+    for relpath, title in SCRIPTS:
+        fname = "mt_" + relpath.replace("/", "_").removesuffix(".py") + ".md"
+        try:
+            pages[fname] = render_script(relpath, title)
+        except Exception as e:  # noqa: BLE001
+            pages[fname] = f"# {title}\n\n``{relpath}``\n\nimport failed: {e}\n"
+        entries.append((relpath, title, fname))
     index = ["# API reference", "",
              "Generated from live docstrings by `docs/gen_api.py`;",
              "`--check` in CI fails when these pages drift from the code.", ""]
-    for (modpath, title), fname in zip(MODULES, pages):
+    for modpath, title, fname in entries:
         index.append(f"- [{title}]({fname}) — ``{modpath}``")
     pages["README.md"] = "\n".join(index) + "\n"
     return pages

@@ -1828,7 +1828,11 @@ class Accumulator:
             # executor must not have its queue wait counted against it.
             round_.t0 = time.monotonic()
         try:
-            summed = self._ici_allreduce(arrays, round_)
+            # Marks the collective for any open timeline capture window
+            # (telemetry.timeline): this is host wall time in communication,
+            # classified as exposed unless compute overlaps it.
+            with telemetry.timeline.comm_span("accum.ici_allreduce"):
+                summed = self._ici_allreduce(arrays, round_)
             with self._lock:
                 # Feeds the adaptive progress bound: healthy rounds this
                 # slow must not be proposed for abort.

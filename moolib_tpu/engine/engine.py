@@ -387,9 +387,12 @@ class ContinuousBatchingEngine:
             self._params_dec, self._cache, self._tables, self._lengths,
             self._active, self._tokens, self._remaining,
         )
-        # mtlint: allow-host-sync(the decode loop's one intentional D2H: emitted tokens/done flags must reach the host to answer requests)
-        nxt = np.asarray(self._tokens)
-        done = np.asarray(done)  # mtlint: allow-host-sync(same fetch: part of the decode loop's one D2H)
+        # host_span marks the decode loop's D2H wait as host-blocked for any
+        # open timeline capture window (telemetry.timeline).
+        with telemetry.timeline.host_span("engine.decode_fetch"):
+            # mtlint: allow-host-sync(the decode loop's one intentional D2H: emitted tokens/done flags must reach the host to answer requests)
+            nxt = np.asarray(self._tokens)
+            done = np.asarray(done)  # mtlint: allow-host-sync(same fetch: part of the decode loop's one D2H)
         emissions: Dict[int, int] = {}
         finished: List[int] = []
         for s in np.nonzero(self._active_host)[0]:

@@ -48,7 +48,9 @@ def _instrument_step(fn, name: Optional[str] = None):
         # Recompile detector (telemetry.devmon): a shape/dtype signature
         # change here means XLA is retracing the train step mid-run.
         devmon.observe_call(name, args, kwargs)
-        with _M_DISPATCH.time():
+        # dispatch_span feeds the timeline capture windows (the step
+        # anchors for overlap/exposure attribution); free when none open.
+        with _M_DISPATCH.time(), devmon.dispatch_span(name):
             out = fn(*args, **kwargs)
         _M_STEPS.inc()
         return out

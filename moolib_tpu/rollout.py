@@ -140,8 +140,11 @@ class PendingAction:
     def realize(self) -> np.ndarray:
         if self._host is None:
             t0 = time.monotonic()
-            # mtlint: allow-host-sync(the realize seam IS the intentional D2H, counted on actor_d2h_bytes_total)
-            self._host = np.asarray(self._dev)
+            # host_span marks this D2H wait as host-blocked for any open
+            # timeline capture window (telemetry.timeline).
+            with telemetry.timeline.host_span("rollout.act_fetch"):
+                # mtlint: allow-host-sync(the realize seam IS the intentional D2H, counted on actor_d2h_bytes_total)
+                self._host = np.asarray(self._dev)
             _M_REALIZE.observe(time.monotonic() - t0)
             _M_D2H.inc(self._host.nbytes)
             _M_DEPTH.dec()

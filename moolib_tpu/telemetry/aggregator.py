@@ -62,6 +62,12 @@ _M_PEER_STEP = _REG.gauge(
     "the last two scrapes",
     ("peer",),
 )
+_M_SCRAPE_SECONDS = _REG.histogram(
+    "aggregator_scrape_seconds",
+    "per-peer snapshot pull wall time within a scrape (timeouts land at "
+    "the per-peer cap)",
+    ("peer",),
+)
 
 _INSTALLED_FLAG = "_moolib_telemetry_handlers"
 
@@ -89,11 +95,19 @@ def install_rpc_handlers(
     tr = tracer or tracing.get_tracer()
 
     def _snapshot():
+        from .flightrec import get_flight_recorder
+
         return {
             "time": time.time(),
             "pid": os.getpid(),
             "name": rpc.get_name(),
             "metrics": reg.snapshot(),
+            # Last flight-recorder entries, newest last — the cohort console
+            # (scripts/mtop.py) shows this tail per peer.
+            "flight": [
+                {"time": t, "name": n, "args": a}
+                for t, n, a in get_flight_recorder().events()[-16:]
+            ],
         }
 
     def _trace():
@@ -129,6 +143,7 @@ class CohortAggregator:
         scrape_timeout: float = 2.0,
         include_observers: bool = True,
         include_self: bool = False,
+        peer_timeout: Optional[float] = None,
     ):
         self._rpc = rpc
         self._brokers = [brokers] if isinstance(brokers, str) else list(brokers)
@@ -136,6 +151,22 @@ class CohortAggregator:
             raise ValueError("need at least one broker peer name")
         self._group = group
         self._timeout = float(scrape_timeout)
+        # Per-peer cap within a scrape, so one wedged peer can't consume the
+        # whole shared deadline and stall every later peer's collection (the
+        # mtop refresh tick).  Resolution: constructor arg >
+        # MOOLIB_AGGREGATOR_SCRAPE_TIMEOUT env > the shared scrape timeout.
+        if peer_timeout is None:
+            env = os.environ.get("MOOLIB_AGGREGATOR_SCRAPE_TIMEOUT")
+            if env:
+                try:
+                    peer_timeout = float(env)
+                except ValueError:
+                    peer_timeout = None
+        self._peer_timeout = (
+            float(peer_timeout)
+            if peer_timeout and peer_timeout > 0
+            else self._timeout
+        )
         self._include_observers = include_observers
         self._include_self = include_self
         self._lock = threading.Lock()
@@ -192,13 +223,18 @@ class CohortAggregator:
         peers: Dict[str, Any] = {}
         errors: Dict[str, str] = {}
         for name, fut in futures.items():
+            t0 = time.monotonic()
             try:
-                row = fut.result(max(0.05, deadline - time.monotonic()))
+                row = fut.result(
+                    max(0.05, min(self._peer_timeout, deadline - time.monotonic()))
+                )
             except Exception as e:  # noqa: BLE001 — per-peer failure isolated
                 fut.cancel()
+                _M_SCRAPE_SECONDS.observe(time.monotonic() - t0, peer=name)
                 errors[name] = str(e) or type(e).__name__
                 _M_SCRAPE_ERRORS.inc(peer=name)
                 continue
+            _M_SCRAPE_SECONDS.observe(time.monotonic() - t0, peer=name)
             if isinstance(row, dict) and "metrics" in row:
                 row.setdefault("name", name)
                 row["role"] = roster.get(name, "member")

@@ -47,11 +47,13 @@ from .flightrec import flight_event
 
 __all__ = [
     "StepCost",
+    "dispatch_span",
     "install_compile_listeners",
     "install_from_env",
     "instrument_jit",
     "last_recompile",
     "observe_call",
+    "set_dispatch_hook",
     "peak_bandwidth",
     "peak_flops",
     "publish_step",
@@ -120,6 +122,8 @@ _M_STEP_BYTES = _REG.gauge(
 # ("v5p" and "v5 lite" before "v5").  These tables are the canonical home;
 # impala_roofline.py and the benchmarks consume them from here.
 _PEAK_FLOPS: List[Tuple[str, float]] = [
+    ("v6e", 918e12),
+    ("v6 lite", 918e12),
     ("v6", 918e12),
     ("v5p", 459e12),
     ("v5 lite", 197e12),
@@ -130,6 +134,8 @@ _PEAK_FLOPS: List[Tuple[str, float]] = [
     ("v2", 45e12),
 ]
 _PEAK_BW: List[Tuple[str, float]] = [
+    ("v6e", 1640e9),
+    ("v6 lite", 1640e9),
     ("v6", 1640e9),
     ("v5p", 2765e9),
     ("v5 lite", 819e9),
@@ -225,6 +231,46 @@ def _diff_sigs(old, new) -> str:
     return "; ".join(parts) or "signatures differ"
 
 
+# Optional (fn, t0_ns, t1_ns) listener for instrumented dispatches — the
+# seam telemetry.timeline uses to anchor capture windows onto train steps.
+# Module-global read (no lock) on the call path; None means untimed.
+_dispatch_hook = None
+
+
+def set_dispatch_hook(hook) -> None:
+    """Install (or clear, with None) the dispatch listener.  The hook is
+    called as ``hook(name, t0_ns, t1_ns)`` with perf_counter_ns bounds of
+    each instrumented call; it must be cheap and must not raise."""
+    global _dispatch_hook
+    _dispatch_hook = hook
+
+
+class dispatch_span:
+    """Context manager equivalent of the `_InstrumentedJit` timing for call
+    sites that wrap their own dispatch (parallel/train.py's step closure):
+    feeds the dispatch hook when one is installed, otherwise free."""
+
+    __slots__ = ("_name", "_t0")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._t0 = None
+
+    def __enter__(self):
+        if _dispatch_hook is not None:
+            self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        hook = _dispatch_hook
+        if hook is not None and self._t0 is not None:
+            try:
+                hook(self._name, self._t0, time.perf_counter_ns())
+            except Exception:  # noqa: BLE001 — listener must never break the step
+                pass
+        return False
+
+
 class _InstrumentedJit:
     """Callable wrapper around a jitted function that tracks abstract input
     signatures.  Attribute access (``lower``, ``_cache_size``, ...) forwards
@@ -241,7 +287,17 @@ class _InstrumentedJit:
             record_signature(self._name, _signature(args, kwargs))
         except Exception:  # noqa: BLE001 — accounting must never break the step
             pass
-        return self._fn(*args, **kwargs)
+        hook = _dispatch_hook
+        if hook is None:
+            return self._fn(*args, **kwargs)
+        t0 = time.perf_counter_ns()
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            try:
+                hook(self._name, t0, time.perf_counter_ns())
+            except Exception:  # noqa: BLE001 — listener must never break the step
+                pass
 
     def __getattr__(self, item):
         return getattr(self._fn, item)
@@ -642,7 +698,9 @@ def summary_text() -> str:
 def reset_for_tests() -> None:
     """Drop detector / cost-cache / watermark state (test isolation only;
     registered metrics reset separately via the registry)."""
+    global _dispatch_hook
     stop()
+    _dispatch_hook = None
     with _lock:
         _JIT_STATE.clear()
         _COST_CACHE.clear()

@@ -28,6 +28,7 @@ import time
 from typing import Optional
 
 from . import tracing
+from .flightrec import flight_event
 
 __all__ = [
     "start_device_trace",
@@ -38,9 +39,65 @@ __all__ = [
 ]
 
 _lock = threading.Lock()
-_active: Optional[dict] = None  # {"logdir", "t0_ns", "unix_ns", "timer"}
+_active: Optional[dict] = None  # {"logdir", "t0_ns", "unix_ns", "timer", "guard"}
 
 DEFAULT_WINDOW_S = 3.0
+# Hard ceiling on any window's lifetime.  The stop is normally an RPC from
+# the requester; a requester killed mid-window would otherwise leave the
+# profiler armed forever (collecting, costing memory, blocking every later
+# start with "profile already active").  MOOLIB_PROFILE_MAX_WINDOW_S
+# overrides; <= 0 disables the guard.
+DEFAULT_MAX_WINDOW_S = 120.0
+
+
+def _max_window_s() -> float:
+    try:
+        return float(
+            os.environ.get("MOOLIB_PROFILE_MAX_WINDOW_S", str(DEFAULT_MAX_WINDOW_S))
+        )
+    except ValueError:
+        return DEFAULT_MAX_WINDOW_S
+
+
+def _arm_guard(logdir: str, max_s: float):
+    """Watchdog-fed deadline that force-stops an abandoned window.  Returns
+    the guard object to close on a normal stop; None when disabled.  Uses
+    the repo Watchdog (lazy import — watchdog.py imports telemetry) with a
+    plain daemon Timer as fallback so the ceiling survives either way."""
+
+    def _expire(_section: str, timeout: float) -> None:
+        with _lock:
+            abandoned = _active is not None and _active["logdir"] == logdir
+        if not abandoned:
+            return  # the window was stopped (and maybe another opened) in time
+        flight_event("profile.abandoned", logdir=logdir, max_window_s=timeout)
+        tracing.get_tracer().event(
+            "device_profile.abandoned", logdir=logdir, max_window_s=timeout
+        )
+        stop_device_trace()
+
+    try:
+        from ..watchdog import Watchdog
+
+        wd = Watchdog(
+            timeout=max_s, on_expire=_expire, name="profile-window", dump=False
+        )
+        wd.arm("device_profile", max_s)
+        return wd
+    except Exception:  # noqa: BLE001 — guard must not block the profile itself
+        timer = threading.Timer(max_s, _expire, args=("device_profile", max_s))
+        timer.daemon = True
+        timer.start()
+        return timer
+
+
+def _close_guard(guard) -> None:
+    if guard is None:
+        return
+    try:
+        guard.close()  # Watchdog
+    except AttributeError:
+        guard.cancel()  # Timer fallback
 
 
 def _default_logdir() -> str:
@@ -74,20 +131,33 @@ def start_device_trace(logdir: Optional[str] = None) -> dict:
             "t0_ns": time.perf_counter_ns(),
             "unix_ns": time.time_ns(),
             "timer": None,
+            "guard": None,
         }
         tracing.get_tracer().event("device_profile.start", logdir=logdir)
-        return {
+        anchors = {
             "ok": True,
             "logdir": logdir,
             "unix_time_ns": _active["unix_ns"],
             "perf_counter_ns": _active["t0_ns"],
         }
+    # Outside the lock: the guard's expiry path calls stop_device_trace,
+    # and Watchdog construction must not run under _lock.
+    max_s = _max_window_s()
+    if max_s > 0:
+        guard = _arm_guard(logdir, max_s)
+        with _lock:
+            if _active is not None and _active["logdir"] == logdir:
+                _active["guard"] = guard
+            else:  # stopped already (tiny window) — don't leak the monitor
+                _close_guard(guard)
+    return anchors
 
 
 def stop_device_trace() -> dict:
     """Close the active window; records the ``device_profile`` host span
     covering it."""
     global _active
+    err = None
     with _lock:
         if _active is None:
             return {"ok": False, "error": "no profile active"}
@@ -100,9 +170,14 @@ def stop_device_trace() -> dict:
 
             jax.profiler.stop_trace()
         except ImportError:
-            return {"ok": False, "error": "jax unavailable"}
+            err = {"ok": False, "error": "jax unavailable"}
         except Exception as e:  # noqa: BLE001
-            return {"ok": False, "error": f"stop_trace failed: {e}", "logdir": state["logdir"]}
+            err = {"ok": False, "error": f"stop_trace failed: {e}", "logdir": state["logdir"]}
+    # Outside the lock: closing the guard may join its monitor thread, whose
+    # expiry path takes _lock.
+    _close_guard(state.get("guard"))
+    if err is not None:
+        return err
     dur_ns = time.perf_counter_ns() - state["t0_ns"]
     tracing.get_tracer().record(
         "device_profile",

@@ -150,8 +150,32 @@ def parse_allreduce_rows(lines: List[str]) -> Dict[Tuple, Dict[str, Any]]:
     return out
 
 
+def parse_step_overlap_rows(lines: List[str]) -> Dict[Tuple, Dict[str, Any]]:
+    """step_overlap rows keyed by peer (the way merge_overlap_rows keys
+    them); throughput: steps_per_s, latency: exposed_comm_s_per_step.
+    Exposed-comm-per-step gating as latency catches overlap regressions
+    (more comm left uncovered by compute) even when step rate holds."""
+    out: Dict[Tuple, Dict[str, Any]] = {}
+    for row in _json_rows(lines):
+        if row.get("metric") != "step_overlap":
+            continue
+        key = (row.get("peer"),)
+        thr: Dict[str, float] = {}
+        v = row.get("steps_per_s")
+        if isinstance(v, (int, float)) and v > 0:
+            thr["steps_per_s"] = float(v)
+        lat: Dict[str, float] = {}
+        v = row.get("exposed_comm_s_per_step")
+        if isinstance(v, (int, float)) and v > 0:
+            lat["exposed_comm_s_per_step"] = float(v)
+        if thr or lat:
+            out[key] = {"throughput": thr, "latency": lat}
+    return out
+
+
 SECTION_RULES = {
     "agent_small": parse_agent_rows,
+    "step_overlap": parse_step_overlap_rows,
     "serve_qps": parse_qps_rows,
     "allreduce_rpc": parse_allreduce_rows,
     "allreduce_ici": parse_allreduce_rows,
@@ -186,10 +210,16 @@ def capture_from_logs(paths: List[str]) -> Dict[str, Any]:
     for path in paths:
         if not os.path.exists(path):
             raise GateError(f"log not found: {path}")
-        agent = fold_capture.parse_agent_lines(path)
-        qps = None if agent else fold_capture.parse_serve_qps(path)
-        allr = None if (agent or qps) else fold_capture.parse_allreduce(path)
-        if agent:
+        overlap = fold_capture.parse_step_overlap(path)
+        agent = None if overlap else fold_capture.parse_agent_lines(path)
+        qps = None if (overlap or agent) else fold_capture.parse_serve_qps(path)
+        allr = (
+            None if (overlap or agent or qps)
+            else fold_capture.parse_allreduce(path)
+        )
+        if overlap:
+            section, lines = "step_overlap", overlap
+        elif agent:
             section, lines = "agent_small", agent
         elif qps:
             section, lines = "serve_qps", qps
@@ -197,7 +227,8 @@ def capture_from_logs(paths: List[str]) -> Dict[str, Any]:
             section, lines = "allreduce_rpc", allr
         else:
             raise GateError(
-                f"no agent, serve_qps, or allreduce rows found in {path}"
+                f"no step_overlap, agent, serve_qps, or allreduce rows "
+                f"found in {path}"
             )
         sec = data.setdefault(section, {"stdout": []})
         sec["stdout"] = list(sec["stdout"]) + lines

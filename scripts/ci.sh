@@ -51,6 +51,9 @@ python -m moolib_tpu.analysis || fail=1
 step "telemetry tests"
 python -m pytest tests/test_telemetry.py tests/test_profiling.py -q || fail=1
 
+step "timeline attribution tests (bucket partition, exposed vs overlapped comm, trace loading, scheduler)"
+python -m pytest tests/test_timeline.py tests/test_trace_merge.py -q || fail=1
+
 step "device performance plane tests (recompile detector, HBM gauges, MFU, cohort skew, bench gate)"
 python -m pytest tests/test_devmon.py -q || fail=1
 
@@ -65,6 +68,27 @@ step "trace-merge smoke (multi-process allreduce + serve request -> one merged C
 # timeline must validate as JSON with >= 1 cross-process parent/child span
 # edge per phase (docs/TELEMETRY.md "Distributed tracing").
 python scripts/trace_smoke.py --smoke || fail=1
+
+step "timeline smoke (2-peer loopback cohort: fused host+device windows, overlap attribution, mtop --once; folds step_overlap rows into BENCH_LOCAL.json)"
+# Drives the whole observability tentpole end to end (docs/TELEMETRY.md
+# "Timeline & overlap"): each peer's last window must have its
+# step_time_fraction buckets sum to 1.0 +/- 0.02 per fn, finite exposed
+# comm, and timeline_comm_vs_psum_ratio in [0.5, 2.0]; the driver also
+# renders one headless mtop frame (per-peer MFU/HBM/skew + merged flight
+# ring) against the live cohort.  Fresh step_overlap rows gate against the
+# committed record before folding — same discipline as the agent smoke.
+tl_log="${TMPDIR:-/tmp}/moolib_ci_timeline_smoke.log"
+python scripts/timeline_smoke.py --smoke > "$tl_log" 2>&1
+tl_rc=$?
+cat "$tl_log"
+if [ "$tl_rc" = 0 ]; then
+  python scripts/bench_gate.py --smoke --log "$tl_log" \
+    --throughput-floor 0.5 --latency-ceiling 3.0 \
+    --allow-new-section all || fail=1
+  python benchmarks/fold_capture.py --local "$tl_log" || fail=1
+else
+  fail=1
+fi
 
 step "fault-domain supervision tests (envpool respawn, watchdog, checkpoint integrity)"
 python -m pytest tests/test_envpool_supervision.py tests/test_watchdog.py \

@@ -1,0 +1,399 @@
+#!/usr/bin/env python
+"""Timeline/overlap CI smoke: periodic capture windows on a live 2-peer cohort.
+
+The acceptance drive for the fused host+device step timeline
+(docs/TELEMETRY.md "Timeline & overlap"), end to end with real
+subprocesses:
+
+1. Two peer subprocesses (peer 0 hosts the broker) form an accumulator
+   cohort with ``MOOLIB_TIMELINE_INTERVAL`` windows enabled.  Each peer
+   runs instrumented jitted steps with an in-mesh share-down
+   (``parallel.redistribute`` → ``accum_psum_seconds``) and a cohort
+   ``reduce_gradients`` round per step, then checks its last ingested
+   window: ``step_time_fraction{bucket}`` sums to 1.0 ± 0.02, finite
+   ``exposed_comm_seconds``, and timeline-measured collective seconds
+   within [0.5, 2.0]× of the host ``accum_psum_seconds`` growth.
+2. While the cohort lingers, ``scripts/mtop.py --once`` scrapes it through
+   the broker and must render both peers (MFU / HBM / skew columns) plus
+   the flight-ring tail — the no-curses console path CI can assert on.
+
+Each peer emits one ``{"metric": "step_overlap", ...}`` JSON row; this
+driver reprints them so ``fold_capture.py --local`` folds a
+``step_overlap`` section into BENCH_LOCAL.json and ``bench_gate.py``
+gates steps/s and exposed-comm per step.
+
+Usage::
+
+    python scripts/timeline_smoke.py --smoke    # CI profile (defaults)
+    python scripts/timeline_smoke.py --steps 80 --interval 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+T0 = time.monotonic()
+
+
+def log(msg: str) -> None:
+    print(f"[timeline_smoke +{time.monotonic() - T0:5.1f}s] {msg}", flush=True)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def child_env() -> dict:
+    return dict(
+        os.environ,
+        PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+    )
+
+
+def spawn(args, log_path, script=None):
+    with open(log_path, "w") as f:
+        return subprocess.Popen(
+            [sys.executable, script or os.path.abspath(__file__)] + args,
+            stdout=f, stderr=subprocess.STDOUT, env=child_env(), cwd=ROOT,
+            start_new_session=True,
+        )
+
+
+def dump_tail(path: str, n: int = 4000) -> None:
+    try:
+        with open(path) as f:
+            sys.stderr.write(f"--- tail of {path} ---\n{f.read()[-n:]}\n")
+    except OSError:
+        pass
+
+
+# -------------------------------------------------------------------- worker
+def worker_peer(flags) -> int:
+    """One cohort peer: instrumented step loop with timeline windows on,
+    self-validates the last window, prints its step_overlap row, lingers
+    until the stop file so mtop can scrape a live cohort."""
+    os.environ["MOOLIB_TIMELINE_INTERVAL"] = str(flags.interval)
+    os.environ["MOOLIB_TIMELINE_WINDOW_S"] = str(flags.window_s)
+    os.environ.setdefault("MOOLIB_PROFILE_DIR", os.path.dirname(flags.out))
+
+    import jax
+    import numpy as np
+
+    from moolib_tpu import Accumulator, Broker, parallel, telemetry
+    from moolib_tpu.telemetry import devmon, profiling, timeline
+
+    telemetry.init_from_env()
+    assert timeline.status()["interval"] == flags.interval
+
+    # Warm the profiler before the cohort forms: the first start_trace of
+    # a process pays seconds of one-time plugin init, which would
+    # otherwise push the first timeline windows past this short loop.
+    warm = profiling.start_device_trace(
+        os.path.join(os.path.dirname(flags.out), f"warmup-{flags.index}")
+    )
+    if warm.get("ok"):
+        profiling.stop_device_trace()
+
+    broker = None
+    if flags.index == 0:
+        broker = Broker()
+        broker.set_name("broker")
+        broker.listen(f"127.0.0.1:{flags.port}")
+    acc = Accumulator("tlsmoke", {"w": np.zeros(8, np.float32)})
+    acc.set_name(f"tl-peer-{flags.index}")
+    acc.listen("127.0.0.1:0")
+    acc.connect(f"127.0.0.1:{flags.port}")
+
+    def pump():
+        if broker is not None:
+            broker.update()
+        acc.update()
+        if acc.wants_state():
+            acc.set_state({"v": 0})
+
+    def wait(cond, what, deadline_s=None):
+        deadline = time.monotonic() + (deadline_s or flags.deadline)
+        while time.monotonic() < deadline:
+            pump()
+            if cond():
+                return True
+            time.sleep(0.02)
+        print(f"peer {flags.index}: timeout waiting for {what}", flush=True)
+        return False
+
+    if not wait(
+        lambda: acc.connected() and len(acc._group.members()) == 2,
+        "cohort formation",
+    ):
+        return 3
+
+    # The instrumented step: a jitted matmul (the dispatch anchor every
+    # timeline window keys on) + a blocking share-down (accum_psum_seconds
+    # and the window's comm plane) + one cohort reduce round (real RPC
+    # comm, so the loop is paced like a train loop).
+    dev = jax.devices()[0]
+    sharding = jax.sharding.SingleDeviceSharding(dev)
+    w = jax.device_put(np.ones((192, 192), np.float32), dev)
+    fn = jax.jit(lambda x: (x @ x).sum())
+    step = devmon.instrument_jit(fn, "smoke.train_step")
+    cost = devmon.step_cost("smoke.train_step", fn, w)
+
+    t_loop = time.monotonic()
+    for k in range(flags.steps):
+        t_step = time.monotonic()
+        out = step(w)
+        jax.block_until_ready(out)
+        parallel.redistribute({"w": w}, sharding, block=True)
+        grads = {"w": np.full(8, float(flags.index + 1), np.float32)}
+        acc.reduce_gradients(4, grads)
+        # Cohort churn (an epoch bump) cancels in-flight rounds and hands
+        # the contribution back: wants_gradients() comes true again and the
+        # caller re-contributes (the standard accumulator loop contract).
+        round_deadline = time.monotonic() + 60.0
+        while not acc.has_gradients():
+            if time.monotonic() >= round_deadline:
+                print(f"peer {flags.index}: timeout waiting for round {k}",
+                      flush=True)
+                return 3
+            pump()
+            if acc.wants_gradients():
+                acc.reduce_gradients(4, grads)
+            time.sleep(0.02)
+        acc.zero_gradients()
+        devmon.publish_step(
+            "smoke.train_step", cost, time.monotonic() - t_step
+        )
+        time.sleep(0.01)  # pace the loop so windows span several steps
+    steps_per_s = flags.steps / (time.monotonic() - t_loop)
+    devmon.sample_memory()
+
+    # Windows ingest on a daemon thread; wait for the last one to land.
+    wait(
+        lambda: not timeline.status()["active"]
+        and timeline.status()["windows"] >= 1,
+        "timeline window ingest",
+        deadline_s=30.0,
+    )
+    st = timeline.status()
+    report = st["last_report"]
+    ok = True
+    if not st["windows"] or not report or not report.get("fns"):
+        print(f"peer {flags.index}: no ingested timeline window: {st}", flush=True)
+        ok = False
+    else:
+        fracs = {b: 0.0 for b in timeline.BUCKETS}
+        total_s = 0.0
+        window_steps = 0
+        for fname, row in report["fns"].items():
+            s = sum(row["fractions"].values())
+            if abs(s - 1.0) > 0.02:
+                print(
+                    f"peer {flags.index}: fractions for {fname} sum to {s}",
+                    flush=True,
+                )
+                ok = False
+            for b in timeline.BUCKETS:
+                fracs[b] += row["seconds"][b]
+            total_s += row["total_seconds"]
+            window_steps += row["steps"]
+        fracs = {b: v / max(total_s, 1e-9) for b, v in fracs.items()}
+        exposed = report["exposed_comm_seconds"]
+        ratio = report["comm_vs_psum_ratio"]
+        if not (exposed >= 0.0 and exposed == exposed):  # finite, non-negative
+            print(f"peer {flags.index}: bad exposed_comm {exposed}", flush=True)
+            ok = False
+        if ratio is None or not (0.5 <= ratio <= 2.0):
+            print(
+                f"peer {flags.index}: comm_vs_psum_ratio {ratio} outside "
+                "[0.5, 2.0]",
+                flush=True,
+            )
+            ok = False
+        row = {
+            "metric": "step_overlap",
+            "peer": f"tl-peer-{flags.index}",
+            "steps": flags.steps,
+            "steps_per_s": round(steps_per_s, 3),
+            "windows": st["windows"],
+            "window_steps": window_steps,
+            "frac_compute": round(fracs["compute"], 4),
+            "frac_comm": round(fracs["comm"], 4),
+            "frac_host": round(fracs["host"], 4),
+            "frac_idle": round(fracs["idle"], 4),
+            "exposed_comm_seconds": round(exposed, 6),
+            "exposed_comm_s_per_step": round(exposed / max(window_steps, 1), 6),
+            "overlapped_comm_seconds": round(
+                report["overlapped_comm_seconds"], 6
+            ),
+            "comm_vs_psum_ratio": round(ratio, 3) if ratio is not None else None,
+        }
+        print(json.dumps(row), flush=True)
+
+    # Linger (pumping) so mtop scrapes a LIVE cohort, then drain.
+    stop = flags.out + ".stop"
+    wait(lambda: os.path.exists(stop), "stop file", deadline_s=flags.deadline)
+    acc.close()
+    if broker is not None:
+        broker.close()
+    return 0 if ok else 4
+
+
+# -------------------------------------------------------------------- driver
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI profile (the defaults; flag kept for symmetry)")
+    ap.add_argument("--steps", type=int, default=48)
+    ap.add_argument("--interval", type=int, default=8,
+                    help="MOOLIB_TIMELINE_INTERVAL for the workers")
+    ap.add_argument("--window-s", type=float, default=0.4)
+    ap.add_argument("--deadline", type=float, default=240.0)
+    ap.add_argument("--workdir", default=None)
+    # Worker mode (internal).
+    ap.add_argument("--worker", choices=("peer",), default=None)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--index", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    flags = ap.parse_args(argv)
+
+    if flags.worker == "peer":
+        return worker_peer(flags)
+
+    import tempfile
+
+    workdir = flags.workdir or tempfile.mkdtemp(prefix="timeline_smoke_")
+    port = free_port()
+    log(f"workdir={workdir} steps={flags.steps} interval={flags.interval}")
+    procs, logs, outs = {}, {}, []
+    for i in range(2):
+        out = os.path.join(workdir, f"peer{i}.out")
+        # A stale stop file from a previous run in a reused --workdir would
+        # make the peer skip its linger and strand mtop on a dead cohort.
+        try:
+            os.unlink(out + ".stop")
+        except OSError:
+            pass
+        outs.append(out)
+        logs[f"peer{i}"] = os.path.join(workdir, f"peer{i}.log")
+        procs[f"peer{i}"] = spawn(
+            [
+                "--worker", "peer", "--port", str(port),
+                "--index", str(i), "--steps", str(flags.steps),
+                "--interval", str(flags.interval),
+                "--window-s", str(flags.window_s),
+                "--out", out, "--deadline", str(flags.deadline),
+            ],
+            logs[f"peer{i}"],
+        )
+
+    rows = []
+    try:
+        # Wait until both peers printed their step_overlap row (== the step
+        # loop and timeline validation finished; they now linger pumping).
+        deadline = time.monotonic() + flags.deadline
+        pending = set(logs)
+        while pending and time.monotonic() < deadline:
+            for name in list(pending):
+                p = procs[name]
+                if p.poll() is not None:
+                    dump_tail(logs[name])
+                    raise SystemExit(
+                        f"FAIL: {name} exited rc={p.returncode} before its row"
+                    )
+                try:
+                    text = open(logs[name]).read()
+                except OSError:
+                    continue
+                if '"step_overlap"' in text:
+                    pending.discard(name)
+            time.sleep(0.2)
+        if pending:
+            for name in pending:
+                dump_tail(logs[name])
+            raise SystemExit(f"FAIL: {sorted(pending)} never emitted a row")
+        log("both peers validated their timeline windows; running mtop --once")
+
+        # mtop console smoke against the live, lingering cohort.
+        mtop_log = os.path.join(workdir, "mtop.log")
+        mtop = spawn(
+            [
+                "--broker", f"127.0.0.1:{port}", "--group", "tlsmoke",
+                "--once", "--require-peers", "2", "--timeout", "10",
+            ],
+            mtop_log,
+            script=os.path.join(ROOT, "scripts", "mtop.py"),
+        )
+        rc = mtop.wait(timeout=120)
+        mtop_out = open(mtop_log).read()
+        if rc != 0:
+            dump_tail(mtop_log)
+            raise SystemExit(f"FAIL: mtop --once rc={rc}")
+        for needed in ("tl-peer-0", "tl-peer-1", "MFU%", "HBM", "SKEW"):
+            if needed not in mtop_out:
+                dump_tail(mtop_log)
+                raise SystemExit(f"FAIL: mtop frame is missing {needed!r}")
+        if "flight ring" not in mtop_out:
+            dump_tail(mtop_log)
+            raise SystemExit("FAIL: mtop frame has no flight-ring tail")
+        log("mtop --once rendered both peers + flight ring")
+
+        # Release the cohort and collect the rows.
+        for out in outs:
+            open(out + ".stop", "w").close()
+        deadline = time.monotonic() + 60
+        for name, p in procs.items():
+            rest = max(1.0, deadline - time.monotonic())
+            try:
+                rc = p.wait(timeout=rest)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                dump_tail(logs[name])
+                raise SystemExit(f"FAIL: {name} never exited")
+            if rc != 0:
+                dump_tail(logs[name])
+                raise SystemExit(f"FAIL: {name} exited rc={rc}")
+        for name in sorted(logs):
+            for line in open(logs[name]).read().splitlines():
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict) and row.get("metric") == "step_overlap":
+                    rows.append(row)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    if len(rows) != 2:
+        raise SystemExit(f"FAIL: expected 2 step_overlap rows, got {len(rows)}")
+    # Reprint on THIS process's stdout: the ci.sh log these land in is what
+    # fold_capture --local and bench_gate --log parse.
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    log(
+        "TIMELINE SMOKE OK: "
+        + ", ".join(
+            f"{r['peer']} {r['steps_per_s']}st/s exposed {r['frac_comm']:.0%}"
+            for r in rows
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -191,6 +191,11 @@ def merge(paths: List[str], skew_correct: bool = True) -> Tuple[dict, dict]:
 
     edges = cross_edges(all_events)
     offsets: Dict[int, float] = {}
+    event_pids = {
+        ev.get("pid")
+        for ev in all_events
+        if "ts" in ev and ev.get("pid") is not None
+    }
     if skew_correct and edges:
         root_pid = edges[0][0].get("pid")
         offsets = skew_offsets(edges, root_pid)
@@ -199,6 +204,11 @@ def merge(paths: List[str], skew_correct: bool = True) -> Tuple[dict, dict]:
             if off and "ts" in ev:
                 ev["ts"] -= off
         edges = cross_edges(all_events)  # re-find with corrected timestamps
+    # A pid with no cross-process edge into the root's component gets no
+    # skew estimate — it stays on its metadata.clock_sync anchor rebase
+    # (already applied above) instead of failing the merge.  Counted so the
+    # stats line shows how much of the timeline is anchor-accurate only.
+    anchor_only = sorted(str(p) for p in event_pids if p not in offsets)
 
     # Flow events: one s→f arrow per cross-process edge.
     flow = []
@@ -239,6 +249,8 @@ def merge(paths: List[str], skew_correct: bool = True) -> Tuple[dict, dict]:
         "traces": len(traces),
         "cross_process_edges": len(edges),
         "skew_offsets_us": {str(k): round(v, 1) for k, v in offsets.items()},
+        "anchor_only_pids": len(anchor_only),
+        "anchor_only": anchor_only,
     }
     return {"traceEvents": all_events, "displayTimeUnit": "ms"}, stats
 

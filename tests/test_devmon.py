@@ -182,11 +182,24 @@ def test_peak_tables_and_env_override(monkeypatch):
     assert devmon.peak_flops("TPU v4") == (275e12, "table")
     assert devmon.peak_flops("TPU v5 lite") == (197e12, "table")
     assert devmon.peak_flops("TPU v5p") == (459e12, "table")
+    # Substring order matters: "v5e"/"v5p" must not fall through to the
+    # bare "v5" (pod) row, and the v6 generation resolves across the
+    # spellings device_kind uses ("TPU v6e", "TPU v6 lite").
+    assert devmon.peak_flops("TPU v5e") == (197e12, "table")
+    assert devmon.peak_flops("TPU v6e") == (918e12, "table")
+    assert devmon.peak_flops("TPU v6 lite") == (918e12, "table")
+    assert devmon.peak_bandwidth("TPU v5e") == (819e9, "table")
+    assert devmon.peak_bandwidth("TPU v5p") == (2765e9, "table")
+    assert devmon.peak_bandwidth("TPU v6e") == (1640e9, "table")
     assert devmon.peak_flops("cpu") == (devmon.NOMINAL_PEAK_FLOPS, "nominal")
+    # MOOLIB_DEVMON_PEAK_* wins over every table row; garbage values fall
+    # back to the table instead of raising.
     monkeypatch.setenv("MOOLIB_DEVMON_PEAK_FLOPS", "123e9")
     assert devmon.peak_flops("TPU v4") == (123e9, "env")
     monkeypatch.setenv("MOOLIB_DEVMON_PEAK_BW", "7e9")
     assert devmon.peak_bandwidth("TPU v4") == (7e9, "env")
+    monkeypatch.setenv("MOOLIB_DEVMON_PEAK_FLOPS", "fast")
+    assert devmon.peak_flops("TPU v4") == (275e12, "table")
 
 
 def test_roofline_classification():

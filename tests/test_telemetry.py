@@ -476,9 +476,13 @@ def test_telemetry_rpc_handlers_shape():
     addr = next(x for x in b._listen_addrs if x.startswith("tcp://127"))
     a.connect(addr)
     try:
+        telemetry.flight_event("test.marker", k=1)
         row = a.sync("scrape-b", "__telemetry_snapshot")
         assert row["name"] == "scrape-b" and row["pid"] == os.getpid()
         assert isinstance(row["metrics"], dict)
+        # The flight-recorder tail rides along so the cohort console can
+        # show recent per-peer events without another endpoint.
+        assert "test.marker" in [ev["name"] for ev in row["flight"]]
         trace = a.sync("scrape-b", "__telemetry_trace")
         assert "traceEvents" in trace and "clock_sync" in trace["metadata"]
     finally:
@@ -550,3 +554,86 @@ def test_cohort_aggregator_survives_peer_kill(free_port):
         for acc in accs:
             acc.close()
         broker.close()
+
+
+class _ScrapeFut:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def result(self, timeout):
+        return self._fn(timeout)
+
+    def cancel(self):
+        pass
+
+
+class _ScrapeRpc:
+    """In-process stand-in for Rpc: one broker roster, per-peer snapshot
+    results (a value, or an exception to raise), with the timeout each
+    ``result()`` call received recorded for assertions."""
+
+    def __init__(self, rows):
+        self.rows = rows
+        self.timeouts = {}
+
+    def get_name(self):
+        return "observer"
+
+    def async_(self, peer, method, *args):
+        if method == "__broker_list":
+            return _ScrapeFut(lambda _t: {"members": sorted(self.rows)})
+
+        def _res(timeout):
+            self.timeouts.setdefault(peer, []).append(timeout)
+            v = self.rows[peer]
+            if isinstance(v, Exception):
+                raise v
+            return v
+
+        return _ScrapeFut(_res)
+
+
+def test_aggregator_peer_timeout_resolution(monkeypatch):
+    rpc = _ScrapeRpc({})
+    # Default: the shared scrape timeout doubles as the per-peer cap.
+    agg = telemetry.CohortAggregator(rpc, "broker", scrape_timeout=3.0)
+    assert agg._peer_timeout == 3.0
+    # Env knob caps each peer below the shared deadline...
+    monkeypatch.setenv("MOOLIB_AGGREGATOR_SCRAPE_TIMEOUT", "0.25")
+    agg = telemetry.CohortAggregator(rpc, "broker", scrape_timeout=3.0)
+    assert agg._peer_timeout == 0.25
+    # ...the constructor arg wins over the env, and garbage env is ignored.
+    agg = telemetry.CohortAggregator(
+        rpc, "broker", scrape_timeout=3.0, peer_timeout=0.1
+    )
+    assert agg._peer_timeout == 0.1
+    monkeypatch.setenv("MOOLIB_AGGREGATOR_SCRAPE_TIMEOUT", "soon")
+    agg = telemetry.CohortAggregator(rpc, "broker", scrape_timeout=3.0)
+    assert agg._peer_timeout == 3.0
+
+
+def test_aggregator_scrape_isolates_slow_peer_and_times_pulls():
+    row = {"time": 1.0, "pid": 7, "metrics": {}}
+    rpc = _ScrapeRpc({"good": row, "wedged": TimeoutError("no answer")})
+    agg = telemetry.CohortAggregator(
+        rpc, "broker", scrape_timeout=5.0, peer_timeout=0.2
+    )
+    fused = agg.scrape()
+    assert set(fused["peers"]) == {"good"}
+    assert "wedged" in fused["errors"]
+    # The wedged peer was given at most the per-peer cap, not the whole
+    # shared deadline — one bad peer can't stall the refresh tick.
+    assert all(t <= 0.2 + 1e-6 for t in rpc.timeouts["wedged"])
+    snap = telemetry.get_registry().snapshot()
+    secs = {
+        s["labels"]["peer"]: s["value"]
+        for s in snap["aggregator_scrape_seconds"]["series"]
+    }
+    # Every pull — success or timeout — lands in the per-peer histogram.
+    assert secs["good"]["count"] >= 1
+    assert secs["wedged"]["count"] >= 1
+    errs = {
+        s["labels"]["peer"]: s["value"]
+        for s in snap["aggregator_scrape_errors_total"]["series"]
+    }
+    assert errs.get("wedged", 0) >= 1

@@ -1,0 +1,325 @@
+"""Fused host+device step timeline (moolib_tpu.telemetry.timeline).
+
+Attribution is pure interval math over synthetic records, so these tests
+exercise the real classification/partition paths without a jax.profiler
+capture: fractions must partition each step exactly, exposed vs overlapped
+comm must split on concurrent compute, and the trace loader must survive
+(and correctly skip) the profiler's python-frame slices.  The scheduler
+tests drive on_dispatch directly with device capture off — the device path
+is covered end-to-end by scripts/timeline_smoke.py in CI.
+"""
+
+import gzip
+import json
+import os
+import time
+
+import pytest
+
+from moolib_tpu import telemetry
+from moolib_tpu.telemetry import timeline
+
+MS = 1_000_000  # ns per millisecond
+
+
+@pytest.fixture(autouse=True)
+def _clean_timeline():
+    timeline.reset_for_tests()
+    yield
+    timeline.reset_for_tests()
+
+
+# ------------------------------------------------------------ classification
+def test_classify_name_buckets():
+    assert timeline.classify_name("all-reduce-start.1") == "comm"
+    assert timeline.classify_name("ncclAllReduce") == "comm"
+    assert timeline.classify_name("psum.3") == "comm"
+    assert timeline.classify_name("collective-permute-done") == "comm"
+    assert timeline.classify_name("infeed-dequeue") == "host"
+    assert timeline.classify_name("memcpyD2H") == "host"
+    assert timeline.classify_name("fusion.123") == "compute"
+    assert timeline.classify_name("") == "compute"
+    # Collectives take precedence over host patterns in one name.
+    assert timeline.classify_name("all-reduce-copy") == "comm"
+
+
+# ---------------------------------------------------------- interval algebra
+def test_interval_union_subtract_measure():
+    u = timeline._union([(3.0, 4.0), (1.0, 2.0), (1.5, 2.5), (5.0, 5.0)])
+    assert u == [(1.0, 2.5), (3.0, 4.0)]
+    assert timeline._measure(u) == pytest.approx(2.5)
+    assert timeline._clip(u, 2.0, 3.5) == [(2.0, 2.5), (3.0, 3.5)]
+    # a \ b with b splitting one interval of a in two.
+    rem = timeline._subtract([(0.0, 10.0)], [(2.0, 3.0), (5.0, 6.0)])
+    assert rem == [(0.0, 2.0), (3.0, 5.0), (6.0, 10.0)]
+    assert timeline._subtract([(0.0, 1.0)], [(0.0, 1.0)]) == []
+
+
+# -------------------------------------------------------------- attribution
+def _anchor():
+    # Arbitrary but consistent: unix origin 1e9 s, perf origin 0.
+    return (1_000_000_000_000_000_000, 0)
+
+
+def test_ingest_window_fractions_partition_each_step():
+    # Two steps of 100 ms each; window end closes the last step at 200 ms.
+    steps = [("train", 0, 10 * MS), ("train", 100 * MS, 110 * MS)]
+    report = timeline.ingest_window(
+        steps,
+        comm_spans=[("psum", 20 * MS, 40 * MS)],    # outside dispatch: exposed
+        host_spans=[("fetch", 50 * MS, 60 * MS)],
+        anchor=_anchor(),
+        window_end_ns=200 * MS,
+        publish=False,
+    )
+    assert report["steps"] == 2
+    row = report["fns"]["train"]
+    assert sum(row["fractions"].values()) == pytest.approx(1.0, abs=0.02)
+    # 200 ms total: 20 ms compute (the dispatch intervals), 20 ms exposed
+    # comm, 10 ms host, 150 ms idle.
+    assert row["seconds"]["compute"] == pytest.approx(0.020)
+    assert row["seconds"]["comm"] == pytest.approx(0.020)
+    assert row["seconds"]["host"] == pytest.approx(0.010)
+    assert row["seconds"]["idle"] == pytest.approx(0.150)
+    assert report["exposed_comm_seconds"] == pytest.approx(0.020)
+    assert report["overlapped_comm_seconds"] == pytest.approx(0.0)
+
+
+def test_ingest_window_overlapped_vs_exposed_comm():
+    # One 100 ms step whose dispatch (compute on CPU) covers 0-40 ms; a
+    # 30 ms comm span sits half under it: 20 ms overlapped, 10 ms exposed.
+    steps = [("train", 0, 40 * MS)]
+    report = timeline.ingest_window(
+        steps,
+        comm_spans=[("allreduce", 20 * MS, 50 * MS)],
+        anchor=_anchor(),
+        window_end_ns=100 * MS,
+        publish=False,
+    )
+    assert report["exposed_comm_seconds"] == pytest.approx(0.010)
+    assert report["overlapped_comm_seconds"] == pytest.approx(0.020)
+    row = report["fns"]["train"]
+    # Overlapped comm counts inside compute's share, not comm's.
+    assert row["seconds"]["compute"] == pytest.approx(0.040)
+    assert row["seconds"]["comm"] == pytest.approx(0.010)
+    assert sum(row["fractions"].values()) == pytest.approx(1.0, abs=0.02)
+
+
+def test_ingest_window_device_slices_rebase_and_bubble():
+    # Device slices on a private origin far from the unix axis get rebased
+    # so the first slice lands at the window start; per-track idle share
+    # becomes pipeline_bubble_fraction{stage}.
+    steps = [("step", 0, 1 * MS)]
+    slices = [
+        {"name": "fusion.1", "ts_us": 7_000.0, "dur_us": 40_000.0,
+         "track": "TPU:0", "bucket": "compute"},
+        {"name": "all-reduce.1", "ts_us": 47_000.0, "dur_us": 10_000.0,
+         "track": "TPU:0", "bucket": "comm"},
+    ]
+    report = timeline.ingest_window(
+        steps,
+        slices=slices,
+        anchor=_anchor(),
+        window_end_ns=100 * MS,
+        publish=False,
+    )
+    row = report["fns"]["step"]
+    # 40 ms device compute + 1 ms dispatch (disjoint after rebase: the
+    # first slice is pinned to the window start, the dispatch is inside it).
+    assert row["seconds"]["compute"] == pytest.approx(0.040, abs=0.002)
+    assert report["exposed_comm_seconds"] == pytest.approx(0.010, abs=0.002)
+    assert "TPU:0" in report["bubble"]
+    assert report["bubble"]["TPU:0"] == pytest.approx(0.5, abs=0.02)
+    assert sum(row["fractions"].values()) == pytest.approx(1.0, abs=0.02)
+
+
+def test_ingest_window_psum_ratio_cross_check():
+    steps = [("t", 0, 10 * MS)]
+    report = timeline.ingest_window(
+        steps,
+        comm_spans=[("psum", 20 * MS, 40 * MS)],
+        anchor=_anchor(),
+        window_end_ns=50 * MS,
+        psum_host_seconds=0.020,
+        publish=False,
+    )
+    assert report["comm_vs_psum_ratio"] == pytest.approx(1.0, abs=0.05)
+    # No psum growth -> no ratio (never a divide-by-zero inf).
+    report = timeline.ingest_window(
+        steps, anchor=_anchor(), psum_host_seconds=0.0, publish=False
+    )
+    assert report["comm_vs_psum_ratio"] is None
+
+
+def test_ingest_window_empty_and_publish_path():
+    assert timeline.ingest_window([], publish=False)["steps"] == 0
+    # publish=True lands the gauges + counters in the shared registry.
+    timeline.ingest_window(
+        [("pub", 0, 10 * MS)],
+        comm_spans=[("psum", 20 * MS, 30 * MS)],
+        anchor=_anchor(),
+        window_end_ns=40 * MS,
+    )
+    snap = telemetry.get_registry().snapshot()
+    fr = {
+        (s["labels"]["bucket"], s["labels"]["fn"]): s["value"]
+        for s in snap["step_time_fraction"]["series"]
+    }
+    assert sum(v for (b, fn), v in fr.items() if fn == "pub") == pytest.approx(
+        1.0, abs=0.02
+    )
+    assert snap["timeline_windows_total"]["series"][0]["value"] >= 1
+
+
+# ------------------------------------------------------------- trace loading
+def _write_trace(tmp_path, events, gz=True):
+    d = os.path.join(str(tmp_path), "plugins", "profile", "run1")
+    os.makedirs(d, exist_ok=True)
+    payload = json.dumps({"traceEvents": events}).encode()
+    if gz:
+        path = os.path.join(d, "host.trace.json.gz")
+        with gzip.open(path, "wb") as f:
+            f.write(payload)
+    else:
+        path = os.path.join(d, "host.trace.json")
+        with open(path, "wb") as f:
+            f.write(payload)
+    return str(tmp_path)
+
+
+def test_load_profiler_trace_classifies_and_skips_python_frames(tmp_path):
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "python"}},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.7",
+         "ts": 100.0, "dur": 50.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "all-reduce-start.1",
+         "ts": 160.0, "dur": 20.0},
+        # The profiler's python tracer: host call-stack frames whose names
+        # ("$collectives.py:92 redistribute") would shred the classifier.
+        {"ph": "X", "pid": 1, "tid": 2,
+         "name": "$collectives.py:92 redistribute", "ts": 100.0, "dur": 80.0},
+        {"ph": "X", "pid": 9, "tid": 1, "name": "anything",
+         "ts": 100.0, "dur": 80.0},  # track "python"
+        {"ph": "X", "pid": 1, "tid": 2, "name": "zero-dur", "ts": 1.0,
+         "dur": 0.0},
+        {"ph": "B", "pid": 1, "tid": 2, "name": "not-complete", "ts": 1.0},
+    ]
+    slices = timeline.load_profiler_trace(_write_trace(tmp_path, events))
+    assert [(s["name"], s["bucket"]) for s in slices] == [
+        ("fusion.7", "compute"),
+        ("all-reduce-start.1", "comm"),
+    ]
+    assert slices[0]["track"] == "XLA Ops"
+
+
+def test_load_profiler_trace_plain_json_and_missing(tmp_path):
+    events = [{"ph": "X", "pid": 3, "tid": 1, "name": "copy-start",
+               "ts": 5.0, "dur": 2.0}]
+    slices = timeline.load_profiler_trace(_write_trace(tmp_path, events,
+                                                       gz=False))
+    assert len(slices) == 1 and slices[0]["bucket"] == "host"
+    assert slices[0]["track"] == "3/1"  # no metadata: pid/tid fallback
+    assert timeline.load_profiler_trace(None) == []
+    assert timeline.load_profiler_trace(str(tmp_path / "nowhere")) == []
+
+
+# ------------------------------------------------------- periodic scheduling
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_scheduler_opens_ingests_and_reports(monkeypatch):
+    # Host-only windows (device=False): no profiler in the loop, so this
+    # exercises exactly the scheduler — open on the Nth dispatch, record
+    # steps + phase spans, close past the deadline, ingest off-thread.
+    timeline.configure(interval=2, window_s=0.05, device=False)
+    t = time.perf_counter_ns()
+    step = 20 * MS
+    n = 0
+    deadline = time.monotonic() + 5.0
+    while timeline.status()["windows"] < 1:
+        assert time.monotonic() < deadline, "window never ingested"
+        timeline.on_dispatch("sched", t + n * step, t + n * step + 2 * MS)
+        with timeline.comm_span("fake-psum"):
+            pass
+        n += 1
+        time.sleep(0.01)
+    st = timeline.status()
+    assert st["interval"] == 2 and st["windows"] >= 1
+    rep = st["last_report"]
+    assert rep["steps"] >= 1
+    assert "sched" in rep["fns"]
+    fr = rep["fns"]["sched"]["fractions"]
+    assert sum(fr.values()) == pytest.approx(1.0, abs=0.02)
+
+
+def test_scheduler_never_overlaps_user_profile(monkeypatch):
+    # A user-held profiler slot wins: the periodic window is skipped (None),
+    # not queued behind the user's capture.
+    from moolib_tpu.telemetry import profiling
+
+    timeline.configure(interval=1, window_s=0.05, device=True)
+    monkeypatch.setattr(
+        profiling, "profile_status", lambda: {"active": True,
+                                              "logdir": "/tmp/user"}
+    )
+    assert timeline._open_window(seq=1) is None
+    # start_device_trace losing the slot race reports "already active".
+    monkeypatch.setattr(
+        profiling, "profile_status", lambda: {"active": False}
+    )
+    monkeypatch.setattr(
+        profiling,
+        "start_device_trace",
+        lambda logdir=None: {"ok": False, "error": "profile already active"},
+    )
+    assert timeline._open_window(seq=2) is None
+    # No jax at all degrades to a host-only window, never an exception.
+    monkeypatch.setattr(
+        profiling,
+        "start_device_trace",
+        lambda logdir=None: {"ok": False, "error": "jax unavailable"},
+    )
+    w = timeline._open_window(seq=3)
+    assert w is not None and w["logdir"] is None
+    timeline._discard_window(w)
+
+
+def test_finish_window_skips_empty_windows():
+    # A window that saw no dispatches must release the slot without
+    # clobbering the last real report.
+    timeline._state["last_report"] = {"steps": 3}
+    w = {"id": 99, "logdir": None, "anchor": _anchor(), "steps": [],
+         "comm": [], "host": [], "psum0": 0.0, "timer": None}
+    timeline._finish_window(w)
+    assert timeline.status()["windows"] == 0
+    assert timeline.status()["last_report"] == {"steps": 3}
+
+
+def test_install_from_env_and_reset(monkeypatch):
+    monkeypatch.setenv("MOOLIB_TIMELINE_INTERVAL", "50")
+    monkeypatch.setenv("MOOLIB_TIMELINE_WINDOW_S", "0.5")
+    monkeypatch.setenv("MOOLIB_TIMELINE_DEVICE", "0")
+    cfg = timeline.install_from_env()
+    assert cfg == {"interval": 50, "window_s": 0.5, "device": False}
+    st = timeline.status()
+    assert st["interval"] == 50 and st["window_s"] == 0.5
+    from moolib_tpu.telemetry import devmon
+
+    assert devmon._dispatch_hook is timeline.on_dispatch
+    timeline.reset_for_tests()
+    assert timeline.status()["interval"] == 0
+    assert devmon._dispatch_hook is None
+    # Unset/garbage env means off — and leaves any existing hook alone.
+    monkeypatch.setenv("MOOLIB_TIMELINE_INTERVAL", "banana")
+    assert timeline.install_from_env()["interval"] == 0

@@ -1,0 +1,117 @@
+"""scripts/trace_merge.py: cohort trace stitching on synthetic exports.
+
+Three processes: A calls into B (a cross-process rpc.call -> rpc.recv span
+pair, so skew correction has a probe), while C recorded spans but never an
+RPC edge — it must stay on its metadata.clock_sync anchor rebase and be
+counted in the stats as anchor-only, not fail the merge.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+import trace_merge  # noqa: E402
+
+US = 1000  # ns per µs
+
+
+def _trace_file(tmp_path, name, pid, events, perf_origin_ns=0):
+    path = tmp_path / name / "host_trace.json"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    # unix origin at 2_000_000_000 s for everyone; per-process perf origins
+    # differ, which is exactly what _rebase must cancel out.  Incoming ts
+    # values are unix-relative µs; shift them onto this process's private
+    # perf axis the way a real Tracer export records them.
+    data = {
+        "traceEvents": [
+            dict(ev, pid=pid, tid=1, ph="X",
+                 ts=ev["ts"] + perf_origin_ns / US)
+            for ev in events
+        ],
+        "metadata": {
+            "clock_sync": {
+                "unix_time_ns": 2_000_000_000_000_000_000,
+                "perf_counter_ns": perf_origin_ns,
+            }
+        },
+    }
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def _merged(tmp_path, skew_correct=True, b_extra_us=0.0):
+    a = _trace_file(
+        tmp_path, "proc-a", 100,
+        [{"name": "rpc.call", "ts": 1000.0, "dur": 400.0,
+          "args": {"span_id": "s-call", "trace_id": "t1"}}],
+    )
+    b = _trace_file(
+        tmp_path, "proc-b", 200,
+        [{"name": "rpc.recv", "ts": 1100.0 + b_extra_us, "dur": 200.0,
+          "args": {"span_id": "s-recv", "parent_id": "s-call",
+                   "trace_id": "t1"}}],
+        perf_origin_ns=5_000_000,  # 5 ms later private origin
+    )
+    c = _trace_file(
+        tmp_path, "proc-c", 300,
+        [{"name": "env.step", "ts": 500.0, "dur": 100.0,
+          "args": {"span_id": "s-env", "trace_id": "t2"}}],
+    )
+    return trace_merge.merge([a, b, c], skew_correct=skew_correct)
+
+
+def test_merge_links_edges_and_counts_anchor_only_pids(tmp_path):
+    merged, stats = _merged(tmp_path)
+    assert stats["files"] == 3
+    assert stats["cross_process_edges"] == 1
+    # C never exchanged an RPC with the root's component: no skew estimate,
+    # anchor rebase only — reported, not dropped.
+    assert stats["anchor_only"] == ["300"]
+    assert stats["anchor_only_pids"] == 1
+    assert "300" not in stats["skew_offsets_us"]
+    assert set(stats["skew_offsets_us"]) == {"100", "200"}
+    # C's events survived the merge, rebased onto the unix axis.
+    c_spans = [e for e in merged["traceEvents"]
+               if e.get("pid") == 300 and e.get("ph") == "X"]
+    assert len(c_spans) == 1
+    assert c_spans[0]["ts"] == pytest.approx(
+        2_000_000_000_000_000.0 + 500.0
+    )
+    # The edge became a Chrome flow arrow (s on the caller, f on the callee).
+    phases = {e["ph"] for e in merged["traceEvents"]}
+    assert {"s", "f"} <= phases
+
+
+def test_merge_skew_correction_cancels_residual_offset(tmp_path):
+    # B's recv midpoint sits 300 µs late relative to A's call midpoint
+    # (0.3 ms residual clock error after anchor rebase); the NTP-style pass
+    # measures and removes it.
+    merged, stats = _merged(tmp_path, b_extra_us=300.0)
+    assert stats["skew_offsets_us"]["200"] == pytest.approx(300.0, abs=1.0)
+    recv = next(e for e in merged["traceEvents"]
+                if e.get("name") == "rpc.recv")
+    call = next(e for e in merged["traceEvents"]
+                if e.get("name") == "rpc.call")
+    mid = lambda e: e["ts"] + e["dur"] / 2.0  # noqa: E731
+    assert mid(recv) == pytest.approx(mid(call), abs=1.0)
+    # With correction disabled every pid is anchor-only by construction.
+    _merged2, stats2 = _merged(tmp_path, skew_correct=False, b_extra_us=300.0)
+    assert stats2["skew_offsets_us"] == {}
+    assert stats2["anchor_only_pids"] == 3
+
+
+def test_merge_cli_require_edges_gate(tmp_path):
+    c = _trace_file(
+        tmp_path, "proc-solo", 300,
+        [{"name": "env.step", "ts": 500.0, "dur": 100.0,
+          "args": {"span_id": "s-env", "trace_id": "t2"}}],
+    )
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main([c, "--out", out]) == 0
+    assert os.path.exists(out)
+    # The CI smoke gate: demand an edge a solo trace cannot have.
+    assert trace_merge.main([c, "--out", out, "--require-edges", "1"]) == 1

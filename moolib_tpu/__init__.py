@@ -36,9 +36,11 @@ __all__ = [
     "EnvPool",
     "EnvRunner",
     "EnvStepper",
+    "DistributedCheckpointer",
     "EnvStepperFuture",
     "Future",
     "GradientShardingError",
+    "MissingShardError",
     "Group",
     "Queue",
     "RestartPolicy",
@@ -67,6 +69,8 @@ _LAZY = {
     "AllReduce": "group",
     "Accumulator": "accumulator",
     "GradientShardingError": "accumulator",
+    "DistributedCheckpointer": "checkpoint",
+    "MissingShardError": "checkpoint",
     "Batcher": "batcher",
     "EnvPool": "envpool",
     "EnvRunner": "envpool",

@@ -29,7 +29,9 @@ HOT_PATHS = (
 )
 
 #: the threaded planes where lock ordering is load-bearing (PR 8 epoch
-#: push, PR 9/10 serving + broker HA, PR 12 engine service loop).
+#: push, PR 9/10 serving + broker HA, PR 12 engine service loop, PR 17
+#: distributed checkpoint coordination — shard I/O must never run under
+#: accumulator state the RPC handlers need).
 LOCKED_PATHS = (
     "moolib_tpu/group.py",
     "moolib_tpu/serving.py",
@@ -37,6 +39,7 @@ LOCKED_PATHS = (
     "moolib_tpu/rpc/core.py",
     "moolib_tpu/engine/",
     "moolib_tpu/rollout.py",
+    "moolib_tpu/checkpoint.py",
 )
 
 #: env/rollout code bound by the counter-based seeding contract (PR 7).

@@ -19,21 +19,36 @@ and provides the cohort-sync hooks (``Accumulator.set_state/state``,
 - The cohort-sync side stays on the Accumulator exactly like the reference:
   restore → ``accumulator.set_model_version(step)`` so leader election
   prefers the restored peer.
+- :class:`DistributedCheckpointer` — the pod-scale plane on top of the
+  same integrity machinery: every cohort member writes its own byte-range
+  shard(s) of the deterministic full-state blob plus a per-host manifest,
+  and the leader commits a cohort manifest via TWO-PHASE commit
+  (``cohort_manifest.json.pending`` → atomic rename), so a torn
+  checkpoint — host killed mid-shard-write, leader killed between the
+  phases — is never eligible for restore.  Capture is asynchronous and
+  double-buffered (``copy_to_host_async`` + a background writer thread;
+  ``checkpoint_stall_seconds`` / ``checkpoint_write_seconds`` prove the
+  train step is not blocked), and restore is elastic: an N-host checkpoint
+  assembles bit-exact on an M-host cohort, re-cutting shard slices with
+  ``buckets.shard_ranges`` (docs/RESILIENCE.md "Distributed checkpoints").
 """
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
 import pickle
+import queue as queue_mod
 import shutil
+import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
-from . import telemetry, utils
+from . import buckets, telemetry, utils
 
 try:
     import orbax.checkpoint as ocp
@@ -48,8 +63,37 @@ _M_CORRUPT_SKIPPED = _REG.counter(
     "checkpoint_corrupt_skipped",
     "corrupt/partial checkpoints skipped by restore() fallback",
 )
+_M_STALL = _REG.histogram(
+    "checkpoint_stall_seconds",
+    "train-thread blocked seconds per async capture handoff (D2H issue + staging)",
+)
+_M_WRITE = _REG.histogram(
+    "checkpoint_write_seconds",
+    "background seconds per shard capture (device fetch + pickle + file write)",
+)
+_M_SHARD_BYTES = _REG.counter(
+    "checkpoint_shard_bytes_total", "checkpoint shard payload bytes written"
+)
+_M_COMMITS = _REG.counter(
+    "checkpoint_commits_total",
+    "cohort manifests committed (two-phase commit completed)",
+)
+_M_DECLINED = _REG.counter(
+    "checkpoint_captures_declined_total",
+    "async captures declined because both staging slots were busy",
+)
+_M_RECONSTRUCTED = _REG.counter(
+    "checkpoint_shard_reconstructions_total",
+    "shard byte ranges rebuilt from a replica copy during restore",
+)
 
 _MANIFEST = "manifest.json"
+_COHORT_MANIFEST = "cohort_manifest.json"
+_PENDING = _COHORT_MANIFEST + ".pending"
+# Chaos knob (scripts/chaos_soak.py): seconds to hold each shard's tmp file
+# before its atomic rename, widening the mid-shard-write window the soak's
+# SIGKILL targets.  Never set outside fault-injection harnesses.
+_WRITE_DELAY_ENV = "MOOLIB_CKPT_WRITE_DELAY"
 
 
 def _sha256(path: str) -> str:
@@ -58,6 +102,28 @@ def _sha256(path: str) -> str:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+def canonical_tree(tree: Any) -> Any:
+    """Rebuild ``tree`` with plain-dict keys in sorted order, recursively.
+
+    Replicated state must pickle to identical bytes on every host, but dict
+    *insertion* order is a host-local artifact: a tree rebuilt from a jax
+    flatten/unflatten round-trip iterates keys sorted, while one that arrived
+    through a pickle-based model sync keeps its original order.  Same values,
+    different bytes, different digest.  Sorting matches jax's own dict-key
+    flatten convention, so restored trees are semantically unchanged.
+    """
+    if type(tree) is dict:
+        return {k: canonical_tree(tree[k]) for k in sorted(tree)}
+    if isinstance(tree, tuple):
+        vals = [canonical_tree(v) for v in tree]
+        if hasattr(tree, "_fields"):  # NamedTuple
+            return type(tree)(*vals)
+        return tuple(vals)
+    if type(tree) is list:
+        return [canonical_tree(v) for v in tree]
+    return tree
 
 
 class Checkpointer:
@@ -149,6 +215,9 @@ class Checkpointer:
                 utils.log_error(
                     "checkpoint: skipping corrupt %s (%s); falling back", path, reason
                 )
+                telemetry.flight_event(
+                    "checkpoint.corrupt_skipped", path=path, reason=reason
+                )
                 continue
             try:
                 return self._load(path, target)
@@ -156,6 +225,9 @@ class Checkpointer:
                 _M_CORRUPT_SKIPPED.inc()
                 utils.log_error(
                     "checkpoint: skipping unreadable %s (%r); falling back", path, e
+                )
+                telemetry.flight_event(
+                    "checkpoint.corrupt_skipped", path=path, reason=repr(e)
                 )
         return None
 
@@ -188,12 +260,18 @@ class Checkpointer:
         for rel, meta in files.items():
             full = os.path.join(path, rel)
             if not os.path.exists(full):
-                return f"missing file {rel}"
+                return f"missing file {full}"
             size = os.path.getsize(full)
             if size != meta.get("size"):
-                return f"truncated {rel} ({size} != {meta.get('size')} bytes)"
-            if _sha256(full) != meta.get("sha256"):
-                return f"checksum mismatch on {rel}"
+                return f"truncated {full} ({size} != {meta.get('size')} bytes)"
+            actual = _sha256(full)
+            if actual != meta.get("sha256"):
+                # Name the file AND both digests: the triage path for a bad
+                # disk/torn write starts from exactly this line.
+                return (
+                    f"checksum mismatch on {full}: "
+                    f"expected {meta.get('sha256')}, got {actual}"
+                )
         return None
 
     def verify(self, step: int) -> bool:
@@ -261,3 +339,545 @@ class Checkpointer:
                 shutil.rmtree(self._step_path(victim))
             except OSError:
                 pass
+
+
+# --------------------------------------------------------------------------
+# Distributed (cohort) checkpoints
+# --------------------------------------------------------------------------
+class MissingShardError(RuntimeError):
+    """A committed cohort checkpoint cannot be assembled: some byte ranges
+    are missing or corrupt in EVERY surviving copy.  Carries the offending
+    ``(owner_rank, start, stop)`` ranges so the error names exactly which
+    host's artifacts are gone — ``spec="sharded"`` cohorts have no replicas
+    to rebuild from, so this is their terminal restore failure."""
+
+    def __init__(self, step: int, missing: Sequence[Tuple[int, int, int]]):
+        self.step = int(step)
+        self.missing = [(int(r), int(a), int(b)) for r, a, b in missing]
+        detail = ", ".join(
+            f"rank {r} bytes [{a}:{b})" for r, a, b in self.missing
+        )
+        super().__init__(f"checkpoint step {step}: missing shards ({detail})")
+
+
+def shard_plan(total_bytes: int, world: int, spec: str = "replicated"):
+    """Byte-range shard layout for a ``world``-host cohort.
+
+    Rank *i* owns range *i* of ``buckets.shard_ranges(total_bytes, world)``
+    and — under ``spec="replicated"`` — also writes a replica of range
+    ``(i+1) % world``, so any single host's artifacts can be rebuilt from
+    survivors.  Returns ``(ranges, owned)`` where ``owned[rank]`` lists the
+    range indices that rank writes (own range first)."""
+    ranges = buckets.shard_ranges(int(total_bytes), int(world), 1)
+    owned = []
+    for rank in range(int(world)):
+        mine = [rank]
+        if spec == "replicated" and int(world) > 1:
+            mine.append((rank + 1) % int(world))
+        owned.append(mine)
+    return ranges, owned
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+class DistributedCheckpointer:
+    """Pod-consistent sharded checkpoints with two-phase commit.
+
+    Each cohort member holds the full replicated training state (the
+    sharded-allreduce plane all-gathers true sums, so host-level state is
+    identical — the same determinism ``Accumulator._sync_chunks``
+    documents).  A snapshot therefore shards the deterministic pickle blob
+    BY BYTE RANGE: host *i* writes only its ~1/N slice (plus one replica
+    slice under ``spec="replicated"``), cutting per-host checkpoint I/O by
+    the cohort size while the union remains the bit-exact full state.
+
+    On-disk layout per checkpoint (``<dir>/step_<N>/``):
+
+    - ``shard_<rank>_<range>.bin`` — byte range ``<range>`` of the blob,
+      written by host ``<rank>`` (tmp + fsync + atomic rename).
+    - ``manifest_<rank>.json`` — per-host manifest: rank, world, spec,
+      blob sha256, and the size/sha256 of each range file that rank wrote.
+    - ``cohort_manifest.json`` — the leader's commit record (step,
+      membership epoch, world, shard map, per-file sha256).  Written as
+      ``cohort_manifest.json.pending`` first (phase 1, fsynced) and
+      atomically renamed (phase 2): a checkpoint is eligible for restore
+      IFF this file exists, so a host SIGKILLed mid-shard-write or a
+      leader killed between the phases leaves nothing restorable — a torn
+      checkpoint costs one interval, never a bad restore.
+
+    Restore is elastic: assembly only needs the committed range files, so
+    an N-host checkpoint restores bit-exact onto any M-host cohort;
+    :meth:`restore_slice` re-cuts this host's byte slice for the NEW
+    cohort size via ``buckets.shard_ranges`` (warm-rejoin slice serving,
+    ``Accumulator.preload_sync_slice``).  A missing range is rebuilt from
+    a replica copy (``checkpoint_shard_reconstructions_total``) when one
+    survives, else :class:`MissingShardError` names it.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        spec: str = "replicated",
+        watchdog=None,
+        write_timeout: float = 120.0,
+    ):
+        if spec not in ("replicated", "sharded"):
+            raise ValueError(f"unknown shard spec {spec!r}")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self.spec = spec
+        self._wd = watchdog
+        self._write_timeout = write_timeout
+        # Async capture plane: double-buffered staging — at most two
+        # captures (one writing + one queued) ride the background worker; a
+        # third is declined (checkpoint_captures_declined_total) instead of
+        # queueing unboundedly behind a slow filesystem.
+        self._slot_lock = threading.Lock()
+        self._busy = 0
+        self._queue: Optional[queue_mod.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        # Rolling capture accounting for the examples' exit summary line
+        # (the chaos soak gates stall-vs-step-time on it); the registry
+        # histograms above carry the same numbers for exporters.
+        self._cap_stats = {
+            "captures": 0, "stall_s": 0.0, "write_s": 0.0, "commits": 0,
+        }
+        # (step, sha16, blob) of the newest successful restore; the
+        # accumulator auto-registers it as a warm-rejoin sync slice.
+        self.last_restored: Optional[Tuple[int, str, bytes]] = None
+
+    def set_watchdog(self, watchdog) -> None:
+        """Attach (or replace) the watchdog whose ``section()`` arms around
+        shard file writes — a hung filesystem write fires
+        ``dump_diagnostics`` instead of silently wedging the writer."""
+        self._wd = watchdog
+
+    def stats(self) -> Dict[str, float]:
+        """Capture-side accounting: ``captures``, ``stall_s`` (train-thread
+        blocked seconds), ``write_s`` (background seconds), ``commits``."""
+        with self._slot_lock:
+            return dict(self._cap_stats)
+
+    def _section(self, name: str):
+        if self._wd is not None:
+            return self._wd.section(name, self._write_timeout)
+        return contextlib.nullcontext()
+
+    # ------------------------------------------------------------ write side
+    def write_shard(self, step: int, blob: bytes, rank: int, world: int,
+                    epoch=0) -> Dict[str, Any]:
+        """Write this host's shard file(s) + per-host manifest for ``step``
+        and return the report dict the leader's commit consumes.
+
+        Synchronous (the train loop uses :meth:`begin_capture` instead);
+        every file lands tmp + fsync + atomic rename, so a kill mid-write
+        leaves only ``.tmp`` husks that no manifest references."""
+        step, rank, world = int(step), int(rank), int(world)
+        if not 0 <= rank < world:
+            raise ValueError(f"rank {rank} outside cohort of {world}")
+        sdir = self._step_path(step)
+        os.makedirs(sdir, exist_ok=True)
+        ranges, owned = shard_plan(len(blob), world, self.spec)
+        delay = float(os.environ.get(_WRITE_DELAY_ENV, "0") or 0.0)
+        files: Dict[str, Dict[str, Any]] = {}
+        for j in owned[rank]:
+            a, b = ranges[j]
+            fname = f"shard_{rank}_{j}.bin"
+            full = os.path.join(sdir, fname)
+            tmp = full + ".tmp"
+            # Satellite: a wedged filesystem write must fire diagnostics,
+            # not silently hold the background writer forever.
+            with self._section("checkpoint_shard_write"):
+                with open(tmp, "wb") as f:
+                    f.write(blob[a:b])
+                    f.flush()
+                    os.fsync(f.fileno())
+                if delay:
+                    time.sleep(delay)  # chaos knob: hold the torn window open
+                os.replace(tmp, full)
+            files[fname] = {
+                "range": j, "start": a, "stop": b, "size": b - a,
+                "sha256": hashlib.sha256(blob[a:b]).hexdigest(),
+            }
+            _M_SHARD_BYTES.inc(b - a)
+        report = {
+            "step": step, "rank": rank, "world": world, "epoch": epoch,
+            "spec": self.spec, "total_bytes": len(blob),
+            "blob_sha256": hashlib.sha256(blob).hexdigest(), "files": files,
+        }
+        with self._section("checkpoint_shard_write"):
+            _write_json_atomic(
+                os.path.join(sdir, f"manifest_{rank}.json"), report
+            )
+        return report
+
+    def begin_capture(self, *, step: int, rank: int, world: int, state,
+                      epoch=0, on_done=None) -> bool:
+        """Async, non-stalling capture of ``state`` (any pytree) into this
+        host's shard files.
+
+        The caller's thread only issues ``copy_to_host_async`` on the
+        device leaves and enqueues the work — that handoff is the whole
+        train-step cost, measured as ``checkpoint_stall_seconds``.  A
+        background worker completes the transfers, pickles, shards, and
+        writes (``checkpoint_write_seconds``), then calls
+        ``on_done(report_or_None)`` from its own thread.  Returns False
+        (``checkpoint_captures_declined_total``) when both staging slots
+        are busy — the snapshot is skipped, never queued unboundedly."""
+        t0 = time.monotonic()
+        with self._slot_lock:
+            if self._busy >= 2:
+                _M_DECLINED.inc()
+                return False
+            self._busy += 1
+            self._ensure_worker_locked()
+        for leaf in jax.tree_util.tree_leaves(state):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        self._queue.put((int(step), int(rank), int(world), epoch, state, on_done))
+        dt = time.monotonic() - t0
+        _M_STALL.observe(dt)
+        with self._slot_lock:
+            self._cap_stats["captures"] += 1
+            self._cap_stats["stall_s"] += dt
+        return True
+
+    def _ensure_worker_locked(self) -> None:
+        if self._worker is None:
+            self._queue = queue_mod.Queue()
+            self._worker = threading.Thread(
+                target=self._worker_main, name="ckpt-shard-writer", daemon=True
+            )
+            self._worker.start()
+
+    def _worker_main(self) -> None:
+        q = self._queue
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            step, rank, world, epoch, state, on_done = item
+            t0 = time.monotonic()
+            report = None
+            try:
+                host = canonical_tree(jax.device_get(state))
+                blob = pickle.dumps(host, protocol=pickle.HIGHEST_PROTOCOL)
+                report = self.write_shard(step, blob, rank, world, epoch=epoch)
+            except Exception as e:  # noqa: BLE001 — capture must not kill the worker
+                utils.log_error(
+                    "checkpoint: shard capture for step %s failed: %r", step, e
+                )
+            finally:
+                dt = time.monotonic() - t0
+                _M_WRITE.observe(dt)
+                with self._slot_lock:
+                    self._busy -= 1
+                    self._cap_stats["write_s"] += dt
+            if on_done is not None:
+                try:
+                    on_done(report)
+                except Exception as e:  # noqa: BLE001 — hook bugs stay local
+                    utils.log_error("checkpoint: on_done hook failed: %r", e)
+
+    def close(self) -> None:
+        """Stop the background writer (daemonized anyway; this makes
+        teardown deterministic in tests)."""
+        with self._slot_lock:
+            q, self._worker = self._queue, None
+            self._queue = None
+        if q is not None:
+            q.put(None)
+
+    # ----------------------------------------------------- two-phase commit
+    def prepare_commit(self, step: int, reports: Sequence[Dict[str, Any]]) -> str:
+        """Phase 1 (leader): validate the quorum and stage the cohort
+        manifest as ``cohort_manifest.json.pending`` (fsynced).
+
+        Every rank ``0..world-1`` must have reported, and every report must
+        agree on the blob digest/size/epoch — digest agreement IS the
+        version-consistency proof for the snapshot.  The checkpoint is NOT
+        yet eligible for restore after this phase."""
+        step = int(step)
+        reports = [r for r in reports if r]
+        if not reports:
+            raise ValueError(f"checkpoint step {step}: no shard reports")
+        world = int(reports[0]["world"])
+        sha = reports[0]["blob_sha256"]
+        total = int(reports[0]["total_bytes"])
+        epoch = reports[0].get("epoch")
+        spec = reports[0].get("spec", self.spec)
+        ranks = sorted(int(r["rank"]) for r in reports)
+        if ranks != list(range(world)):
+            raise ValueError(
+                f"checkpoint step {step}: quorum incomplete "
+                f"(have ranks {ranks}, want 0..{world - 1})"
+            )
+        for r in reports:
+            key = (r["blob_sha256"], int(r["total_bytes"]), int(r["world"]),
+                   r.get("epoch"))
+            if key != (sha, total, world, epoch):
+                raise ValueError(
+                    f"checkpoint step {step}: rank {r['rank']} digest/shape "
+                    f"disagrees — snapshot not version-consistent (rank 0: "
+                    f"sha {sha[:16]} {total} B epoch {epoch}; "
+                    f"rank {r['rank']}: sha {r['blob_sha256'][:16]} "
+                    f"{int(r['total_bytes'])} B epoch {r.get('epoch')})"
+                )
+        cohort = {
+            "step": step, "epoch": epoch, "world": world, "spec": spec,
+            "total_bytes": total, "blob_sha256": sha, "time": time.time(),
+            "shards": {
+                str(int(r["rank"])): {"files": r["files"]} for r in reports
+            },
+        }
+        sdir = self._step_path(step)
+        os.makedirs(sdir, exist_ok=True)
+        pending = os.path.join(sdir, _PENDING)
+        _write_json_atomic(pending, cohort)
+        return pending
+
+    def commit(self, step: int) -> str:
+        """Phase 2 (leader): atomically promote the pending cohort manifest
+        — the single instant the checkpoint becomes eligible for restore."""
+        sdir = self._step_path(int(step))
+        pending = os.path.join(sdir, _PENDING)
+        final = os.path.join(sdir, _COHORT_MANIFEST)
+        if not os.path.exists(pending):
+            raise FileNotFoundError(
+                f"checkpoint step {step}: no pending cohort manifest to commit"
+            )
+        os.replace(pending, final)
+        _fsync_dir(sdir)
+        _M_COMMITS.inc()
+        with self._slot_lock:
+            self._cap_stats["commits"] += 1
+        telemetry.flight_event(
+            "checkpoint.cohort_committed", step=int(step), path=final
+        )
+        utils.log_info("checkpoint: committed cohort manifest %s", final)
+        self._gc()
+        return final
+
+    def commit_cohort(self, step: int, reports: Sequence[Dict[str, Any]]) -> str:
+        """Both phases back to back (the leader's normal path)."""
+        self.prepare_commit(step, reports)
+        return self.commit(step)
+
+    # -------------------------------------------------------------- restore
+    def committed_steps(self) -> List[int]:
+        """Steps whose cohort manifest is COMMITTED (phase 2 done).  A
+        ``.pending``-only or manifest-less ``step_<N>/`` is a torn artifact
+        and is never eligible."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            if name.startswith("step_") and not name.endswith(".tmp"):
+                if os.path.exists(
+                    os.path.join(self.directory, name, _COHORT_MANIFEST)
+                ):
+                    try:
+                        steps.append(int(name[len("step_"):]))
+                    except ValueError:
+                        pass
+        return sorted(steps)
+
+    def latest_committed_step(self) -> Optional[int]:
+        steps = self.committed_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: Optional[int] = None) -> Optional[Tuple[int, Any]]:
+        """Load the newest committed, assemblable checkpoint (≤ ``step``
+        when given) as ``(step, state)``; None when nothing is committed.
+
+        A committed candidate whose files are truncated/corrupt falls back
+        to the next older committed one (``checkpoint_corrupt_skipped``),
+        after replica reconstruction has been tried.  When at least one
+        candidate failed ONLY for missing shards and no older checkpoint
+        could be restored, the :class:`MissingShardError` naming those
+        shards is raised instead of silently returning None."""
+        candidates = self.committed_steps()
+        if step is not None:
+            candidates = [s for s in candidates if s <= step]
+        missing_err = None
+        for cand in reversed(candidates):
+            path = self._step_path(cand)
+            try:
+                blob, cohort = self._assemble(cand)
+                state = pickle.loads(blob)
+            except MissingShardError as e:
+                missing_err = missing_err or e
+                _M_CORRUPT_SKIPPED.inc()
+                utils.log_error(
+                    "checkpoint: skipping committed %s (%s); falling back",
+                    path, e,
+                )
+                telemetry.flight_event(
+                    "checkpoint.corrupt_skipped", path=path, reason=str(e)
+                )
+                continue
+            except Exception as e:  # noqa: BLE001 — treat as corruption
+                _M_CORRUPT_SKIPPED.inc()
+                utils.log_error(
+                    "checkpoint: skipping corrupt %s (%r); falling back",
+                    path, e,
+                )
+                telemetry.flight_event(
+                    "checkpoint.corrupt_skipped", path=path, reason=repr(e)
+                )
+                continue
+            self.last_restored = (cand, cohort["blob_sha256"][:16], blob)
+            return cand, state
+        if missing_err is not None:
+            raise missing_err
+        return None
+
+    def restore_slice(
+        self, rank: int, world: int, step: Optional[int] = None
+    ) -> Optional[Tuple[int, str, int, bytes, int]]:
+        """Elastic re-cut: assemble the newest committed blob and return
+        ``(step, sha16, start, data, total_bytes)`` — THIS host's byte
+        slice under a ``world``-host layout (``buckets.shard_ranges``),
+        regardless of the cohort size that wrote the checkpoint.  Feeds
+        ``Accumulator.preload_sync_slice`` so a rejoining host pulls only
+        the bytes it does not already hold."""
+        candidates = self.committed_steps()
+        if step is not None:
+            candidates = [s for s in candidates if s <= step]
+        if not candidates:
+            return None
+        cand = candidates[-1]
+        blob, cohort = self._assemble(cand)
+        a, b = buckets.shard_ranges(len(blob), int(world), 1)[int(rank)]
+        return cand, cohort["blob_sha256"][:16], a, blob[a:b], len(blob)
+
+    def verify(self, step: int) -> bool:
+        """Public probe: is ``step`` committed AND assemblable bit-exact?"""
+        try:
+            self._assemble(int(step))
+        except Exception:  # noqa: BLE001 — any failure means not restorable
+            return False
+        return True
+
+    def _assemble(self, step: int) -> Tuple[bytes, Dict[str, Any]]:
+        sdir = self._step_path(int(step))
+        with open(os.path.join(sdir, _COHORT_MANIFEST)) as f:
+            cohort = json.load(f)
+        total = int(cohort["total_bytes"])
+        world = int(cohort["world"])
+        buf = bytearray(total)
+        # Candidate files per range, primary (owner rank == range) first so
+        # replica reads are countable reconstructions, not the normal path.
+        by_range: Dict[int, List[Tuple[bool, str, Dict[str, Any]]]] = {}
+        for rank_s, shard in cohort.get("shards", {}).items():
+            for fname, meta in shard.get("files", {}).items():
+                j = int(meta["range"])
+                by_range.setdefault(j, []).append(
+                    (int(rank_s) != j, fname, meta)
+                )
+        missing = []
+        for j, (a, b) in enumerate(buckets.shard_ranges(total, world, 1)):
+            done = False
+            for is_replica, fname, meta in sorted(
+                by_range.get(j, []), key=lambda t: (t[0], t[1])
+            ):
+                full = os.path.join(sdir, fname)
+                reason = _verify_range_file(full, meta, b - a)
+                if reason is not None:
+                    utils.log_error("checkpoint: %s", reason)
+                    continue
+                with open(full, "rb") as f:
+                    buf[a:b] = f.read()
+                if is_replica:
+                    _M_RECONSTRUCTED.inc()
+                    utils.log_info(
+                        "checkpoint: step %d range %d rebuilt from replica "
+                        "%s (primary lost)", int(step), j, fname,
+                    )
+                done = True
+                break
+            if not done:
+                missing.append((j, a, b))
+        if missing:
+            raise MissingShardError(step, missing)
+        got = hashlib.sha256(bytes(buf)).hexdigest()
+        if got != cohort["blob_sha256"]:
+            raise ValueError(
+                f"assembled blob checksum mismatch for step {step}: "
+                f"expected {cohort['blob_sha256']}, got {got}"
+            )
+        return bytes(buf), cohort
+
+    # ------------------------------------------------------------- internals
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{int(step)}")
+
+    def _gc(self) -> None:
+        committed = self.committed_steps()
+        victims = committed[:-self.max_to_keep] if self.max_to_keep else []
+        newest = committed[-1] if committed else None
+        for s in victims:
+            shutil.rmtree(self._step_path(s), ignore_errors=True)
+        # Torn husks: step dirs that never committed and are OLDER than the
+        # newest committed checkpoint can never become eligible — reap them.
+        # (Newer uncommitted dirs may be mid-write and are left alone.)
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("step_"):
+                continue
+            base = name[:-len(".tmp")] if name.endswith(".tmp") else name
+            try:
+                s = int(base[len("step_"):])
+            except ValueError:
+                continue
+            committed_here = os.path.exists(
+                os.path.join(self.directory, name, _COHORT_MANIFEST)
+            )
+            if newest is not None and s < newest and not committed_here:
+                shutil.rmtree(
+                    os.path.join(self.directory, name), ignore_errors=True
+                )
+
+
+def _verify_range_file(full: str, meta: Dict[str, Any], want_size: int):
+    """None when the range file matches its manifest entry; else the reason
+    with path + expected/actual digests (triage starts from this string)."""
+    if not os.path.exists(full):
+        return f"missing shard file {full}"
+    size = os.path.getsize(full)
+    if size != want_size:
+        return f"truncated shard {full} ({size} != {want_size} bytes)"
+    actual = _sha256(full)
+    if actual != meta.get("sha256"):
+        return (
+            f"checksum mismatch on {full}: "
+            f"expected {meta.get('sha256')}, got {actual}"
+        )
+    return None

@@ -78,6 +78,13 @@ def make_flags(argv=None):
     p.add_argument("--train_id", default="impala")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--checkpoint_interval", type=float, default=600.0)
+    p.add_argument(
+        "--checkpoint_dir", default=None,
+        help="distributed checkpoint plane for --shard_grads cohorts "
+        "(docs/RESILIENCE.md 'Distributed checkpoints'): a SHARED "
+        "directory where every host writes its shard of the snapshot and "
+        "the leader two-phase-commits the cohort manifest; restore "
+        "re-cuts shards onto the restart cohort size")
     p.add_argument("--stats_interval", type=float, default=2.0)
     p.add_argument("--log_interval", type=float, default=5.0)
     p.add_argument("--device", default=None, help="jax device str, e.g. 'tpu:0'")
@@ -478,6 +485,26 @@ def train(flags, on_stats=None) -> dict:
             params, opt_state = ck["params"], ck["opt_state"]
             steps_done, model_version = ck["steps"], ck["model_version"]
 
+    dckpt = None
+    if flags.checkpoint_dir:
+        if not flags.shard_grads:
+            raise ValueError(
+                "--checkpoint_dir is the distributed checkpoint plane and "
+                "requires --shard_grads (use --checkpoint for single-host "
+                "snapshots)"
+            )
+        from ...checkpoint import DistributedCheckpointer
+
+        dckpt = DistributedCheckpointer(flags.checkpoint_dir)
+        r = dckpt.restore()
+        if r is not None:
+            # The committed step IS the model version the cohort agreed on
+            # at capture; election then prefers this restored peer.
+            model_version, (params, _buffers, st) = r
+            opt_state = st["opt_state"]
+            steps_done = int(st.get("steps", 0))
+            print(f"resumed from checkpoint step {model_version}", flush=True)
+
     @jax.jit
     def act_step(params, inputs, core_state, rng_key):
         out, new_core = model.apply(params, inputs, core_state, sample_rng=rng_key)
@@ -676,6 +703,22 @@ def train(flags, on_stats=None) -> dict:
     # writes the leader checkpoint — a preempted-but-hung run stays
     # resumable (docs/RESILIENCE.md).
     wd = Watchdog(timeout=flags.watchdog, name="impala")
+    if dckpt is not None:
+        # Distributed snapshots ride the accumulator's model-version
+        # lockstep; a hung shard write fires the watchdog (and shows in the
+        # flight recorder) instead of wedging the writer thread silently.
+        dckpt.set_watchdog(wd)
+        # The env-step total is host-local (each peer's reduced stats lag
+        # differently), so it rides the leader-broadcast aux dict; state_fn
+        # may only return lockstep-replicated values — the blob digests
+        # must agree across every member.
+        accumulator.enable_distributed_checkpoint(
+            dckpt, interval=flags.checkpoint_interval,
+            aux_fn=lambda: {"steps": int(stats["steps_done"].value)},
+        )
+
+    def dckpt_state_fn():
+        return {"opt_state": jax.device_get(opt_state)}
 
     tsv = None
     if flags.localdir:
@@ -822,6 +865,8 @@ def train(flags, on_stats=None) -> dict:
                 broker.update()
             rpc_group.update()
             accumulator.update()
+            if dckpt is not None:
+                accumulator.checkpoint_tick(state_fn=dckpt_state_fn)
             if scaler is not None:
                 scaler.step()  # self-rate-limited supervision tick
             if decommission_flag is not None and not decommissioning:
@@ -1140,6 +1185,16 @@ def train(flags, on_stats=None) -> dict:
                 pass
             telemetry.get_tracer().enable_jax_annotations(False)
         _signal.signal(_signal.SIGTERM, prev_sigterm)
+        if dckpt is not None:
+            s = dckpt.stats()
+            print(
+                "ckpt_async: captures=%d commits=%d stall_s=%.4f "
+                "write_s=%.4f" % (
+                    s["captures"], s["commits"], s["stall_s"], s["write_s"],
+                ),
+                flush=True,
+            )
+            dckpt.close()
         if flags.checkpoint and accumulator.is_leader():
             save_checkpoint(
                 flags.checkpoint, params, opt_state,

@@ -31,6 +31,7 @@ import os
 import random
 import signal
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["FaultPlan", "FrameFaults", "Partition", "install_from_env"]
@@ -459,6 +460,105 @@ class FaultPlan:
             f.truncate(keep)
         self._record("truncate", path, size, keep)
         return path
+
+    def truncate_shard(self, ckpt_dir: str, step: Optional[int] = None,
+                       rank: Optional[int] = None,
+                       range_index: Optional[int] = None) -> Optional[str]:
+        """Truncate one shard file of a COMMITTED distributed checkpoint to
+        half its size (manifests left intact).  Targets the newest committed
+        ``step_<N>/`` unless ``step`` is given; picks the victim shard on
+        the ``checkpoint`` stream unless ``rank``/``range_index`` pin it
+        (``rank == range_index`` names a primary copy, anything else a
+        replica).  Returns the truncated path, or None when no committed
+        shard exists.  The invariant this arms: restore must detect the
+        short read via the per-shard sha256, reconstruct from a surviving
+        replica or fall back to an older committed snapshot — never
+        deserialize torn bytes."""
+        step_dir = self._committed_step_dir(ckpt_dir, step)
+        if step_dir is None:
+            return None
+        shards = sorted(
+            f for f in os.listdir(step_dir)
+            if f.startswith("shard_") and f.endswith(".bin")
+        )
+        if not shards:
+            return None
+        if rank is not None:
+            shards = [f for f in shards if f.startswith(f"shard_{int(rank)}_")]
+        if range_index is not None:
+            shards = [
+                f for f in shards if f.endswith(f"_{int(range_index)}.bin")
+            ]
+        if not shards:
+            return None
+        victim = shards[self.rng("checkpoint").randrange(len(shards))]
+        return self._truncate_file(os.path.join(step_dir, victim))
+
+    def tear_cohort_manifest(self, ckpt_dir: str,
+                             step: Optional[int] = None) -> Optional[str]:
+        """Un-commit a distributed checkpoint: rename its cohort manifest
+        back to ``.pending``, recreating the exact on-disk state of a leader
+        lost between commit phase 1 and phase 2.  Returns the torn step dir,
+        or None when nothing was committed.  The invariant: a torn
+        checkpoint is NEVER eligible — restore must select an older
+        committed snapshot (or report none) without reading the shards."""
+        step_dir = self._committed_step_dir(ckpt_dir, step)
+        if step_dir is None:
+            return None
+        manifest = os.path.join(step_dir, "cohort_manifest.json")
+        os.replace(manifest, manifest + ".pending")
+        self._record("tear_cohort_manifest", step_dir)
+        return step_dir
+
+    def kill_mid_shard_write(self, proc, ckpt_dir: str,
+                             timeout: float = 30.0,
+                             sig: int = signal.SIGKILL) -> Optional[str]:
+        """SIGKILL ``proc`` the moment a shard write is in flight under
+        ``ckpt_dir`` (a ``shard_*.tmp`` staging file exists — widen the
+        window with ``MOOLIB_CKPT_WRITE_DELAY`` in the victim's env).
+        Returns the tmp path that triggered the kill, or None if no write
+        started within ``timeout`` (no kill sent).  The invariant: the
+        half-written shard has no committed cohort manifest, so restore
+        ignores the whole step dir."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for root, _dirs, files in os.walk(ckpt_dir):
+                for f in files:
+                    if f.startswith("shard_") and f.endswith(".tmp"):
+                        full = os.path.join(root, f)
+                        pid = getattr(proc, "pid", proc)
+                        self._record("kill_mid_shard_write", full, pid, sig)
+                        os.kill(pid, sig)
+                        return full
+            time.sleep(0.002)
+        self._record("kill_mid_shard_write", None, None, 0)
+        return None
+
+    @staticmethod
+    def _committed_step_dir(ckpt_dir: str,
+                            step: Optional[int] = None) -> Optional[str]:
+        """Newest ``step_<N>/`` under ``ckpt_dir`` holding a committed
+        cohort manifest (or the one for ``step``); None when absent."""
+        if not os.path.isdir(ckpt_dir):
+            return None
+        steps = []
+        for name in os.listdir(ckpt_dir):
+            if not name.startswith("step_"):
+                continue
+            try:
+                n = int(name[len("step_"):])
+            except ValueError:
+                continue
+            if os.path.exists(
+                os.path.join(ckpt_dir, name, "cohort_manifest.json")
+            ):
+                steps.append(n)
+        if step is not None:
+            return (os.path.join(ckpt_dir, f"step_{int(step)}")
+                    if int(step) in steps else None)
+        if not steps:
+            return None
+        return os.path.join(ckpt_dir, f"step_{max(steps)}")
 
 
 _env_installed: Optional[FrameFaults] = None

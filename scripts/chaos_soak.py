@@ -16,6 +16,14 @@ Exercises the supervision story end to end with a deterministic
    checkpoint is truncated, and a final relaunch must resume from the
    newest *intact* checkpoint (step-counter continuity in the logs) and
    reach its target.
+4. **Distributed checkpoints under mid-write host loss**: a 2-peer
+   ``--shard_grads`` cohort snapshots into one shared directory; peer B
+   is SIGKILLed *mid-shard-write* (a write-delay fault widens the
+   window).  No torn checkpoint may ever be eligible, the 1-host
+   relaunch must resume from the newest *committed* cohort manifest
+   with step continuity (an elastic M<N restore), and the measured
+   per-capture ``checkpoint_stall_seconds`` must stay under 10% of the
+   mean step time (async capture is non-stalling).
 
 Exit code 0 only when every phase holds.  A wedged child is killed by its
 own ``--watchdog`` (non-zero exit) or by this script's phase deadline —
@@ -57,7 +65,7 @@ def free_port() -> int:
 CACHE_DIR = ""  # set in main(): shared persistent compile cache for children
 
 
-def child_env(faults: str = "") -> dict:
+def child_env(faults: str = "", extra_env=None) -> dict:
     env = dict(
         os.environ,
         PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -71,14 +79,17 @@ def child_env(faults: str = "") -> dict:
         env["MOOLIB_FAULTS"] = faults
     else:
         env.pop("MOOLIB_FAULTS", None)
+    if extra_env:
+        env.update(extra_env)
     return env
 
 
-def spawn_lm(args, log_path, faults=""):
+def spawn_lm(args, log_path, faults="", extra_env=None):
     with open(log_path, "w") as f:
         return subprocess.Popen(
             [sys.executable, "-m", "moolib_tpu.examples.lm"] + args,
-            stdout=f, stderr=subprocess.STDOUT, env=child_env(faults), cwd=ROOT,
+            stdout=f, stderr=subprocess.STDOUT,
+            env=child_env(faults, extra_env), cwd=ROOT,
             start_new_session=True,
         )
 
@@ -320,6 +331,134 @@ def phase_kill_resume(flags, plan, workdir: str, reached: int) -> None:
     log(f"phase 3 OK (resumed from intact step {got}, reached {steps[-1]})")
 
 
+def _ckpt_async_stats(log_path: str):
+    """The exit-line capture stats a distributed-checkpoint run prints
+    (``ckpt_async: captures=.. commits=.. stall_s=.. write_s=.. train_s=..
+    steps=..``), as a dict, or None."""
+    try:
+        with open(log_path) as f:
+            m = re.search(
+                r"^ckpt_async: captures=(\d+) commits=(\d+) stall_s=([\d.]+) "
+                r"write_s=([\d.]+) train_s=([\d.]+) steps=(\d+)",
+                f.read(), re.M,
+            )
+    except OSError:
+        return None
+    if not m:
+        return None
+    keys = ("captures", "commits", "stall_s", "write_s", "train_s", "steps")
+    return {k: float(m.group(i + 1)) for i, k in enumerate(keys)}
+
+
+def phase_ckpt_distributed(flags, plan, workdir: str) -> None:
+    """2-peer sharded cohort writing DISTRIBUTED checkpoints into one shared
+    directory; peer B is SIGKILLed mid-shard-write (write-delay fault widens
+    the window).  The invariants (ISSUE 17):
+
+    - the torn step dir is never eligible: every ``step_<N>/`` the relaunch
+      can select holds a committed ``cohort_manifest.json``;
+    - the relaunched (now 1-host) cohort resumes from the newest COMMITTED
+      snapshot with step-counter continuity — an elastic M<N restore;
+    - async capture is non-stalling: the measured ``checkpoint_stall_seconds``
+      per capture stays under 10% of the mean step time."""
+    from moolib_tpu.checkpoint import DistributedCheckpointer
+
+    log("phase 4: distributed checkpoints; kill peer B mid-shard-write")
+    port = free_port()
+    dckpt_dir = os.path.join(workdir, "dckpt")
+    a_log = os.path.join(workdir, "dpeerA.log")
+    b_log = os.path.join(workdir, "dpeerB.log")
+    target = flags.steps * 2
+    shard_args = ["--shard_grads"]
+    a = spawn_lm(shard_args + lm_args(flags, target, dckpt_dir, port=port,
+                                      name="dpeerA"), a_log)
+    # The victim's shard writes dawdle between staging and rename
+    # (MOOLIB_CKPT_WRITE_DELAY) so the mid-write kill window is wide enough
+    # to hit deterministically.
+    b = spawn_lm(shard_args + lm_args(flags, target, dckpt_dir, connect=port,
+                                      name="dpeerB"),
+                 b_log, extra_env={"MOOLIB_CKPT_WRITE_DELAY": "0.4"})
+    ck = DistributedCheckpointer(dckpt_dir)
+    deadline = time.monotonic() + flags.phase_deadline
+    try:
+        # First committed cohort snapshot, then catch the next shard write
+        # in flight and kill B under it.
+        wait_for(lambda: ck.latest_committed_step() is not None, deadline,
+                 "waiting for the first committed cohort checkpoint",
+                 procs=(a, b))
+        victim_tmp = plan.kill_mid_shard_write(
+            b, dckpt_dir, timeout=max(5.0, deadline - time.monotonic())
+        )
+        if victim_tmp is None:
+            raise SystemExit("FAIL: no shard write observed to kill under")
+        log(f"killed peer B (pid {b.pid}) mid-shard-write: {victim_tmp}")
+        # A absorbs the loss (cohort shrinks to 1, checkpointing continues)
+        # and must still reach its target.
+        rc = a.wait(timeout=max(5.0, deadline - time.monotonic()))
+        if rc != 0:
+            dump_tail(a_log)
+            raise SystemExit(f"FAIL: peer A exited rc={rc}")
+    except subprocess.TimeoutExpired:
+        dump_tail(a_log)
+        raise SystemExit("FAIL: peer A never finished after mid-write kill")
+    finally:
+        kill_tree(a)
+        kill_tree(b)
+
+    committed = ck.committed_steps()
+    assert committed, "no committed distributed checkpoint survived"
+    expect_resume = committed[-1]
+    # Zero eligible torn checkpoints: everything restore can select is
+    # committed, and every torn/uncommitted husk is verifiably NOT.
+    torn = [
+        name for name in os.listdir(dckpt_dir)
+        if name.startswith("step_") and not name.endswith(".tmp")
+        and not os.path.exists(
+            os.path.join(dckpt_dir, name, "cohort_manifest.json"))
+    ]
+    for name in torn:
+        assert int(name[len("step_"):]) not in committed
+    log(f"committed steps {committed}; torn/uncommitted dirs ignored: {torn}")
+
+    # Non-stalling capture, measured: per-capture stall < 10% of step time.
+    s = _ckpt_async_stats(a_log)
+    assert s and s["captures"] >= 1, f"no capture stats in peer A log: {s}"
+    step_time = s["train_s"] / max(s["steps"], 1.0)
+    stall = s["stall_s"] / s["captures"]
+    assert stall < 0.10 * step_time, (
+        f"async capture stalls the step: {stall:.4f}s/capture vs "
+        f"10% of {step_time:.4f}s step"
+    )
+    log(f"capture stall {stall * 1e3:.2f}ms vs step {step_time * 1e3:.1f}ms "
+        f"({s['captures']:.0f} captures, {s['commits']:.0f} commits)")
+
+    # Elastic M<N restore: the 2-host checkpoint restores onto a 1-host
+    # cohort from the newest COMMITTED step, with step continuity.
+    final_log = os.path.join(workdir, "dpeerA_final.log")
+    final_target = expect_resume + 30
+    a = spawn_lm(shard_args + lm_args(flags, final_target, dckpt_dir,
+                                      port=free_port(), name="dpeerA"),
+                 final_log)
+    try:
+        rc = a.wait(timeout=flags.phase_deadline)
+    except subprocess.TimeoutExpired:
+        dump_tail(final_log)
+        raise SystemExit("FAIL: distributed-resume run never finished")
+    finally:
+        kill_tree(a)
+    if rc != 0:
+        dump_tail(final_log)
+        raise SystemExit(f"FAIL: distributed-resume run exited rc={rc}")
+    got = resumed_step(final_log)
+    steps = logged_steps(final_log)
+    assert got == expect_resume, (
+        f"resumed from {got}, expected newest committed {expect_resume}"
+    )
+    assert steps and steps[0] >= got and steps[-1] >= final_target - 10, steps
+    log(f"phase 4 OK (resumed 1-host from committed step {got} of a 2-host "
+        f"cohort, reached {steps[-1]})")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="seeded chaos soak")
     ap.add_argument("--seed", type=int, default=0)
@@ -363,6 +502,7 @@ def main(argv=None) -> int:
     phase_envpool(plan)
     reached = phase_cohort(flags, plan, workdir)
     phase_kill_resume(flags, plan, workdir, reached)
+    phase_ckpt_distributed(flags, plan, workdir)
     log(f"CHAOS SOAK OK (fault log: {plan.actions})")
     return 0
 

@@ -90,9 +90,10 @@ else
   fail=1
 fi
 
-step "fault-domain supervision tests (envpool respawn, watchdog, checkpoint integrity)"
+step "fault-domain supervision tests (envpool respawn, watchdog, checkpoint integrity, distributed checkpoints)"
 python -m pytest tests/test_envpool_supervision.py tests/test_watchdog.py \
-  tests/test_checkpoint_corrupt.py -q || fail=1
+  tests/test_checkpoint_corrupt.py tests/test_checkpoint_distributed.py \
+  -q || fail=1
 
 step "warm-rejoin plane tests (chunked model sync resume, compile cache)"
 python -m pytest tests/test_accumulator_rejoin.py tests/test_compile_cache.py \
@@ -191,7 +192,7 @@ else
   fail=1
 fi
 
-step "chaos soak (seeded, ~80 s smoke: worker/peer kills + respawn SLO, RPC frame chaos, forced-kill resume)"
+step "chaos soak (seeded, ~80 s smoke: worker/peer kills + respawn SLO, RPC frame chaos, forced-kill resume, mid-shard-write kill + distributed checkpoint resume)"
 # Exits non-zero if any phase stalls past its watchdog/deadline, or the
 # respawned peer misses its recovery bound (docs/RESILIENCE.md recovery
 # budget).  The shared compile cache below is what keeps the respawn's

@@ -1,0 +1,410 @@
+"""Pod-consistent sharded checkpoints (ISSUE 17 tentpole): two-phase
+commit, quorum restore, elastic re-cut, and the accumulator's
+leader-coordinated snapshot protocol.
+
+- a cohort's shard files + committed cohort manifest restore bit-exact,
+  and the bytes are identical no matter which cohort size reads them
+  (M<N and M>N elastic re-cut);
+- torn artifacts are NEVER eligible: a leader killed between commit
+  phase 1 and phase 2 (``cohort_manifest.json.pending`` only), a
+  truncated shard, or a SIGKILLed mid-write host all fall back to the
+  newest intact committed snapshot;
+- ``spec="replicated"`` rebuilds a lost range from the replica copy
+  (counted); ``spec="sharded"`` raises :class:`MissingShardError`
+  naming the lost ranges;
+- async capture never stalls the caller and declines (never queues
+  unboundedly) past the double-buffered staging slots;
+- an in-process 2-peer cohort drives the full leader-coordinated
+  protocol to a committed, restorable snapshot — with dict insertion
+  order deliberately divergent across peers (the canonical-ordering
+  regression);
+- a restored shard slice pre-fills the resumable model-sync stream
+  (``accum_sync_slice_chunks_total``).
+"""
+
+import hashlib
+import os
+import pickle
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from moolib_tpu import Accumulator, Broker, buckets, checkpoint, telemetry
+from moolib_tpu.checkpoint import DistributedCheckpointer, MissingShardError
+from moolib_tpu.testing import FaultPlan
+
+STATE = {"opt": "shared-state"}
+LR = 0.1
+
+
+def _counter(name):
+    return telemetry.get_registry().counter_values().get(name, 0.0)
+
+
+def _state(seed, n=4096):
+    rng = np.random.RandomState(seed)
+    params = {"w": rng.randn(n).astype(np.float32),
+              "b": rng.randn(8).astype(np.float32)}
+    return (params, {}, {"opt_state": rng.randn(16).astype(np.float32)})
+
+
+def _write_cohort(ckpt, step, state, world, epoch=0):
+    """Every rank writes its shard of the SAME state; leader commits."""
+    blob = pickle.dumps(checkpoint.canonical_tree(jax.device_get(state)),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    reports = [ckpt.write_shard(step, blob, rank, world, epoch=epoch)
+               for rank in range(world)]
+    return blob, reports
+
+
+def _assert_tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------- file plane
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_cohort_roundtrip_bit_exact(tmp_path, world):
+    """2- and 3-host cohort commits restore bit-exact, and match the blob
+    a single-host (world=1) writer produces for the same state."""
+    state = _state(0)
+    ck = DistributedCheckpointer(str(tmp_path / "a"))
+    blob, reports = _write_cohort(ck, 7, state, world=world)
+    ck.commit_cohort(7, reports)
+    assert ck.committed_steps() == [7]
+    step, got = ck.restore()
+    assert step == 7
+    _assert_tree_equal(got, state)
+    assert ck.last_restored[0] == 7 and ck.last_restored[2] == blob
+    # Single-host reference: same state, world=1 — byte-identical blob.
+    ref = DistributedCheckpointer(str(tmp_path / "b"))
+    ref_blob, ref_reports = _write_cohort(ref, 7, state, world=1)
+    assert ref_blob == blob
+    ref.commit_cohort(7, ref_reports)
+    assert ref.restore()[0] == 7
+
+
+def test_canonical_tree_makes_dict_order_irrelevant():
+    """Same values, different dict insertion order → same blob bytes (the
+    sharded flatten/unflatten vs pickle-synced divergence)."""
+    a = {"w": np.arange(4.0), "b": {"y": 1, "x": 2}}
+    b = {"b": {"x": 2, "y": 1}, "w": np.arange(4.0)}
+    pa = pickle.dumps(checkpoint.canonical_tree(a),
+                      protocol=pickle.HIGHEST_PROTOCOL)
+    pb = pickle.dumps(checkpoint.canonical_tree(b),
+                      protocol=pickle.HIGHEST_PROTOCOL)
+    assert pa == pb
+    assert pickle.dumps(a) != pickle.dumps(b)  # the bug this guards against
+
+
+def test_torn_manifest_never_eligible(tmp_path):
+    """A leader lost between phase 1 and phase 2 leaves `.pending` only:
+    nothing eligible; an older committed snapshot is selected instead."""
+    ck = DistributedCheckpointer(str(tmp_path))
+    s1 = _state(1)
+    blob, reports = _write_cohort(ck, 1, s1, world=2)
+    ck.commit_cohort(1, reports)
+    # Step 2: phase 1 only — leader dies before commit().
+    _, reports2 = _write_cohort(ck, 2, _state(2), world=2)
+    ck.prepare_commit(2, reports2)
+    assert ck.committed_steps() == [1]
+    step, got = ck.restore()
+    assert step == 1
+    _assert_tree_equal(got, s1)
+    # Step 3: committed, then torn by the fault plan (recreates the same
+    # on-disk state) — back to step 1 again.
+    _, reports3 = _write_cohort(ck, 3, _state(3), world=2)
+    ck.commit_cohort(3, reports3)
+    assert ck.latest_committed_step() == 3
+    plan = FaultPlan(seed=0)
+    torn = plan.tear_cohort_manifest(str(tmp_path), step=3)
+    assert torn and torn.endswith("step_3")
+    assert ck.committed_steps() == [1]
+    assert ck.restore()[0] == 1
+
+
+def test_truncated_shard_rebuilt_from_replica(tmp_path):
+    """spec="replicated": a truncated shard is detected via its sha256 and
+    rebuilt from the replica copy, counted as a reconstruction."""
+    ck = DistributedCheckpointer(str(tmp_path))
+    state = _state(4)
+    _, reports = _write_cohort(ck, 5, state, world=2)
+    ck.commit_cohort(5, reports)
+    plan = FaultPlan(seed=0)
+    # Pin the PRIMARY copy of range 0 so the replica (shard_1_0.bin) is
+    # what restore must fall back to.
+    victim = plan.truncate_shard(str(tmp_path), step=5, rank=0, range_index=0)
+    assert victim is not None and victim.endswith("shard_0_0.bin")
+    before = _counter("checkpoint_shard_reconstructions_total")
+    step, got = ck.restore()
+    assert step == 5
+    _assert_tree_equal(got, state)
+    assert _counter("checkpoint_shard_reconstructions_total") > before
+
+
+def test_both_copies_lost_falls_back(tmp_path):
+    """When a range's primary AND replica are both gone, restore falls back
+    to the next older committed snapshot."""
+    ck = DistributedCheckpointer(str(tmp_path))
+    old = _state(5)
+    _, r1 = _write_cohort(ck, 1, old, world=2)
+    ck.commit_cohort(1, r1)
+    _, r2 = _write_cohort(ck, 2, _state(6), world=2)
+    ck.commit_cohort(2, r2)
+    sdir = tmp_path / "step_2"
+    os.remove(sdir / "shard_0_0.bin")  # range 0 primary
+    os.remove(sdir / "shard_1_0.bin")  # range 0 replica
+    step, got = ck.restore()
+    assert step == 1
+    _assert_tree_equal(got, old)
+
+
+def test_sharded_spec_missing_shard_error(tmp_path):
+    """spec="sharded" has no replicas: a lost shard is a terminal
+    MissingShardError naming the missing byte ranges."""
+    ck = DistributedCheckpointer(str(tmp_path), spec="sharded")
+    blob, reports = _write_cohort(ck, 9, _state(7), world=2)
+    ck.commit_cohort(9, reports)
+    os.remove(tmp_path / "step_9" / "shard_1_1.bin")
+    with pytest.raises(MissingShardError) as ei:
+        ck.restore()
+    (j, a, b), = ei.value.missing
+    assert j == 1 and (a, b) == tuple(
+        buckets.shard_ranges(len(blob), 2, 1)[1]
+    )
+
+
+def test_elastic_recut_m_less_and_more(tmp_path):
+    """A 4-host checkpoint restores bit-exact on 3-host and 8-host cohorts,
+    and restore_slice re-cuts each host's byte slice for the NEW size."""
+    ck = DistributedCheckpointer(str(tmp_path))
+    state = _state(8, n=32768)
+    blob, reports = _write_cohort(ck, 11, state, world=4)
+    ck.commit_cohort(11, reports)
+    for new_world in (3, 8):  # one M<N, one M>N
+        reader = DistributedCheckpointer(str(tmp_path))
+        step, got = reader.restore()
+        assert step == 11
+        _assert_tree_equal(got, state)
+        slices = []
+        for rank in range(new_world):
+            step, sha16, start, data, total = reader.restore_slice(
+                rank, new_world
+            )
+            assert step == 11 and total == len(blob)
+            assert sha16 == hashlib.sha256(blob).hexdigest()[:16]
+            a, b = buckets.shard_ranges(len(blob), new_world, 1)[rank]
+            assert start == a and data == blob[a:b]
+            slices.append(data)
+        assert b"".join(slices) == blob
+
+
+def test_quorum_validation(tmp_path):
+    """prepare_commit rejects an incomplete quorum and a digest
+    disagreement (the version-consistency proof)."""
+    ck = DistributedCheckpointer(str(tmp_path))
+    blob, reports = _write_cohort(ck, 3, _state(9), world=2)
+    with pytest.raises(ValueError, match="quorum incomplete"):
+        ck.prepare_commit(3, reports[:1])
+    bad = dict(reports[1], blob_sha256="0" * 64)
+    with pytest.raises(ValueError, match="not version-consistent"):
+        ck.prepare_commit(3, [reports[0], bad])
+    assert ck.committed_steps() == []
+
+
+def test_async_capture_nonstalling_and_bounded(tmp_path, monkeypatch):
+    """begin_capture hands off without blocking on the write (stall ≪
+    write time) and declines a third capture while two are staged."""
+    monkeypatch.setenv("MOOLIB_CKPT_WRITE_DELAY", "0.3")
+    ck = DistributedCheckpointer(str(tmp_path))
+    state = _state(10)
+    done = []
+    assert ck.begin_capture(step=1, rank=0, world=1, state=state,
+                            on_done=done.append)
+    assert ck.begin_capture(step=2, rank=0, world=1, state=state,
+                            on_done=done.append)
+    declined_before = _counter("checkpoint_captures_declined_total")
+    assert not ck.begin_capture(step=3, rank=0, world=1, state=state,
+                                on_done=done.append)
+    assert _counter("checkpoint_captures_declined_total") > declined_before
+    deadline = time.time() + 30
+    while len(done) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert [r["step"] for r in done if r] == [1, 2]
+    st = ck.stats()
+    assert st["captures"] == 2
+    # Each write sleeps 0.3 s in the background; the caller's stall must
+    # not include it.
+    assert st["write_s"] >= 0.3
+    assert st["stall_s"] < 0.1
+    ck.close()
+
+
+# ------------------------------------------------------ coordination plane
+
+
+def pump_all(broker, accs):
+    broker.update()
+    for a in accs:
+        a.update()
+        if a.wants_state():
+            a.set_state(dict(STATE))
+        a.checkpoint_tick(state_fn=lambda: dict(STATE))
+
+
+def wait_until(broker, accs, seconds, cond):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        pump_all(broker, accs)
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def make_acc(name, addr, params):
+    a = Accumulator("m", params)
+    a._rpc.set_name(name)
+    a._rpc.set_timeout(10)
+    a._rpc.listen("127.0.0.1:0")
+    a._group.set_timeout(8.0)
+    a.connect(addr)
+    return a
+
+
+def apply_step(a):
+    g = a.gradients()
+    p = a.parameters()
+    a.set_parameters({k: p[k] - LR * g[k] for k in p})
+    a.zero_gradients()
+
+
+def run_rounds(broker, accs, n, seconds=60, history=None):
+    """Drive n applied rounds; when ``history`` is given, record the first
+    peer's parameters at each post-apply model version."""
+    start = {id(a): a.model_version() for a in accs}
+
+    def all_done():
+        done = True
+        for a in accs:
+            if a.has_gradients():
+                apply_step(a)
+                if history is not None and a is accs[0]:
+                    history[a.model_version()] = {
+                        k: v.copy() for k, v in a.parameters().items()
+                    }
+            elif (
+                a.model_version() - start[id(a)] < n and a.wants_gradients()
+            ):
+                a.reduce_gradients(
+                    1, {k: v.copy() for k, v in a.parameters().items()}
+                )
+            if a.model_version() - start[id(a)] < n:
+                done = False
+        return done
+
+    assert wait_until(broker, accs, seconds, all_done), (
+        f"rounds stalled at versions {[a.model_version() for a in accs]}"
+    )
+
+
+def _make_broker(port):
+    addr = f"127.0.0.1:{port}"
+    broker = Broker()
+    broker.set_name("broker")
+    broker.set_timeout(4.0)
+    broker.listen(addr)
+    return broker, addr
+
+
+def test_two_peer_cohort_commits_and_restores(free_port, tmp_path):
+    """The full leader-coordinated protocol: 2 loopback peers, divergent
+    dict insertion order, leader opens epochs, both capture at the target
+    step, the leader two-phase-commits, and the snapshot restores the
+    cohort's parameters bit-exact."""
+    broker, addr = _make_broker(free_port)
+    w = np.arange(256, dtype=np.float32) / 7
+    b = np.ones(8, dtype=np.float32)
+    # Deliberately divergent insertion order: equal versions mean no model
+    # sync overwrites either, so the order difference survives to capture —
+    # only canonical ordering lets the digests agree.
+    a0 = make_acc("pA", addr, {"w": w.copy(), "b": b.copy()})
+    a1 = make_acc("pB", addr, {"b": b.copy(), "w": w.copy()})
+    accs = [a0, a1]
+    ck = [DistributedCheckpointer(str(tmp_path)) for _ in accs]
+    try:
+        assert wait_until(broker, accs, 40,
+                          lambda: all(a.connected() for a in accs))
+        for a, c in zip(accs, ck):
+            a.enable_distributed_checkpoint(c, interval=0.05, lead_steps=1,
+                                            timeout=20.0)
+        aborts0 = _counter("checkpoint_aborts_total")
+        # Keep stepping until a cohort manifest commits, recording the
+        # parameters at every applied version along the way.
+        history = {}
+        deadline = time.time() + 60
+        while ck[0].latest_committed_step() is None and time.time() < deadline:
+            run_rounds(broker, accs, 1, history=history)
+        step = ck[0].latest_committed_step()
+        assert step is not None, "no cohort checkpoint committed"
+        assert _counter("checkpoint_aborts_total") == aborts0
+        # The snapshot must equal the parameters AT the committed version.
+        reader = DistributedCheckpointer(str(tmp_path))
+        got_step, (params, _buffers, st) = reader.restore(step=step)
+        assert got_step == step
+        assert st == STATE
+        assert step in history
+        _assert_tree_equal(params, history[step])
+    finally:
+        broker.close()
+        for a in accs:
+            a.close()
+        for c in ck:
+            c.close()
+
+
+def test_restored_slice_prefills_model_sync(free_port, tmp_path):
+    """Warm rejoin from a shard slice: a joiner that preloads its re-cut
+    byte slice of the leader's sync blob receives those chunks from LOCAL
+    bytes (accum_sync_slice_chunks_total) and still converges bit-exact."""
+    broker, addr = _make_broker(free_port)
+    w = np.arange(16384, dtype=np.float32) / 3
+    leader = make_acc("pL", addr, {"w": w.copy()})
+    leader.set_model_chunk_bytes(1024)
+    accs = [leader]
+    joiner = None
+    try:
+        assert wait_until(broker, accs, 40, lambda: leader.connected())
+        run_rounds(broker, accs, 3)
+        version = leader.model_version()
+        # The leader's sync blob for its current state, computed exactly
+        # the way _sync_chunks does (canonical ordering included).
+        blob = pickle.dumps(
+            checkpoint.canonical_tree(jax.device_get(
+                (leader.parameters(), leader.buffers(), dict(STATE))
+            )),
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        sha16 = hashlib.sha256(blob).hexdigest()[:16]
+        half = len(blob) // 2
+        before = _counter("accum_sync_slice_chunks_total")
+        joiner = make_acc("pJ", addr, {"w": np.zeros_like(w)})
+        joiner.preload_sync_slice(version, sha16, 0, blob[:half], len(blob))
+        accs.append(joiner)
+        assert wait_until(
+            broker, accs, 60,
+            lambda: joiner.model_version() >= version,
+        ), "joiner never synced"
+        assert _counter("accum_sync_slice_chunks_total") > before
+        _assert_tree_equal(joiner.parameters(), leader.parameters())
+    finally:
+        broker.close()
+        for a in accs:
+            a.close()

@@ -50,6 +50,19 @@ bit-exactness vs the legacy plane AND a numpy reference, plus the byte
 ratio bound — single process by default, or one rank per process via
 WORLD_SIZE/RANK/BROKER_ADDR (scripts/ci.sh runs the 2-process form so the
 inter-host byte drop is measured across real process boundaries).
+
+``--overlap`` A/Bs the streaming gradient pipeline (docs/DESIGN.md §6e:
+buckets launch into the inter-host allreduce while backward is still
+producing gradients) against the barrier plane over the same real
+Accumulator cohort.  Each round simulates a ``--compute_ms`` backward that
+delivers gradient leaves tail-first at an even pace; the claim is the
+``exposed_ms`` column — comm left after the LAST gradient is ready — which
+streaming cuts to the final bucket's tail where the barrier arm pays the
+whole allreduce.  ``--overlap --smoke`` is the CI gate: bit-exactness
+streaming vs barrier vs numpy, positive launch lead for every non-final
+bucket (``accum_bucket_launch_lead_seconds``), and exposed comm per step
+<= 0.5x barrier at the 10 MB tree — same WORLD_SIZE/RANK/BROKER_ADDR
+2-process contract as the sharded smoke.
 """
 
 from __future__ import annotations
@@ -578,6 +591,287 @@ def bench_sharded_smoke(args):
     )
 
 
+def _overlap_trees(world_size, size, n_leaves=8):
+    """Deterministic integer-valued multi-leaf gradient trees for the
+    overlap arm: the streaming pipeline needs several leaves so the paced
+    backward has buckets to launch early.  Zero-padded keys keep the dict
+    flatten order equal to build order; integer values keep every summation
+    order bit-exact."""
+    trees = []
+    for r in range(world_size):
+        rng = np.random.default_rng(1000 + r)
+        tree, left, i = {}, size, 0
+        per = max(1, size // n_leaves)
+        while left > 0:
+            n = left if i >= n_leaves - 1 else min(per, left)
+            tree[f"g{i:02d}"] = rng.integers(-32, 33, n).astype(np.float32)
+            left -= n
+            i += 1
+        trees.append(tree)
+    return trees
+
+
+def _overlap_round(cohort, local_trees, compute_s, streaming):
+    """One gradient round with a simulated backward of ``compute_s``
+    seconds.  Barrier arm: every gradient materializes only at the end of
+    backward, then the whole allreduce runs exposed.  Streaming arm: leaves
+    are delivered tail-first at an even pace across the backward window
+    (the readiness order reverse-mode AD produces) and buckets launch
+    mid-backward; only what remains after the LAST delivery is exposed.
+    Returns ``(outs, exposed_s)`` where exposed = wall seconds from
+    backward-end (last leaf ready) to the cohort result landing."""
+    import threading
+
+    import jax.tree_util as jtu
+
+    import moolib_tpu.buckets as buckets
+
+    reducers = []
+    t_bw_end = [0.0]
+    if streaming:
+        lock = threading.Lock()
+
+        def produce(stream, leaves):
+            pace = compute_s / max(1, len(leaves))
+            for i in range(len(leaves) - 1, -1, -1):
+                time.sleep(pace)
+                stream.deliver(i, [leaves[i]])
+            with lock:
+                t_bw_end[0] = max(t_bw_end[0], time.perf_counter())
+
+        for a, t in zip(cohort.accs, local_trees):
+            leaves, treedef = jtu.tree_flatten(t)
+            # Host leaves are declared explicitly unsharded so a cold cache
+            # streams instead of falling back to a barrier round (the
+            # sharded plane's layout is signature-guarded).
+            stream = buckets.GradientStream(
+                treedef,
+                [l.shape for l in leaves],
+                [l.dtype for l in leaves],
+                shardings=[None] * len(leaves),
+            )
+            threading.Thread(
+                target=produce, args=(stream, leaves), daemon=True
+            ).start()
+            th = threading.Thread(target=a.reduce_gradients, args=(1, stream))
+            th.start()
+            reducers.append(th)
+    else:
+        time.sleep(compute_s)  # simulated backward: grads ready only at the end
+        t_bw_end[0] = time.perf_counter()
+        for a, t in zip(cohort.accs, local_trees):
+            a.reduce_gradients(1, t)
+    deadline = time.time() + 120
+    while not all(a.has_gradients() for a in cohort.accs):
+        assert time.time() < deadline, "overlap gradient round wedged"
+        cohort.pump()
+        time.sleep(0.001)
+    t_done = time.perf_counter()
+    for th in reducers:
+        th.join(120)
+    outs = [
+        {k: np.asarray(v) for k, v in a.gradients().items()} for a in cohort.accs
+    ]
+    for a in cohort.accs:
+        a.zero_gradients()
+    return outs, max(0.0, t_done - t_bw_end[0])
+
+
+def _overlap_measure(cohort, local, compute_s, iters, streaming):
+    """Warmup (layouts, codecs, transport upgrades) then median-of-iters
+    round wall time and exposed comm for one arm."""
+    _overlap_round(cohort, local, min(compute_s, 0.05), streaming)
+    times, exps, outs = [], [], None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        outs, e = _overlap_round(cohort, local, compute_s, streaming)
+        times.append(time.perf_counter() - t0)
+        exps.append(e)
+    return outs, statistics.median(times), statistics.median(exps)
+
+
+def _overlap_banner(streaming, n, compute_ms):
+    arm = "streaming" if streaming else "barrier"
+    return (
+        f"# accum grad rounds ({arm} arm, overlap A/B), {n} hosts, loopback "
+        f"(simulated backward {compute_ms:.0f} ms; exposed_ms = comm left "
+        f"after the last gradient leaf is ready)"
+    )
+
+
+_OVERLAP_HEADER = (
+    f"{'elems':>10} {'MB':>8} {'round_ms':>9} {'exposed_ms':>11} {'MB/s':>10}"
+)
+
+
+def _overlap_row(size, dt, exposed):
+    mb = size * 4 / 1e6
+    return (
+        f"{size:>10} {mb:>8.2f} {dt * 1e3:>9.2f} {exposed * 1e3:>11.2f} "
+        f"{mb / dt:>10.1f}"
+    )
+
+
+def bench_overlap(args):
+    """A/B rows: barrier vs streaming gradient rounds over a real
+    Accumulator cohort with a simulated backward window (docs/DESIGN.md
+    §6e).  The claim is the exposed_ms column: the streaming arm launches
+    each bucket's inter-host reduce as soon as backward fills it, so only
+    the tail of the allreduce remains after the last gradient is ready,
+    where the barrier arm pays the whole allreduce after backward.  Rows
+    are banner-keyed so fold_capture merges fresh captures over stale ones
+    without clobbering the tree/ring/sharded sections."""
+    import moolib_tpu.buckets as buckets
+
+    buckets.set_bucket_bytes(args.bucket_bytes or (1 << 20))
+    cohort = _AccumCohort(args, {"g": np.zeros(8, np.float32)})
+    cohort.converge()
+    n = cohort.world_size
+    compute_s = args.compute_ms / 1e3
+
+    def run_rows(streaming):
+        print(_overlap_banner(streaming, n, args.compute_ms))
+        print(_OVERLAP_HEADER)
+        exposed = {}
+        for size in args.sizes:
+            trees = _overlap_trees(n, size)
+            local = [trees[i] for i in cohort.local_ranks]
+            _, dt, ex = _overlap_measure(
+                cohort, local, compute_s, args.iters, streaming
+            )
+            print(_overlap_row(size, dt, ex))
+            exposed[size] = ex
+        return exposed
+
+    barrier = run_rows(False)
+    stream = run_rows(True)
+    print(
+        "# streaming/barrier exposed comm per step "
+        "(<= 0.5 at the 10 MB tree is the DESIGN.md 6e acceptance bound)"
+    )
+    print(f"{'elems':>10} {'ratio':>8}")
+    for size in args.sizes:
+        if barrier[size] > 0:
+            print(f"{size:>10} {stream[size] / barrier[size]:>8.3f}")
+    cohort.close()
+
+
+def bench_overlap_smoke(args):
+    """CI gate for the streaming gradient pipeline (docs/DESIGN.md §6e) at
+    the 10 MB acceptance point: streaming and barrier rounds over the SAME
+    contributions must be bit-identical to each other and to the numpy
+    reference; the streaming round must really have streamed (every
+    non-final bucket launched with positive lead —
+    ``accum_bucket_launch_lead_seconds`` > 0); and the exposed comm per
+    step must come in at <= 0.5x the barrier arm.  Prints the measured A/B
+    rows banner-keyed (same shape as the sweep) so the smoke log folds and
+    gates like every other capture.  In multi-process mode every rank gates
+    its OWN exposure and leads, so the 2-process form proves the cut across
+    real process boundaries."""
+    import moolib_tpu.buckets as buckets
+
+    buckets.set_bucket_bytes(args.bucket_bytes or (1 << 20))
+    cohort = _AccumCohort(args, {"g": np.zeros(8, np.float32)})
+    cohort.converge()
+    n = cohort.world_size
+    size = 2_621_440  # 10 MB of f32 — the acceptance point
+    compute_s = args.compute_ms / 1e3
+    trees = _overlap_trees(n, size)
+    local = [trees[i] for i in cohort.local_ranks]
+    # Mirror the accumulator's averaging expression (f32 total / python int)
+    # so the reference check is bit-exact, not approximate.
+    ref = {
+        k: np.sum(
+            np.stack([t[k] for t in trees]), axis=0, dtype=np.float64
+        ).astype(np.float32) / n
+        for k in trees[0]
+    }
+    fails = []
+
+    barrier_outs, barrier_dt, barrier_ex = _overlap_measure(
+        cohort, local, compute_s, args.iters, streaming=False
+    )
+    for a in cohort.accs:
+        # Cleared so a silent fallback to the barrier path (which never
+        # records launch leads) is caught below, not masked by the warmup.
+        a._last_launch_leads = None
+    stream_outs, stream_dt, stream_ex = _overlap_measure(
+        cohort, local, compute_s, args.iters, streaming=True
+    )
+
+    print(_overlap_banner(False, n, args.compute_ms))
+    print(_OVERLAP_HEADER)
+    print(_overlap_row(size, barrier_dt, barrier_ex))
+    print(_overlap_banner(True, n, args.compute_ms))
+    print(_OVERLAP_HEADER)
+    print(_overlap_row(size, stream_dt, stream_ex))
+
+    for tag, outs in (("barrier", barrier_outs), ("streaming", stream_outs)):
+        for o in outs:
+            if any(o[k].tobytes() != ref[k].tobytes() for k in ref):
+                fails.append(f"{tag}: not bit-exact vs numpy reference")
+                break
+    for bo, so in zip(barrier_outs, stream_outs):
+        if any(bo[k].tobytes() != so[k].tobytes() for k in ref):
+            fails.append("streaming differs bit-wise from barrier")
+            break
+    max_lead = 0.0
+    for rank, a in zip(cohort.local_ranks, cohort.accs):
+        leads = getattr(a, "_last_launch_leads", None)
+        if not leads:
+            fails.append(
+                f"rank{rank}: no bucket launch leads recorded — the round "
+                f"fell back to the barrier path instead of streaming"
+            )
+            continue
+        # Leads are t_final_launch - t_launch: the FINAL bucket is the one
+        # with lead exactly 0 (the smallest); every other bucket must have
+        # launched strictly earlier.
+        nonfinal = sorted(leads)[1:]
+        if len(leads) < 2:
+            fails.append(f"rank{rank}: only {len(leads)} bucket(s) launched")
+        elif min(nonfinal) <= 0.0:
+            fails.append(
+                f"rank{rank}: a non-final bucket launched with zero lead "
+                f"(leads={['%.3f' % l for l in sorted(leads)]})"
+            )
+        elif max(leads) < compute_s / 2:
+            fails.append(
+                f"rank{rank}: max launch lead {max(leads) * 1e3:.1f} ms < "
+                f"half the backward window — buckets are not launching "
+                f"mid-backward"
+            )
+        max_lead = max(max_lead, max(leads))
+    if barrier_ex <= 0:
+        fails.append(f"barrier exposed comm did not register ({barrier_ex})")
+    elif stream_ex > 0.5 * barrier_ex:
+        fails.append(
+            f"exposed comm per step ratio {stream_ex / barrier_ex:.3f} > "
+            f"acceptance bound 0.500 "
+            f"(streaming {stream_ex * 1e3:.2f} ms vs barrier "
+            f"{barrier_ex * 1e3:.2f} ms)"
+        )
+    cohort.close()
+    if fails:
+        for f in fails:
+            print("SMOKE FAIL:", f)
+        raise SystemExit(1)
+    print(
+        f"smoke: streaming allreduce bit-exact vs barrier and numpy "
+        f"reference ({n} hosts, {size * 4 / 1e6:.1f} MB tree)"
+    )
+    print(
+        f"smoke: exposed comm per step streaming {stream_ex * 1e3:.2f} ms vs "
+        f"barrier {barrier_ex * 1e3:.2f} ms "
+        f"(ratio {stream_ex / barrier_ex:.3f} <= 0.500)"
+    )
+    print(
+        f"smoke: every non-final bucket launched with positive lead "
+        f"(max lead {max_lead * 1e3:.1f} ms of a {args.compute_ms:.0f} ms "
+        f"backward window)"
+    )
+
+
 def bench_ici(args):
     import jax
     import jax.numpy as jnp
@@ -667,6 +961,16 @@ def main(argv=None):
                    "a real Accumulator cohort; with --smoke, gate "
                    "bit-exactness vs numpy and the per-host byte ratio "
                    "instead of printing sweep rows")
+    p.add_argument("--overlap", action="store_true",
+                   help="A/B the streaming gradient pipeline (DESIGN.md "
+                   "§6e) against the barrier plane over a real Accumulator "
+                   "cohort with a simulated backward window; with --smoke, "
+                   "gate bit-exactness, bucket launch leads, and the "
+                   "exposed-comm-per-step cut at the 10 MB tree")
+    p.add_argument("--compute_ms", type=float, default=300.0,
+                   help="simulated backward window for the --overlap arm "
+                   "(gradient leaves are delivered tail-first at an even "
+                   "pace across it)")
     p.add_argument(
         "--sizes",
         type=int,
@@ -674,7 +978,11 @@ def main(argv=None):
         default=[400, 10_000, 100_000, 1_000_000, 2_621_440],
     )
     args = p.parse_args(argv)
-    if args.sharded and args.smoke:
+    if args.overlap and args.smoke:
+        bench_overlap_smoke(args)
+    elif args.overlap:
+        bench_overlap(args)
+    elif args.sharded and args.smoke:
         bench_sharded_smoke(args)
     elif args.sharded:
         bench_sharded(args)

@@ -149,6 +149,13 @@ _M_D2H_OVERLAP = _REG.histogram(
     "device-to-host transfer time overlapped with bucket staging (async "
     "copy_to_host issued for every leaf before the first bucket fills)",
 )
+_M_LAUNCH_LEAD = _REG.histogram(
+    "accum_bucket_launch_lead_seconds",
+    "how early each streamed bucket's wire op launched before the final "
+    "bucket's launch (the barrier point a non-streaming round would have "
+    "fired at): 0 for the last bucket, > 0 for every earlier one while the "
+    "streaming gradient pipeline is hiding comm under the backward tail",
+)
 # Sharded hierarchical reduce (docs/DESIGN.md §6d): per-kind inter-host
 # bytes (the reduce-scatter contribution vs the owned-shard redistribution),
 # the fraction of the payload this host owns, and the wall time of the
@@ -845,18 +852,21 @@ class Accumulator:
             self._flat_layouts[key] = layout
         return layout
 
-    def _sharded_flat_layout(self, treedef, shapes, dtype, leaves):
+    def _sharded_flat_layout(self, treedef, shapes, dtype, shardings):
         """Shard-pinned layout for the sharded reduce plane, cached per
         (treedef, shapes, dtype, bucket size) and GUARDED by the gradient
         tree's sharding signature: a later call whose leaves carry a
         different device sharding raises :class:`GradientShardingError` —
         the layout is cohort wire protocol, so a silent re-layout (or a
         silent fall-back to full-tree payloads) would desync op shapes
-        across hosts mid-epoch."""
+        across hosts mid-epoch.  ``shardings`` is the flat per-leaf list
+        (``None`` entries for host/replicated leaves) — callers with leaves
+        in hand pass their ``.sharding`` attributes; the streaming path
+        passes the stream's declared shardings."""
         key = (treedef, tuple(shapes), np.dtype(dtype).str, buckets.bucket_bytes())
         sig = tuple(
-            buckets.sharding_signature(s, getattr(l, "sharding", None))
-            for s, l in zip(shapes, leaves)
+            buckets.sharding_signature(s, sh)
+            for s, sh in zip(shapes, shardings)
         )
         layout = self._sharded_layouts.get(key)
         if layout is not None:
@@ -871,8 +881,7 @@ class Accumulator:
                 )
             return layout
         layout = buckets.BucketLayout.from_shardings(
-            treedef, shapes,
-            [getattr(l, "sharding", None) for l in leaves], dtype,
+            treedef, shapes, list(shardings), dtype,
         )
         self._sharded_layouts[key] = layout
         return layout
@@ -926,7 +935,8 @@ class Accumulator:
         t_fill = time.monotonic()
         if sharded:
             layout = self._sharded_flat_layout(
-                treedef, [s for s, _ in specs], stage_dtype, leaves
+                treedef, [s for s, _ in specs], stage_dtype,
+                [getattr(l, "sharding", None) for l in leaves],
             )
         else:
             layout = self._flat_layout(treedef, [s for s, _ in specs], stage_dtype)
@@ -1057,6 +1067,335 @@ class Accumulator:
                     time.monotonic() - round_.t0, plane=round_.plane
                 )
             self._drain_rounds_locked()
+
+    # ---------------------------------------------- streaming reduce (§6e)
+    def _materialize_stream(self, stream):
+        """Collect every chunk of a GradientStream and rebuild the full
+        gradient pytree — the fall-back whenever a stream arrives on a path
+        that needs the whole tree at once (ICI plane, virtual batching,
+        chunked ring, legacy payloads): bit-identical to a barrier
+        contribution, just without the launch lead."""
+        leaves = [None] * stream.n_leaves
+        timeout = getattr(self._group, "_timeout", 60.0)
+        while True:
+            chunk = stream.next_chunk(timeout)
+            if chunk is None:
+                break
+            lo, ls = chunk
+            leaves[lo:lo + len(ls)] = ls
+        return jax.tree_util.tree_unflatten(stream.treedef, leaves)
+
+    def _streaming_layout(self, stream):
+        """(layout, stage_dtype, treedef) for a streaming round, or None
+        when the stream cannot take the streaming path (mixed dtypes without
+        wire compression; sharded plane without sharding info on a cold
+        layout cache) — the caller then materializes and runs the barrier
+        path, which is bit-identical."""
+        treedef = stream.treedef
+        specs = list(zip(stream.shapes, stream.dtypes))
+        if not specs:
+            return None
+        stage_dtype = self._flat_stage_dtype(treedef, specs, ring=False)
+        if stage_dtype is None:
+            return None
+        shapes = [s for s, _ in specs]
+        if self._sharded:
+            if stream.shardings is not None:
+                layout = self._sharded_flat_layout(
+                    treedef, shapes, stage_dtype, stream.shardings
+                )
+            else:
+                key = (treedef, tuple(shapes), np.dtype(stage_dtype).str,
+                       buckets.bucket_bytes())
+                layout = self._sharded_layouts.get(key)
+                if layout is None:
+                    # No sharding info and no prior round to key the wire
+                    # layout off: establish it via one barrier round first.
+                    return None
+        else:
+            layout = self._flat_layout(treedef, shapes, stage_dtype)
+        return layout, stage_dtype, treedef
+
+    def _plan_streaming_round_locked(self, stats, flat, layout, treedef):
+        """Issue the wire scaffolding of one streaming round under the lock
+        and return the launch plan: ``units`` (element range -> launch
+        closure, in flat order), ``finish`` (after the last launch) and
+        ``abort`` (error the round loudly from the staging side).  Returns
+        None when the contribution is dropped (not connected — elastic
+        semantics, same as the barrier paths)."""
+        if not self.connected():
+            utils.log_verbose(
+                "accumulator %s: dropping gradient contribution (not connected)",
+                self._name,
+            )
+            return None
+        if len(self._inflight) >= self._parallel_gradients:
+            raise RpcError(
+                f"{len(self._inflight)} gradient reductions already in flight "
+                f"(parallel_gradients={self._parallel_gradients})"
+            )
+        if self._has_gradients:
+            raise RpcError("unconsumed gradients; call zero_gradients() first")
+        if self._wire_q8:
+            wire = "q8"
+        elif self._wire_dtype is not None:
+            wire = np.dtype(self._wire_dtype).name
+        else:
+            wire = None
+        item = 1 if wire == "q8" else (
+            np.dtype(wire).itemsize if wire else layout.dtype.itemsize
+        )
+        members = list(self._group.members())
+        me = self._rpc.get_name()
+        n = len(members)
+        units = []
+        if self._sharded and n > 1 and me in members:
+            # Sharded hierarchical round, streamed: every non-owned range is
+            # its own bucketed STREAM (its sub-ops launch bucket by bucket as
+            # the range stages); the owner's scatter op is deferred until its
+            # own range is staged (the scatter callback folds the local
+            # slice — issuing early could let the op resolve against a
+            # half-staged slice).  Gathers are issued up front exactly like
+            # the barrier path: they contribute nothing.
+            rank = members.index(me)
+            ranges = buckets.shard_ranges(layout.total, n, layout.bucket_elems)
+            nonempty = [g for g, (gs, ge) in enumerate(ranges) if ge > gs]
+            sr = _ShardedRound(
+                rank, ranges, layout, treedef, flat, dict(stats),
+                meta_group=nonempty[0], wire=wire, item=item,
+                remaining=len(nonempty),
+            )
+            round_ = _Round(None, kind="full")
+            sr.round = round_
+            own = ranges[rank]
+            _M_SHARD_FRACTION.set(
+                (own[1] - own[0]) / layout.total if layout.total else 0.0,
+                accumulator=self._name, peer=me,
+            )
+            _M_BUCKET_ROUNDS.inc(plane="rpc")
+            self._inflight.append(round_)
+            sync0 = self._group.sync_id()
+            handles = []
+            for g in nonempty:
+                gs, ge = ranges[g]
+                if g == rank:
+                    def _launch_owner(sr=sr, gs=gs, ge=ge, sync0=sync0):
+                        with self._lock:
+                            if self._group.sync_id() != sync0:
+                                raise RpcError(
+                                    f"streaming sharded round {self._name}: "
+                                    "group changed with buckets in flight"
+                                )
+                            template = np.broadcast_to(
+                                np.zeros((), sr.layout.dtype), (ge - gs,)
+                            )
+                            fut = self._group.all_reduce(
+                                f"__accum_sg{sr.rank}:{self._name}", None,
+                                op="sum", wire=sr.wire, bucketed=True,
+                                template=template, owned=True,
+                            )
+                            fut.add_done_callback(
+                                lambda f, sr=sr: self._on_shard_scatter_done(sr, f)
+                            )
+                        return fut
+
+                    units.append({"s": gs, "e": ge, "fire": _launch_owner})
+                    continue
+                handle = self._group.bucketed_stream(
+                    f"__accum_sg{g}:{self._name}", flat[gs:ge], wire=wire,
+                )
+                handles.append(handle)
+                nb = (ge - gs) * item
+                self._reduce_bytes["rpc"] += nb
+                _M_REDUCE_BYTES.inc(nb, plane="rpc")
+                _M_BUCKET_BYTES.inc(nb, plane="rpc")
+                _M_INTERHOST.inc(nb, kind="grad")
+                _M_BUCKETS.inc(len(handle.bounds), plane="rpc")
+                for k, (bs, be) in enumerate(handle.bounds):
+                    units.append({
+                        "s": gs + bs, "e": gs + be,
+                        "fire": (lambda h=handle, k=k: h.launch(k)),
+                    })
+            for g in nonempty:
+                if g == rank:
+                    continue
+                gs, ge = ranges[g]
+                template = np.broadcast_to(np.zeros((), layout.dtype), (ge - gs,))
+                kw = dict(op="sum", wire=wire, bucketed=True,
+                          template=template, owned=True)
+                if g == sr.meta_group:
+                    kw.update(meta=dict(stats), meta_op=_count_reduce_op)
+                gfut = self._group.all_reduce(
+                    f"__accum_pg{g}:{self._name}", None, **kw
+                )
+                sr.gather[g] = gfut
+                gfut.add_done_callback(
+                    lambda f, sr=sr, g=g: self._on_shard_gather_done(sr, g, f)
+                )
+
+            def _abort(err, sr=sr, handles=handles):
+                for h in handles:
+                    h.abort(err)
+                with self._lock:
+                    sr.err = sr.err or err
+                    round_ = sr.round
+                    if not round_.done:
+                        buckets.release(sr.flat)
+                        sr.flat = None
+                        round_.done = True
+                        round_.error = err
+                        self._drain_rounds_locked()
+
+            return {"units": units, "finish": (lambda: None), "abort": _abort}
+        # Plain tree round, streamed: ONE bucketed stream over the whole
+        # flat payload — identical wire protocol to the barrier tree path
+        # (same parent seq, same per-bucket sub-op names), only launch times
+        # differ, so streaming and barrier peers interoperate in one round.
+        handle = self._group.bucketed_stream(
+            f"__accum_grad:{self._name}", flat,
+            meta=dict(stats), meta_op=_count_reduce_op, wire=wire,
+        )
+        round_ = _Round(handle.future, kind="full")
+        nb = layout.total * item
+        self._reduce_bytes["rpc"] += nb
+        _M_REDUCE_BYTES.inc(nb, plane="rpc")
+        _M_BUCKET_BYTES.inc(nb, plane="rpc")
+        _M_INTERHOST.inc(nb, kind="grad")
+        _M_BUCKET_ROUNDS.inc(plane="rpc")
+        _M_BUCKETS.inc(len(handle.bounds), plane="rpc")
+        self._inflight.append(round_)
+        handle.future.add_done_callback(
+            lambda f, r=round_, td=treedef, lo=layout:
+                self._on_flat_round_done(r, f, td, lo, None)
+        )
+        for k, (bs, be) in enumerate(handle.bounds):
+            units.append({
+                "s": bs, "e": be,
+                "fire": (lambda h=handle, k=k: h.launch(k)),
+            })
+        return {"units": units, "finish": handle.finish, "abort": handle.abort}
+
+    def _reduce_gradients_streaming(self, stats, stream) -> bool:
+        """Stage a GradientStream bucket by bucket and launch each bucket's
+        wire op the moment its slice is staged (docs/DESIGN.md §6e): the
+        inter-host reduce overlaps the backward tail instead of waiting for
+        the full-tree barrier.  Bit-exactness contract: fills, EF-q8 (per
+        bucket, independent absmax + residual slices) and fold order are
+        identical to the barrier path, so streaming == barrier to the bit.
+        Returns False when the stream must fall back (caller materializes
+        and takes the barrier path)."""
+        picked = self._streaming_layout(stream)
+        if picked is None:
+            return False
+        layout, stage_dtype, treedef = picked
+        flat = buckets.lease(layout.total, stage_dtype)
+        try:
+            with self._lock:
+                plan = self._plan_streaming_round_locked(
+                    stats, flat, layout, treedef)
+        except Exception:
+            buckets.release(flat)
+            raise
+        if plan is None:
+            buckets.release(flat)
+            return True  # dropped (not connected) — elastic semantics
+        units = plan["units"]
+        timeout = getattr(self._group, "_timeout", 60.0)
+        filled = buckets.Coverage()       # staged element ranges
+        fin = buckets.Coverage()          # staged AND quantized: launchable
+        finalized = [False] * layout.n_buckets
+        launch_order = []                 # unit indices in launch order
+        t0 = time.monotonic()
+        d2h = 0
+        fill_s = 0.0
+        tl = telemetry.timeline
+
+        def _launch(i):
+            u = units[i]
+            mark = tl.comm_mark()
+            cf = u["fire"]()
+            u["t"] = time.monotonic()
+            launch_order.append(i)
+            if cf is not None and mark is not None:
+                # Retroactive per-bucket comm span: launch -> sub-op
+                # completion.  Overlap attribution (timeline.ingest_window)
+                # unions these against the step's compute span, so wire time
+                # hidden under backward lands in overlapped_comm_seconds.
+                cf.add_done_callback(
+                    lambda f, m=mark: tl.comm_interval("accum.stream_bucket", m)
+                )
+
+        try:
+            while True:
+                chunk = stream.next_chunk(timeout)
+                if chunk is None:
+                    break
+                lo, leaves = chunk
+                # D2H for EVERY leaf of the group before its first bucket
+                # fill (the producer already issued these at deliver();
+                # repeat is a cheap no-op and keeps the ordering contract
+                # local to the stager, where _M_D2H_OVERLAP measures it).
+                for leaf in leaves:
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+                        d2h += 1
+                tf = time.monotonic()
+                for i, leaf in enumerate(leaves, start=lo):
+                    off, sz = layout.offsets[i], layout.sizes[i]
+                    src = np.asarray(leaf)
+                    np.copyto(flat[off:off + sz], src.reshape(-1),
+                              casting="unsafe")
+                    filled.add(off, off + sz)
+                # Finalize every layout bucket the chunk completed: EF-q8
+                # runs per bucket (independent absmax + residual slice, so
+                # quantizing in readiness order is bit-identical to the
+                # barrier's one-pass quantization), then any wire unit whose
+                # range is fully finalized launches.
+                for k, (bs, be) in enumerate(layout.bounds):
+                    if finalized[k] or not filled.covers(bs, be):
+                        continue
+                    if self._wire_q8:
+                        residual = (
+                            self._q_residual
+                            if isinstance(self._q_residual, np.ndarray)
+                            else None
+                        )
+                        self._q_residual = buckets.ef_quantize_flat(
+                            flat, residual, [(bs, be)]
+                        )
+                    finalized[k] = True
+                    fin.add(bs, be)
+                    if stream.on_bucket is not None:
+                        try:
+                            stream.on_bucket(bs, be)
+                        except Exception:  # noqa: BLE001 — telemetry hook
+                            pass
+                    for i, u in enumerate(units):
+                        if "t" not in u and fin.covers(u["s"], u["e"]):
+                            _launch(i)
+                fill_s += time.monotonic() - tf
+            for i, u in enumerate(units):
+                if "t" not in u:
+                    # Zero-length units (empty ranges) or anything the
+                    # coverage maths left behind launches at the barrier
+                    # point — lead 0, never a wedge.
+                    _launch(i)
+        except BaseException as e:
+            plan["abort"](
+                e if isinstance(e, (RpcError, GradientShardingError))
+                else RpcError(f"streaming gradient round failed: {e!r}")
+            )
+            raise
+        t_final = max((units[i]["t"] for i in launch_order), default=t0)
+        leads = [max(0.0, t_final - u["t"]) for u in units]
+        for lead in leads:
+            _M_LAUNCH_LEAD.observe(lead)
+        self._last_launch_leads = leads
+        plan["finish"]()
+        _M_BUCKET_FILL.observe(fill_s)
+        if d2h:
+            _M_D2H_OVERLAP.observe(time.monotonic() - t0)
+        return True
 
     def _start_sharded_round(self, kind: str, stats: Dict[str, int], staged,
                              fire_stats=None) -> None:
@@ -1242,6 +1581,10 @@ class Accumulator:
         per-range true sums (every range's bytes arrived via the share-down,
         so the assembly is host copies only) and hand the round to the
         shared drain logic."""
+        if sr.round.done:
+            # Streaming abort already errored the round; late gather
+            # callbacks just drain into it.
+            return
         buckets.release(sr.flat)
         round_ = sr.round
         norm = None
@@ -1930,6 +2273,16 @@ class Accumulator:
         wire per contribution; gradients accumulate locally in f32 and ship in
         ONE allreduce once the global count meets ``virtual_batch_size``
         (reference two-phase protocol, ``src/accumulator.cc:1005-1078``).
+
+        ``gradients`` may also be a :class:`moolib_tpu.buckets.GradientStream`
+        (the streaming gradient pipeline, docs/DESIGN.md §6e — produced by
+        ``make_train_step(overlap_grads=True)``): buckets stage and launch
+        onto the wire as the producer delivers leaf groups, overlapping the
+        inter-host reduce with the backward tail.  Streaming is bit-exact
+        with the equivalent barrier contribution and interoperates with
+        barrier peers in the same round; paths that need the whole tree at
+        once (ICI, virtual batching, chunked ring) materialize the stream
+        transparently.
         """
         if gradients is None:
             raise ValueError(
@@ -1948,6 +2301,22 @@ class Accumulator:
     def _reduce_gradients_traced(self, batch_size: int, gradients) -> None:
         self._rec_note_first_reduce()
         stats = {"num_gradients": 1, "num_skipped": 0, "batch_size": int(batch_size)}
+        if isinstance(gradients, buckets.GradientStream):
+            # Streaming gradient pipeline (docs/DESIGN.md §6e): stage and
+            # launch wire buckets as the producer delivers leaf groups.
+            # Paths that need the whole tree at once (ICI psum, virtual
+            # batching, the chunked ring, legacy payloads) materialize the
+            # stream and fall through — bit-identical, just barrier-timed.
+            stream = gradients
+            if (
+                self._bucketed
+                and not self._ici_eligible()
+                and self._virtual_batch_size is None
+                and not self._use_ring_locked()
+                and self._reduce_gradients_streaming(stats, stream)
+            ):
+                return
+            gradients = self._materialize_stream(stream)
         if self._ici_eligible():
             # ICI data plane: one synchronous XLA psum over the mesh; wire
             # compression and the two-phase count protocol are DCN

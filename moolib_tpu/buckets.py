@@ -284,6 +284,149 @@ def release(arr: Optional[np.ndarray]) -> None:
             free.append(arr)
 
 
+# ------------------------------------------------------------- streaming
+class Coverage:
+    """Merged-interval set over element positions — the streaming stager's
+    ledger of which flat ranges are staged.  ``add`` merges; ``covers`` asks
+    whether one range is fully inside the covered set.  Pure bookkeeping
+    (no locking — callers serialize)."""
+
+    __slots__ = ("_iv",)
+
+    def __init__(self):
+        self._iv: List[Tuple[int, int]] = []
+
+    def add(self, start: int, stop: int) -> None:
+        if stop <= start:
+            return
+        iv = self._iv
+        out: List[Tuple[int, int]] = []
+        s, e = int(start), int(stop)
+        for a, b in iv:
+            if b < s or a > e:
+                out.append((a, b))
+            else:
+                s, e = min(s, a), max(e, b)
+        out.append((s, e))
+        out.sort()
+        self._iv = out
+
+    def covers(self, start: int, stop: int) -> bool:
+        if stop <= start:
+            return True
+        for a, b in self._iv:
+            if a <= start and stop <= b:
+                return True
+        return False
+
+
+class GradientStream:
+    """Incrementally delivered gradient pytree — the producer/consumer
+    handoff of the streaming gradient pipeline (docs/DESIGN.md §6e).
+
+    The producer (the two-jit overlap train step, or any caller that knows
+    leaf readiness order) constructs the stream with the FULL tree structure
+    up front — ``treedef`` (opaque here; the Accumulator unflattens with
+    it), per-leaf ``shapes``/``dtypes``, and optionally the flat list of
+    per-leaf device ``shardings`` (required for streaming onto the sharded
+    reduce plane, whose layout is signature-guarded) — then calls
+    :meth:`deliver` once per contiguous leaf group, in expected readiness
+    order (backward produces LATE layers first, so the tail of the flatten
+    order usually arrives before the head).  ``deliver`` issues
+    ``copy_to_host_async`` for every leaf of the group before handing it to
+    the consumer, so D2H transfer for the whole group overlaps the
+    consumer's bucket fills.
+
+    The consumer (``Accumulator.reduce_gradients``) blocks on
+    :meth:`next_chunk` and stages/launches wire buckets as ranges complete.
+    ``on_bucket`` (settable attribute) is the per-bucket ready callback
+    surfaced to the caller: invoked as ``on_bucket(start, stop)`` (element
+    range of the staged flat buffer) each time a bucket finishes staging —
+    exceptions are swallowed (telemetry-grade hook, never round-fatal).
+
+    Thread-safe: deliver/fail from any thread; one consumer.
+    """
+
+    __slots__ = (
+        "treedef", "shapes", "dtypes", "shardings", "on_bucket",
+        "_cond", "_chunks", "_delivered", "_err", "n_leaves",
+    )
+
+    def __init__(self, treedef, shapes: Sequence[Tuple[int, ...]],
+                 dtypes: Sequence, shardings: Optional[Sequence] = None,
+                 on_bucket=None):
+        self.treedef = treedef
+        self.shapes = tuple(tuple(int(d) for d in s) for s in shapes)
+        self.dtypes = tuple(np.dtype(d) for d in dtypes)
+        if len(self.shapes) != len(self.dtypes):
+            raise ValueError("GradientStream: shapes/dtypes length mismatch")
+        if shardings is not None and len(shardings) != len(self.shapes):
+            raise ValueError("GradientStream: shardings length mismatch")
+        self.shardings = list(shardings) if shardings is not None else None
+        self.on_bucket = on_bucket
+        self.n_leaves = len(self.shapes)
+        self._cond = threading.Condition()
+        self._chunks: List[Tuple[int, list]] = []  # queued, not yet consumed
+        self._delivered = [False] * self.n_leaves
+        self._err: Optional[BaseException] = None
+
+    def deliver(self, lo: int, leaves: Sequence) -> None:
+        """Hand the consumer leaves ``lo .. lo+len(leaves)`` (flatten-order
+        indices).  Each leaf index must be delivered exactly once; issues
+        ``copy_to_host_async`` per leaf (legal on not-yet-ready jax arrays)
+        before publication."""
+        leaves = list(leaves)
+        lo = int(lo)
+        if lo < 0 or lo + len(leaves) > self.n_leaves:
+            raise ValueError(
+                f"GradientStream.deliver: leaves [{lo}, {lo + len(leaves)}) "
+                f"outside [0, {self.n_leaves})"
+            )
+        for leaf in leaves:
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        with self._cond:
+            for i in range(lo, lo + len(leaves)):
+                if self._delivered[i]:
+                    raise ValueError(
+                        f"GradientStream.deliver: leaf {i} delivered twice"
+                    )
+                self._delivered[i] = True
+            self._chunks.append((lo, leaves))
+            self._cond.notify_all()
+
+    def fail(self, err: BaseException) -> None:
+        """Producer died (e.g. the backward jit raised): wake the consumer
+        with the error instead of wedging it on next_chunk."""
+        with self._cond:
+            self._err = err
+            self._cond.notify_all()
+
+    @property
+    def complete(self) -> bool:
+        with self._cond:
+            return all(self._delivered)
+
+    def next_chunk(self, timeout: Optional[float] = None):
+        """Blocking: the next delivered ``(lo, leaves)`` group, or ``None``
+        once every leaf was consumed.  Raises the producer's failure, or
+        ``TimeoutError`` when nothing arrives in ``timeout`` seconds."""
+        with self._cond:
+            while True:
+                if self._err is not None:
+                    raise self._err
+                if self._chunks:
+                    return self._chunks.pop(0)
+                if all(self._delivered):
+                    return None
+                if not self._cond.wait(timeout):
+                    raise TimeoutError(
+                        "GradientStream: producer delivered no leaves within "
+                        f"{timeout}s ({sum(self._delivered)}/{self.n_leaves} "
+                        "delivered)"
+                    )
+
+
 # ------------------------------------------------------------------- EF-q8
 def ef_quantize_flat(flat: np.ndarray, residual: Optional[np.ndarray],
                      bounds: Sequence[Tuple[int, int]]) -> np.ndarray:

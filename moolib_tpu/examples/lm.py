@@ -136,6 +136,13 @@ def make_flags(argv=None):
                    "reduce-scatters only (N-1)/N of the flat payload "
                    "between hosts.  Composes --mesh with the elastic "
                    "cohort (--address/--connect); requires both")
+    p.add_argument("--overlap_grads", action="store_true",
+                   help="latency-hiding gradient pipeline (DESIGN.md §6e): "
+                   "the train step is split into a two-jit backward "
+                   "schedule and gradients stream into the inter-host "
+                   "allreduce bucket-by-bucket while the head of backward "
+                   "is still running; bit-identical results, less exposed "
+                   "comm per step")
     p.add_argument("--wire_dtype", default=None, choices=[None, "bf16", "int8"])
     p.add_argument("--localdir", default=None,
                    help="per-peer scratch dir: the autoscaler's decommission "
@@ -631,6 +638,7 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
             lambda p, b, r: loss_fn(p, b),
             mesh=mesh, params_sharding="fsdp", grad_spec="params",
             batch_spec=tok_spec,
+            overlap_grads=bool(getattr(flags, "overlap_grads", False)),
         )
         p_sh_cache: dict = {}
 
@@ -662,6 +670,22 @@ def _train_elastic(flags, model, params, opt, opt_state, loss_fn, rng,
             loss, aux, grads = gstep(p, jax.device_put(t, tok_sharding), grad_rng)
             return (loss, aux), grads
 
+    elif getattr(flags, "overlap_grads", False):
+        # Two-jit overlap schedule (DESIGN.md §6e): the step returns the
+        # loss/aux plus a GradientStream that delivers the tail of the
+        # flatten order first; reduce_gradients() consumes it and launches
+        # each bucket's inter-host reduce while the head jit is still
+        # computing.  Bit-identical to the single-jit step.
+        ostep = parallel.make_train_step(
+            lambda p, b, r: loss_fn(p, b), overlap_grads=True
+        )
+        overlap_rng = jax.random.key(flags.seed)
+
+        def jgrad(p, t):
+            loss, aux, stream = ostep(p, t, overlap_rng)
+            return (loss, aux), stream
+
+        japply = jax.jit(apply_fn)
     else:
         jgrad = jax.jit(lambda p, t: jax.value_and_grad(loss_fn, has_aux=True)(p, t))
         japply = jax.jit(apply_fn)

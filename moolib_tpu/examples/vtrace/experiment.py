@@ -134,6 +134,18 @@ def make_flags(argv=None):
         "(Group.ring_auto would keep a same-host cohort on the tree)",
     )
     p.add_argument(
+        "--overlap_grads",
+        action="store_true",
+        help="latency-hiding gradient pipeline (DESIGN.md §6e): the learner "
+        "step runs as a two-jit backward schedule and gradients stream "
+        "into the inter-host allreduce bucket-by-bucket while the head of "
+        "backward is still computing.  Bit-identical results; streaming "
+        "launch engages when --virtual_batch_size 0 (with vbatch the "
+        "stream is consumed but buckets wait for the accumulation "
+        "barrier).  Unmeshed learner only (with --mesh the in-jit psum "
+        "already overlaps over ICI)",
+    )
+    p.add_argument(
         "--trace_dir",
         default=None,
         help="capture a jax profiler trace of the first learner steps here",
@@ -528,6 +540,12 @@ def train(flags, on_stats=None) -> dict:
         return optax.apply_updates(p, updates), o
 
     actor_mesh = None
+    if flags.mesh and getattr(flags, "overlap_grads", False):
+        raise ValueError(
+            "--overlap_grads is the unmeshed learner's overlap plane; with "
+            "--mesh the jitted step already psums gradients over ICI inside "
+            "the jit (drop one of the two flags)"
+        )
     if flags.mesh:
         from ... import parallel
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -574,6 +592,30 @@ def train(flags, on_stats=None) -> dict:
             in_shardings=(param_sh, opt_sh, param_sh),
             out_shardings=(param_sh, opt_sh),
         )
+    elif getattr(flags, "overlap_grads", False):
+        # Two-jit overlap schedule (DESIGN.md §6e): the step returns loss,
+        # aux, and a GradientStream delivering the tail of the flatten
+        # order first; reduce_gradients() consumes it and launches each
+        # bucket's inter-host reduce while the head jit is still running.
+        # Bit-identical to the single-jit step (same primal/backward
+        # graphs, cut on a leaf boundary).
+        from ... import parallel
+
+        _ostep = parallel.make_train_step(
+            lambda p, b, r: compute_loss(
+                p, b["batch"], b["core"], model=model, flags=flags
+            ),
+            overlap_grads=True,
+        )
+        _ov_rng = jax.random.key(0)  # compute_loss ignores it; fixed key
+
+        def grad_fn(p, batch, initial_core):
+            loss, aux, stream = _ostep(
+                p, {"batch": batch, "core": initial_core}, _ov_rng
+            )
+            return (loss, aux), stream
+
+        opt_apply = jax.jit(_opt_apply)
     else:
         grad_fn = jax.jit(raw_grad)
         # Jitted even unmeshed: the eager optax chain re-dispatches ~100 ops

@@ -1514,37 +1514,9 @@ class Group:
         created = []
         try:
             for k in range(layout.n_buckets):
-                cname = f"{name}\x1f{pseq}:{k}"
-                cseq_key = (self._sync_id, cname)
-                cseq = self._seq.get(cseq_key, 0)
-                self._seq[cseq_key] = cseq + 1
-                key = (self._sync_id, cname, cseq)
-                s, e = layout.bounds[k]
-                val = {
-                    "b": parent.flat_view[s:e] if parent.flat_view is not None else None,
-                    "m": dict(meta) if (k == 0 and meta is not None) else None,
-                }
-                cf = AllReduce()
-                opstate = _Op(
-                    key, val,
-                    (lambda a, b, k=k: parent._fold(k, a, b)),
-                    parent._fin if wire is not None else None,
-                    cf, eager=True,
-                    consume=(lambda v, k=k: parent._consume(k, v)),
-                )
-                self._ops[key] = opstate
+                opstate, key = self._bucketed_child_locked(
+                    parent, name, pseq, k, meta, wire)
                 created.append(key)
-                # A parked contribution of the wrong length (peers with
-                # mismatched MOOLIB_BUCKET_BYTES) raises here — the except
-                # below turns that into a loud whole-round error.
-                for c in self._parked.pop(key, []):
-                    opstate.value = opstate.op(opstate.value, c)
-                    opstate.folded += 1
-                if self._ring_parked.pop(key, None) is not None:
-                    raise RpcError(
-                        "peers disagree on allreduce path: ring frame "
-                        f"received for bucketed op {key}")
-                cf.add_done_callback(lambda f, k=k: parent._child_done(k, f))
                 finished.append((opstate, self._check_op_locked(opstate)))
         except Exception as e:
             # Unwind every child op already registered: an orphaned child
@@ -1570,6 +1542,183 @@ class Group:
 
         parent.cleanup = _done
         return finished
+
+    def _bucketed_child_locked(self, parent, name, pseq, k, meta, wire):
+        """Create and register bucket ``k``'s eager sub-op of a bucketed
+        round (caller holds the group lock).  Shared by the barrier path
+        (``_bucketed_start_locked`` creates every bucket at once) and the
+        streaming path (``bucketed_stream`` launches buckets one at a time,
+        as the caller stages them).  A parked contribution of the wrong
+        length (peers with mismatched ``MOOLIB_BUCKET_BYTES``) raises here —
+        callers turn that into a loud whole-round error."""
+        cname = f"{name}\x1f{pseq}:{k}"
+        cseq_key = (self._sync_id, cname)
+        cseq = self._seq.get(cseq_key, 0)
+        self._seq[cseq_key] = cseq + 1
+        key = (self._sync_id, cname, cseq)
+        s, e = parent.layout.bounds[k]
+        val = {
+            "b": parent.flat_view[s:e] if parent.flat_view is not None else None,
+            "m": dict(meta) if (k == 0 and meta is not None) else None,
+        }
+        cf = AllReduce()
+        opstate = _Op(
+            key, val,
+            (lambda a, b, k=k: parent._fold(k, a, b)),
+            parent._fin if wire is not None else None,
+            cf, eager=True,
+            consume=(lambda v, k=k: parent._consume(k, v)),
+        )
+        self._ops[key] = opstate
+        try:
+            for c in self._parked.pop(key, []):
+                opstate.value = opstate.op(opstate.value, c)
+                opstate.folded += 1
+            if self._ring_parked.pop(key, None) is not None:
+                raise RpcError(
+                    "peers disagree on allreduce path: ring frame "
+                    f"received for bucketed op {key}")
+        except Exception:
+            self._ops.pop(key, None)
+            raise
+        cf.add_done_callback(lambda f, k=k: parent._child_done(k, f))
+        return opstate, key
+
+    def bucketed_stream(self, name: str, flat, *, meta=None, meta_op=None,
+                        wire=None) -> "BucketedStream":
+        """Start a flat-bucket tree allreduce whose per-bucket sub-ops
+        launch INCREMENTALLY (streaming gradient pipeline, DESIGN.md §6e).
+
+        ``flat`` is the caller's contiguous staging buffer, handed over
+        ``owned=True`` (folds accumulate into it in place; results may be
+        read-only views) — its CONTENTS need not be ready yet: bucket ``k``'s
+        slice must be fully staged only by the time the caller invokes
+        ``handle.launch(k)``.  The wire protocol is IDENTICAL to the barrier
+        path (same parent seq, same child op names, same payloads) — only
+        the launch times differ, so streaming and barrier peers interoperate
+        within one round: a faster peer's frames for a not-yet-launched
+        bucket simply park until the launch folds them.
+
+        Returns a :class:`BucketedStream` handle; ``handle.future`` resolves
+        exactly like the equivalent ``all_reduce(..., bucketed=True)``
+        future once every bucket's sub-op completes.  A membership-epoch
+        change mid-stream errors the round loudly: the epoch push cancels
+        the launched ops (``RpcError("group changed")``) and any later
+        ``launch`` raises instead of silently desyncing the cohort.
+        """
+        future = AllReduce()
+        handle = BucketedStream(self, name, future)
+        flat = np.asarray(flat)
+        if flat.ndim != 1 or not flat.flags.c_contiguous:
+            future.set_exception(RpcError(
+                "bucketed_stream needs a contiguous 1-d flat buffer"))
+            handle._dead = True
+            return handle
+        with self._lock:
+            if self._sync_id is None or self._rpc.get_name() not in self._members:
+                future.set_exception(RpcError("group not active"))
+                handle._dead = True
+                return handle
+            seq_key = (self._sync_id, name)
+            pseq = self._seq.get(seq_key, 0)
+            self._seq[seq_key] = pseq + 1
+            handle._pseq = pseq
+            handle._sync_id = self._sync_id
+            if len(self._members) == 1:
+                # Degenerate cohort: the result is the caller's own staged
+                # flat.  Completion waits for handle.finish() — the buffer
+                # is still being filled while buckets "launch".
+                handle._degenerate = (flat, meta)
+                layout = buckets.BucketLayout([np.asarray(flat).shape],
+                                              np.asarray(flat).dtype)
+                handle.bounds = layout.bounds
+                return handle
+            pkey = (self._sync_id, name, pseq)
+            if (
+                self._parked.pop(pkey, None) is not None
+                or self._ring_parked.pop(pkey, None) is not None
+            ):
+                future.set_exception(RpcError(
+                    "peers disagree on allreduce path: legacy frame "
+                    f"received for bucketed op {pkey}"))
+                handle._dead = True
+                return handle
+            parent = _BucketedReduce(
+                flat, meta, meta_op, wire, None, True, self._defer)
+            parent.key = pkey
+            parent.attach(future)
+            handle._parent = parent
+            handle._meta = meta
+            handle._wire = wire
+            handle.bounds = parent.layout.bounds
+            # Mismatch sentinel at the parent key, exactly as the barrier
+            # path registers it (legacy frames error loudly, the timeout
+            # sweep covers a round whose peers never show up).
+            self._ops[pkey] = parent
+
+            def _done(pkey=pkey, parent=parent):
+                with self._lock:
+                    if self._ops.get(pkey) is parent:
+                        del self._ops[pkey]
+
+            parent.cleanup = _done
+        return handle
+
+    def _stream_launch(self, handle: "BucketedStream", k: int):
+        """Launch bucket ``k`` of a streaming round (its slice of the flat
+        buffer is now staged).  Returns the child future, or None on the
+        degenerate single-member path.  Raises RpcError when the membership
+        epoch changed mid-stream — buckets partially in flight cannot be
+        re-keyed to the new epoch, so the round fails loudly."""
+        if handle._dead:
+            raise RpcError(
+                f"streaming allreduce {handle.name}: round already failed")
+        if handle._degenerate is not None:
+            return None
+        parent = handle._parent
+        with self._lock:
+            if self._sync_id != handle._sync_id or parent.done:
+                err = RpcError(
+                    f"streaming allreduce {handle.name}: group changed with "
+                    f"buckets in flight (epoch {handle._sync_id} -> "
+                    f"{self._sync_id})")
+                handle._dead = True
+            else:
+                try:
+                    opstate, _key = self._bucketed_child_locked(
+                        parent, handle.name, handle._pseq, k, handle._meta,
+                        handle._wire)
+                    action = self._check_op_locked(opstate)
+                    err = None
+                except Exception as e:  # noqa: BLE001 — loud whole-round error
+                    err = e if isinstance(e, RpcError) else RpcError(
+                        f"streaming allreduce launch failed: {e!r}")
+                    handle._dead = True
+        if err is not None:
+            parent._fail(err)
+            raise err
+        self._finish_op(opstate, action)
+        return opstate.future
+
+    def _stream_finish(self, handle: "BucketedStream") -> None:
+        """Caller finished staging + launching every bucket.  Only the
+        degenerate single-member path has work left: resolve the future with
+        the (now fully staged) local flat, mirroring all_reduce's
+        single-member short-circuit."""
+        if handle._degenerate is not None and not handle._dead:
+            flat, meta = handle._degenerate
+            handle.future.set_result((flat, meta) if meta is not None else flat)
+
+    def _stream_abort(self, handle: "BucketedStream", err) -> None:
+        """Error the streaming round from the caller's side (staging failed
+        mid-stream).  Launched sub-ops keep draining into the dead parent;
+        peers waiting on unlaunched buckets time out loudly — same failure
+        surface as a peer crashing mid-round."""
+        handle._dead = True
+        if handle._parent is not None:
+            handle._parent._fail(err)
+        else:
+            handle.future.set_exception(err)
 
     def _defer(self, fn, *args):
         """Run ``fn(*args)`` on the completion thread.  Bucketed rounds
@@ -1860,3 +2009,39 @@ class Group:
         self._rpc.async_callback(
             nxt, "__group_ring", _sent, self._name, op.key, phase, step,
             chunk_idx, data, meta)
+
+
+class BucketedStream:
+    """Caller handle of one streaming bucketed allreduce
+    (:meth:`Group.bucketed_stream`): ``bounds`` is the per-bucket element
+    ranges of the flat buffer (the launch units), ``launch(k)`` fires bucket
+    ``k``'s sub-op once its slice is staged, ``finish()`` is called after
+    the last launch, ``abort(err)`` errors the round from the caller's
+    side.  ``future`` resolves like the barrier path's."""
+
+    __slots__ = (
+        "_group", "name", "future", "bounds", "_parent", "_pseq", "_sync_id",
+        "_meta", "_wire", "_degenerate", "_dead",
+    )
+
+    def __init__(self, group, name, future):
+        self._group = group
+        self.name = name
+        self.future = future
+        self.bounds = ()
+        self._parent = None
+        self._pseq = None
+        self._sync_id = None
+        self._meta = None
+        self._wire = None
+        self._degenerate = None
+        self._dead = False
+
+    def launch(self, k: int):
+        return self._group._stream_launch(self, k)
+
+    def finish(self) -> None:
+        self._group._stream_finish(self)
+
+    def abort(self, err) -> None:
+        self._group._stream_abort(self, err)

@@ -19,7 +19,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import telemetry
+from .. import buckets, telemetry
 from ..telemetry import devmon
 from ..utils import init_compile_cache
 from .mesh import replicated
@@ -129,6 +129,30 @@ def auto_shardings(
     return jax.tree_util.tree_map(spec_of, params)
 
 
+def _overlap_cut_index(leaves) -> int:
+    """Default two-jit cut for ``overlap_grads=True``: the param-leaf
+    boundary nearest the flat-bucket grid boundary nearest the payload
+    midpoint.  Cutting on (near) a bucket boundary means the tail jit's
+    gradients complete whole buckets of the accumulator's ``BucketLayout``,
+    so their wire ops launch while the head jit is still running backward.
+    """
+    sizes = [max(1, int(np.prod(np.shape(l)))) for l in leaves]
+    if len(sizes) < 2:
+        return 0
+    total = sum(sizes)
+    itemsize = np.dtype(getattr(leaves[0], "dtype", np.float32)).itemsize
+    grid = max(1, buckets.bucket_bytes() // itemsize)
+    # Bucket-grid boundary nearest the midpoint of the flat payload.
+    target = round((total / 2) / grid) * grid
+    off, best, best_d = 0, 1, None
+    for i in range(1, len(sizes)):
+        off += sizes[i - 1]
+        d = abs(off - target)
+        if best_d is None or d < best_d:
+            best, best_d = i, d
+    return best
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer: Optional[optax.GradientTransformation] = None,
@@ -137,6 +161,8 @@ def make_train_step(
     batch_spec: Optional[P] = None,
     donate: bool = True,
     grad_spec=None,
+    overlap_grads: bool = False,
+    overlap_cut: Optional[int] = None,
 ):
     """Build ``step(params, opt_state, batch, rng) -> (params, opt_state,
     loss, aux)``.
@@ -154,6 +180,20 @@ def make_train_step(
     sharded grads to ``Accumulator.reduce_gradients`` for the inter-host
     round.  ``grad_spec`` is a mode string ("replicated" / "fsdp" /
     "params" to mirror ``params_sharding``) or a sharding pytree.
+
+    With ``overlap_grads=True`` (DESIGN.md §6e) the step is split into TWO
+    jits cut on a param-leaf boundary near a flat-bucket grid boundary
+    (``overlap_cut=`` overrides the leaf index): the first computes the loss
+    and the gradients of the *tail* leaves (shortest backprop chains, ready
+    first), the second the gradients of the *head* leaves.  The step then
+    returns ``(loss, aux, stream)`` where ``stream`` is a
+    ``buckets.GradientStream`` that delivers the tail gradients while the
+    head jit is still executing backward — handing it to
+    ``Accumulator.reduce_gradients`` launches each bucket's inter-host wire
+    op as soon as that bucket is staged, hiding comm under the backward
+    tail.  Composes with ``grad_spec=`` (the stream carries the grad
+    shardings for the sharded inter-host round); does not compose with
+    ``optimizer=`` (apply updates after the reduce completes).
     """
 
     def step(params, opt_state, batch, rng):
@@ -168,10 +208,99 @@ def make_train_step(
 
     if grad_spec is not None and mesh is None:
         raise ValueError("grad_spec= requires mesh=")
-    if grad_spec is None and optimizer is None:
+    if overlap_grads and optimizer is not None:
+        raise ValueError(
+            "overlap_grads=True streams raw gradients to the caller; it does "
+            "not compose with optimizer= (apply updates after the reduce)"
+        )
+    if overlap_grads and mesh is not None and grad_spec is None:
+        raise ValueError("overlap_grads=True with mesh= requires grad_spec=")
+    if grad_spec is None and optimizer is None and not overlap_grads:
         raise ValueError("make_train_step needs an optimizer unless grad_spec= is given")
 
+    def _build_overlap(shard):
+        # Two-jit schedule: tail grads first (short backprop chains), head
+        # grads second; the GradientStream hands each chunk to the caller the
+        # moment its jit's outputs exist as (async) device arrays, so the
+        # consumer's per-bucket D2H + wire launches run under the head jit's
+        # device time.  Compiled lazily on first call (needs real pytrees).
+        state: dict = {}
+
+        def overlap_step(params, batch, rng):
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            if "fns" not in state:
+                if len(leaves) < 2:
+                    cut = 0
+                else:
+                    cut = overlap_cut if overlap_cut is not None else _overlap_cut_index(leaves)
+                    cut = int(max(1, min(len(leaves) - 1, cut)))
+
+                def tail_loss(tail, head, b, r):
+                    p = jax.tree_util.tree_unflatten(treedef, list(head) + list(tail))
+                    return loss_fn(p, b, r)
+
+                def tail_step(tail, head, b, r):
+                    (loss, aux), g = jax.value_and_grad(tail_loss, has_aux=True)(tail, head, b, r)
+                    return loss, aux, g
+
+                def head_loss(head, tail, b, r):
+                    p = jax.tree_util.tree_unflatten(treedef, list(head) + list(tail))
+                    return loss_fn(p, b, r)
+
+                def head_step(head, tail, b, r):
+                    g, _ = jax.grad(head_loss, has_aux=True)(head, tail, b, r)
+                    return g
+
+                if shard is None:
+                    gsh = None
+                    tail_fn = jax.jit(tail_step)
+                    head_fn = jax.jit(head_step) if cut else None
+                else:
+                    init_compile_cache()
+                    psh = jax.tree_util.tree_leaves(shard["get_ps"](params))
+                    gsh = jax.tree_util.tree_leaves(shard["get_gs"](params))
+                    bsh = jax.tree_util.tree_map(lambda _: shard["bsharding"], batch)
+                    rep_ = shard["rep"]
+                    tail_fn = jax.jit(
+                        tail_step,
+                        in_shardings=(psh[cut:], psh[:cut], bsh, rep_),
+                        out_shardings=(rep_, None, gsh[cut:]),
+                    )
+                    head_fn = (
+                        jax.jit(
+                            head_step,
+                            in_shardings=(psh[:cut], psh[cut:], bsh, rep_),
+                            out_shardings=gsh[:cut],
+                        )
+                        if cut
+                        else None
+                    )
+                state.update(fns=(tail_fn, head_fn), cut=cut, gsh=gsh)
+            tail_fn, head_fn = state["fns"]
+            cut = state["cut"]
+            head_p, tail_p = leaves[:cut], leaves[cut:]
+            loss, aux, gtail = tail_fn(tail_p, head_p, batch, rng)
+            ghead = list(head_fn(head_p, tail_p, batch, rng)) if head_fn is not None else []
+            glist = ghead + list(gtail)
+            stream = buckets.GradientStream(
+                treedef,
+                [tuple(np.shape(g)) for g in glist],
+                [np.dtype(g.dtype) for g in glist],
+                shardings=state["gsh"],
+            )
+            # Tail first: its jit was dispatched first and its grads need
+            # only the shallow end of the backward graph, so they land while
+            # the head jit is still executing.
+            stream.deliver(cut, list(gtail))
+            if ghead:
+                stream.deliver(0, ghead)
+            return loss, aux, stream
+
+        return _instrument_step(overlap_step)
+
     if mesh is None:
+        if overlap_grads:
+            return _build_overlap(None)
         return _instrument_step(jax.jit(step, donate_argnums=(0, 1) if donate else ()))
 
     if params_sharding is None:
@@ -219,6 +348,11 @@ def make_train_step(
 
             def get_gs(params):
                 return gs
+
+        if overlap_grads:
+            return _build_overlap(
+                {"get_ps": get_ps, "get_gs": get_gs, "bsharding": bsharding, "rep": rep}
+            )
 
         def sharded_grad_step(params, batch, rng):
             if "fn" not in compiled:

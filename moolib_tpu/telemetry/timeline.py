@@ -682,6 +682,38 @@ def host_span(name: str) -> "_PhaseSpan":
     return _PhaseSpan(name, "host")
 
 
+def comm_mark() -> Optional[int]:
+    """Timestamp (perf_counter_ns) for a later :func:`comm_interval`, or
+    ``None`` when no window is open.  The pair exists for ASYNC comm whose
+    begin/end straddle callbacks (the streaming gradient pipeline's
+    per-bucket wire ops): the caller can't hold a ``comm_span`` context
+    open across a launch→completion callback boundary, so it marks at
+    launch and records retroactively at completion.  Keeps the clock choice
+    inside telemetry (call sites never touch perf_counter directly)."""
+    if _state["window"] is None:
+        return None
+    return time.perf_counter_ns()
+
+
+def comm_interval(name: str, t0_ns: Optional[int],
+                  t1_ns: Optional[int] = None) -> None:
+    """Retroactively record ``[t0_ns, t1_ns]`` (``t1_ns`` defaults to now)
+    as a comm span of the active window.  ``t0_ns=None`` (from a
+    :func:`comm_mark` outside a window) is a no-op, so call sites wire the
+    pair unconditionally.  Per-bucket spans may overlap each other and the
+    step's compute — ``ingest_window`` unions comm spans before subtracting
+    compute, so overlapping bucket ops count once, and the part concurrent
+    with compute lands in ``overlapped_comm_seconds``, not exposed."""
+    if t0_ns is None:
+        return
+    if t1_ns is None:
+        t1_ns = time.perf_counter_ns()
+    with _lock:
+        w = _state["window"]
+        if w is not None:
+            w["comm"].append((name, int(t0_ns), int(t1_ns)))
+
+
 def configure(
     interval: int,
     window_s: float = DEFAULT_WINDOW_S,

@@ -192,6 +192,61 @@ else
   fail=1
 fi
 
+step "streaming gradient pipeline tests (bit-exact vs barrier/numpy: tree+ring+q8+sharded, launch leads, epoch-bump + sharding-change failure paths, two-jit overlap step)"
+python -m pytest tests/test_streaming_allreduce.py -q || fail=1
+
+step "streaming overlap 2-process smoke (exposed comm per step must drop >= 50% vs barrier at the 10 MB tree)"
+# Two real processes over loopback run barrier and streaming gradient
+# rounds on identical contributions with a simulated paced backward
+# (DESIGN.md §6e): results must be bit-identical to each other AND a numpy
+# reference, every non-final bucket must launch with positive lead
+# (accum_bucket_launch_lead_seconds > 0), and each rank's OWN exposed comm
+# per step must come in at <= 0.5x the barrier arm — the latency-hiding
+# claim measured across real process boundaries.  MOOLIB_LOCKGRAPH=1: the
+# streaming consume loop holds producer/consumer + accumulator + group
+# locks across threads; an observed ABBA cycle fails the run at teardown.
+ov_port=$((21000 + RANDOM % 20000))
+ov_log0="${TMPDIR:-/tmp}/moolib_ci_overlap_r0.log"
+ov_log1="${TMPDIR:-/tmp}/moolib_ci_overlap_r1.log"
+WORLD_SIZE=2 RANK=1 BROKER_ADDR="127.0.0.1:${ov_port}" MOOLIB_LOCKGRAPH=1 \
+  python benchmarks/allreduce_bench.py rpc --overlap --smoke --iters 3 > "$ov_log1" 2>&1 &
+ov_pid=$!
+WORLD_SIZE=2 RANK=0 BROKER_ADDR="127.0.0.1:${ov_port}" MOOLIB_LOCKGRAPH=1 \
+  python benchmarks/allreduce_bench.py rpc --overlap --smoke --iters 3 > "$ov_log0" 2>&1
+ov_rc0=$?
+wait "$ov_pid"; ov_rc1=$?
+cat "$ov_log0"
+if [ "$ov_rc0" = 0 ] && [ "$ov_rc1" = 0 ]; then
+  python scripts/bench_gate.py --smoke --log "$ov_log0" \
+    --throughput-floor 0.5 --latency-ceiling 3.0 \
+    --allow-new-section all || fail=1
+  python benchmarks/fold_capture.py --local "$ov_log0" || fail=1
+else
+  echo "overlap 2-process smoke failed (rc0=$ov_rc0 rc1=$ov_rc1)"
+  cat "$ov_log1"
+  fail=1
+fi
+
+step "streaming overlap A/B rows (barrier vs streaming exposed comm per step; folds into BENCH_LOCAL.json banner-keyed)"
+# The measured latency-hiding claim as committed data: round wall time and
+# exposed_ms per step on both arms plus the ratio section.  fold_capture
+# merges banner-keyed, so these rows coexist with the tree/ring/sharded
+# sections instead of clobbering them.
+ov_ab_log="${TMPDIR:-/tmp}/moolib_ci_overlap_ab.log"
+MOOLIB_LOCKGRAPH=1 python benchmarks/allreduce_bench.py rpc --overlap \
+  --world_size 2 --iters 3 --sizes 1000000 2621440 \
+  --broker_addr "127.0.0.1:$((21000 + RANDOM % 20000))" > "$ov_ab_log" 2>&1
+ov_ab_rc=$?
+cat "$ov_ab_log"
+if [ "$ov_ab_rc" = 0 ]; then
+  python scripts/bench_gate.py --smoke --log "$ov_ab_log" \
+    --throughput-floor 0.5 --latency-ceiling 3.0 \
+    --allow-new-section all || fail=1
+  python benchmarks/fold_capture.py --local "$ov_ab_log" || fail=1
+else
+  fail=1
+fi
+
 step "chaos soak (seeded, ~80 s smoke: worker/peer kills + respawn SLO, RPC frame chaos, forced-kill resume, mid-shard-write kill + distributed checkpoint resume)"
 # Exits non-zero if any phase stalls past its watchdog/deadline, or the
 # respawned peer misses its recovery bound (docs/RESILIENCE.md recovery

@@ -133,6 +133,56 @@ def test_ingest_window_device_slices_rebase_and_bubble():
     assert sum(row["fractions"].values()) == pytest.approx(1.0, abs=0.02)
 
 
+def test_ingest_window_overlapping_bucket_spans_union_once():
+    # Streaming gradient pipeline: per-bucket wire spans overlap each other
+    # AND the backward compute.  Buckets [0,10] and [5,15] union to [0,15];
+    # compute covers [0,12], so 12 ms is overlapped and only [12,15] (3 ms)
+    # is exposed — double-counting the [5,10] overlap region would report
+    # 20 ms of comm out of 15 ms of wall clock.
+    steps = [("train", 0, 12 * MS)]
+    report = timeline.ingest_window(
+        steps,
+        comm_spans=[
+            ("accum.stream_bucket", 0, 10 * MS),
+            ("accum.stream_bucket", 5 * MS, 15 * MS),
+        ],
+        anchor=_anchor(),
+        window_end_ns=20 * MS,
+        psum_host_seconds=0.015,
+        publish=False,
+    )
+    assert report["exposed_comm_seconds"] == pytest.approx(0.003, abs=1e-3)
+    assert report["overlapped_comm_seconds"] == pytest.approx(0.012, abs=1e-3)
+    # The psum cross-check counts the UNIONED comm measure (15 ms), so the
+    # ratio stays ~1.0 against a 15 ms host-side psum account.
+    assert report["comm_vs_psum_ratio"] == pytest.approx(1.0, abs=0.05)
+    row = report["fns"]["train"]
+    assert row["seconds"]["comm"] == pytest.approx(0.003, abs=1e-3)
+    assert sum(row["fractions"].values()) == pytest.approx(1.0, abs=0.02)
+
+
+def test_comm_mark_interval_records_retroactive_span():
+    # No window open: mark is None and interval is a no-op.
+    assert timeline.comm_mark() is None
+    timeline.comm_interval("accum.stream_bucket", None)
+    w = timeline._open_window(seq=1)
+    assert w is not None
+    timeline._state["window"] = w
+    try:
+        t0 = timeline.comm_mark()
+        assert t0 is not None
+        timeline.comm_interval("accum.stream_bucket", t0)
+        timeline.comm_interval("explicit", 100, 200)
+        names = [n for n, _, _ in w["comm"]]
+        assert names == ["accum.stream_bucket", "explicit"]
+        (_, a0, a1), (_, b0, b1) = w["comm"]
+        assert a0 == t0 and a1 >= a0
+        assert (b0, b1) == (100, 200)
+    finally:
+        timeline._state["window"] = None
+        timeline._discard_window(w)
+
+
 def test_ingest_window_psum_ratio_cross_check():
     steps = [("t", 0, 10 * MS)]
     report = timeline.ingest_window(

@@ -364,6 +364,50 @@ def merge_agent_rows(old_lines, new_lines):
     return kept + merged_new
 
 
+def parse_r2d2_local(path):
+    """r2d2_bench stdout: one ``{"metric": "r2d2_learner_sps", "arm": ...}``
+    row per replay arm (host / host_rpc / device) plus the
+    ``r2d2_replay_ab`` summary (speedups + priority bit-exactness + the
+    write-once ingest accounting).  No platform gate — the replay-plane
+    A/B is a valid local record wherever it ran; the platform column says
+    which chip served it."""
+    keep = []
+    try:
+        with open(path) as f:
+            for line in f.read().splitlines():
+                if not line.startswith("{"):
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("metric") in ("r2d2_learner_sps", "r2d2_replay_ab"):
+                    keep.append(json.dumps(row))
+    except OSError:
+        return None
+    return keep or None
+
+
+def _r2d2_row_key(line):
+    """Merge key for an r2d2_learner section row: (metric, arm).  The
+    ``r2d2_replay_ab`` summary carries no arm and keys as the single
+    comparison row each fresh A/B run replaces."""
+    try:
+        row = json.loads(line)
+    except json.JSONDecodeError:
+        return line
+    return (row.get("metric"), row.get("arm"))
+
+
+def merge_r2d2_rows(old_lines, new_lines):
+    """r2d2_learner rows merge per arm: a single-arm re-run (``--arms
+    device``) must not erase the stored host/host_rpc rows the speedup
+    claim is measured against."""
+    fresh = {_r2d2_row_key(l) for l in new_lines}
+    kept = [l for l in (old_lines or []) if _r2d2_row_key(l) not in fresh]
+    return kept + list(new_lines)
+
+
 def parse_serve_qps(path):
     """serve_bench --qps stdout: the baseline closed-loop row plus one
     ``{"metric": "serve_qps", ...}`` line per target (no platform gate —
@@ -460,8 +504,9 @@ def merge_overlap_rows(old_lines, new_lines):
 def fold_local(log_path, json_path):
     """Merge a fresh local capture into BENCH_LOCAL.json: only the section
     the log belongs to — ``allreduce_rpc`` for an allreduce_bench capture,
-    ``agent_small`` for an agent_bench one, ``serve_qps`` for a
-    ``serve_bench --qps`` one (detected by content) — has its stdout
+    ``agent_small`` for an agent_bench one, ``r2d2_learner`` for an
+    r2d2_bench replay A/B, ``serve_qps`` for a ``serve_bench --qps`` one
+    (detected by content) — has its stdout
     updated; every other section (rpc, envpool, ...) is preserved verbatim.
     The allreduce_rpc, serve_qps, and agent_small sections merge rows
     (banner-keyed / row-keyed) instead of clobbering — same
@@ -474,7 +519,14 @@ def fold_local(log_path, json_path):
         data = {}
     overlap_lines = parse_step_overlap(log_path)
     agent_lines = None if overlap_lines else parse_agent_lines(log_path)
-    qps_lines = None if (overlap_lines or agent_lines) else parse_serve_qps(log_path)
+    r2d2_lines = (
+        None if (overlap_lines or agent_lines) else parse_r2d2_local(log_path)
+    )
+    qps_lines = (
+        None
+        if (overlap_lines or agent_lines or r2d2_lines)
+        else parse_serve_qps(log_path)
+    )
     if overlap_lines:
         section, cmd, lines = (
             "step_overlap",
@@ -486,6 +538,12 @@ def fold_local(log_path, json_path):
             "agent_small",
             "benchmarks/agent_bench.py --scale small --rollout all",
             agent_lines,
+        )
+    elif r2d2_lines:
+        section, cmd, lines = (
+            "r2d2_learner",
+            "benchmarks/r2d2_bench.py --check",
+            r2d2_lines,
         )
     elif qps_lines:
         # dict.fromkeys: an A/B capture has one row per target per arm.
@@ -513,6 +571,8 @@ def fold_local(log_path, json_path):
     sec["rc"] = 0
     if section == "serve_qps":
         lines = merge_qps_rows(sec.get("stdout"), lines)
+    elif section == "r2d2_learner":
+        lines = merge_r2d2_rows(sec.get("stdout"), lines)
     elif section == "agent_small":
         lines = merge_agent_rows(sec.get("stdout"), lines)
     elif section == "allreduce_rpc":

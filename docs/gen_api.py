@@ -33,7 +33,11 @@ MODULES = [
     ("moolib_tpu.envpool", "EnvPool"),
     ("moolib_tpu.batcher", "Batcher"),
     ("moolib_tpu.rollout", "Device-resident actor rollout"),
-    ("moolib_tpu.replay", "Replay"),
+    ("moolib_tpu.replay", "Replay (package)"),
+    ("moolib_tpu.replay.host", "Replay: host reference store"),
+    ("moolib_tpu.replay.device", "Replay: device-resident shard"),
+    ("moolib_tpu.replay.ingest", "Replay: memfd-multicast ingest"),
+    ("moolib_tpu.replay.distributed", "Replay: two-level cohort sampling"),
     ("moolib_tpu.checkpoint", "Checkpointing"),
     ("moolib_tpu.watchdog", "Watchdog (run-loop deadman)"),
     ("moolib_tpu.autoscaler", "Autoscaler (elastic fleet supervision)"),

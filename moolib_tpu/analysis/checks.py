@@ -20,12 +20,17 @@ from .core import Check, Context, Finding, ModuleSource, register
 # ---------------------------------------------------------------------------
 
 #: the 0 B/frame hot-path modules (PR 5/7: the one-crossing and
-#: zero-crossing actor planes, PR 12: the decode loop).
+#: zero-crossing actor planes, PR 12: the decode loop, PR 20: the
+#: device-resident replay plane — replay/host.py stays out of scope on
+#: purpose, it IS the host-side numpy reference store).
 HOT_PATHS = (
     "moolib_tpu/rollout.py",
     "moolib_tpu/engine/",
     "moolib_tpu/ops/",
     "moolib_tpu/envs/jax_envs.py",
+    "moolib_tpu/replay/device.py",
+    "moolib_tpu/replay/distributed.py",
+    "moolib_tpu/replay/ingest.py",
 )
 
 #: the threaded planes where lock ordering is load-bearing (PR 8 epoch

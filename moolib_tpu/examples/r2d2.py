@@ -4,11 +4,14 @@ Covers the agent family the reference's users build on top of moolib
 ("R2D2 / recurrent PPO with LSTM policy + prioritized replay RPC",
 BASELINE.json configs): EnvPool actors collect fixed-length sequences with
 stored initial LSTM states, push them (with initial TD-error priorities)
-into a :class:`moolib_tpu.replay.ReplayBuffer` — in-process here, or served
-over RPC with ``--replay_peer`` for a distributed actor fleet — and the
-learner samples prioritized sequence batches, replays them through the
-recurrent Q-network (double-Q with a target network), and writes updated
-priorities back.
+into a replay store — the device-resident
+:class:`moolib_tpu.replay.DeviceReplayShard` by default
+(``--device_replay false`` for the legacy host
+:class:`~moolib_tpu.replay.ReplayBuffer`), or served over RPC with
+``--replay_peer`` for a distributed actor fleet — and the learner samples
+prioritized sequence batches, replays them through the recurrent
+Q-network (double-Q with a target network), and writes updated priorities
+back (on the device path the TD errors never visit the host).
 
 Run: ``python -m moolib_tpu.examples.r2d2 --total_steps 60000``
 """
@@ -47,10 +50,23 @@ def make_flags(argv=None):
     p.add_argument("--eps_decay_steps", type=int, default=30_000)
     p.add_argument("--num_processes", type=int, default=2)
     p.add_argument("--replay_peer", default=None, help="remote replay server peer name")
+    p.add_argument(
+        "--device_replay",
+        type=_bool_flag,
+        default=True,
+        help="device-resident replay shard (sum-tree + ring on chip); "
+        "`--device_replay false` keeps the legacy host ReplayBuffer",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log_interval", type=float, default=5.0)
     p.add_argument("--quiet", action="store_true")
     return finalize_flags(p, argv)
+
+
+def _bool_flag(v) -> bool:
+    """argparse-friendly bool: ``--device_replay false`` works (store_true
+    can't express an =false override)."""
+    return str(v).strip().lower() not in ("0", "false", "no", "off", "")
 
 
 def td_loss(params, target_params, model, batch, discounting):
@@ -124,6 +140,7 @@ def train(flags, on_stats=None) -> dict:
         )
     )
 
+    device_store = bool(flags.device_replay) and not flags.replay_peer
     if flags.replay_peer:
         from .. import Rpc
 
@@ -131,6 +148,12 @@ def train(flags, on_stats=None) -> dict:
         rpc.set_name(f"r2d2-actor-{flags.seed}")
         rpc.connect(flags.replay_peer)
         replay = ReplayClient(rpc, "replay-server", "replay")
+    elif device_store:
+        from ..replay import DeviceReplayShard
+
+        replay = DeviceReplayShard(
+            flags.replay_capacity, seed=flags.seed, name="r2d2_replay"
+        )
     else:
         replay = ReplayBuffer(flags.replay_capacity, seed=flags.seed)
 
@@ -201,20 +224,35 @@ def train(flags, on_stats=None) -> dict:
                 replay_warm = replay.size() >= flags.min_replay
             if replay_warm:
                 batch_items, idxs, weights = replay.sample(flags.learn_batch)
-                # batch leaves: [N, T+1, ...] -> time-major [T+1, N, ...]
-                batch = {
-                    "state": jnp.asarray(np.swapaxes(np.asarray(batch_items["state"]), 0, 1)),
-                    "done": jnp.asarray(np.swapaxes(np.asarray(batch_items["done"]), 0, 1)),
-                    "action": jnp.asarray(np.swapaxes(np.asarray(batch_items["action"]), 0, 1)),
-                    "reward": jnp.asarray(np.swapaxes(np.asarray(batch_items["reward"]), 0, 1)),
-                    # core was nest-stacked: already a tuple of [N, H] arrays.
-                    "core": tuple(jnp.asarray(c) for c in batch_items["core"]),
-                    "is_weight": jnp.asarray(weights),
-                }
+                if device_store:
+                    # Device arrays stay on device: [N, T+1, ...] ->
+                    # time-major without a host hop.
+                    batch = {
+                        k: jnp.swapaxes(batch_items[k], 0, 1)
+                        for k in ("state", "done", "action", "reward")
+                    }
+                    batch["core"] = tuple(batch_items["core"])
+                    batch["is_weight"] = weights
+                else:
+                    # batch leaves: [N, T+1, ...] -> time-major [T+1, N, ...]
+                    batch = {
+                        "state": jnp.asarray(np.swapaxes(np.asarray(batch_items["state"]), 0, 1)),
+                        "done": jnp.asarray(np.swapaxes(np.asarray(batch_items["done"]), 0, 1)),
+                        "action": jnp.asarray(np.swapaxes(np.asarray(batch_items["action"]), 0, 1)),
+                        "reward": jnp.asarray(np.swapaxes(np.asarray(batch_items["reward"]), 0, 1)),
+                        # core was nest-stacked: already a tuple of [N, H] arrays.
+                        "core": tuple(jnp.asarray(c) for c in batch_items["core"]),
+                        "is_weight": jnp.asarray(weights),
+                    }
                 (loss, prio), grads = grad_fn(params, target_params, batch=batch)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
-                replay.update_priorities(np.asarray(idxs), np.asarray(prio))
+                if device_store:
+                    # Priority write-back consumes the device TD errors
+                    # without realizing them on host.
+                    replay.update_priorities(idxs, prio)
+                else:
+                    replay.update_priorities(np.asarray(idxs), np.asarray(prio))
                 stats["loss"] = float(loss)
                 stats["sgd_steps"] += 1
                 if stats["sgd_steps"] % flags.target_update_interval == 0:
@@ -250,10 +288,23 @@ def serve_replay(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--address", default="0.0.0.0:4441")
     p.add_argument("--capacity", type=int, default=100_000)
+    p.add_argument("--device", type=_bool_flag, default=False,
+                   help="serve a device-resident shard (memfd ingest + "
+                   "cohort sampling endpoints) instead of the host buffer")
+    p.add_argument("--shard_index", type=int, default=0)
+    p.add_argument("--num_shards", type=int, default=1)
     args = p.parse_args(argv)
     rpc = Rpc()
     rpc.set_name("replay-server")
-    ReplayServer(rpc, "replay", ReplayBuffer(args.capacity))
+    if args.device:
+        from ..replay import DeviceReplayShard, ReplayShardService
+
+        shard = DeviceReplayShard(args.capacity, name="replay_srv")
+        ReplayShardService(rpc, "replay", shard,
+                           shard_index=args.shard_index,
+                           num_shards=args.num_shards)
+    else:
+        ReplayServer(rpc, "replay", ReplayBuffer(args.capacity))
     rpc.listen(args.address)
     print(f"replay server on {args.address}")
     while True:

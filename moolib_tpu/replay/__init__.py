@@ -1,0 +1,49 @@
+"""Prioritized experience replay: host reference store + device-resident
+distributed store.
+
+- :mod:`~moolib_tpu.replay.host` — the original numpy/RPC store
+  (``SumTree``/``ReplayBuffer``/``ReplayServer``/``ReplayClient``), kept
+  as the compat shim and the bit-exactness reference.
+- :mod:`~moolib_tpu.replay.device` — the sum-tree and ring storage as
+  donated device arrays (``DeviceSumTree``/``DeviceReplayShard``).
+- :mod:`~moolib_tpu.replay.ingest` — memfd-multicast trajectory publish
+  and zero-copy shard adoption
+  (``ReplayPublisher``/``ReplayShardService``).
+- :mod:`~moolib_tpu.replay.distributed` — the two-level cohort draw
+  (``DistributedReplay``/``SampleRef``).
+
+Host names import eagerly (numpy only); the device-side names load
+lazily so that importing the legacy store never pays the jax import.
+"""
+
+from .host import ReplayBuffer, ReplayClient, ReplayServer, SumTree, payload_bytes
+
+_LAZY = {
+    "DeviceSumTree": ("device", "DeviceSumTree"),
+    "DeviceReplayShard": ("device", "DeviceReplayShard"),
+    "ReplayPublisher": ("ingest", "ReplayPublisher"),
+    "ReplayShardService": ("ingest", "ReplayShardService"),
+    "DistributedReplay": ("distributed", "DistributedReplay"),
+    "SampleRef": ("distributed", "SampleRef"),
+}
+
+__all__ = [
+    "ReplayBuffer",
+    "ReplayClient",
+    "ReplayServer",
+    "SumTree",
+    "payload_bytes",
+    *_LAZY,
+]
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f".{entry[0]}", __name__)
+    value = getattr(mod, entry[1])
+    globals()[name] = value
+    return value

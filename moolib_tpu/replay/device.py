@@ -1,0 +1,425 @@
+"""Device-resident prioritized replay: ring storage and sum-tree as jnp arrays.
+
+The host store (:mod:`moolib_tpu.replay.host`) keeps items as python lists
+and walks a numpy sum-tree under a lock — every add/sample crosses the
+host boundary and restacks the batch.  Here the whole store lives on
+device:
+
+- :class:`DeviceSumTree` — the sum-tree is one ``[2*capacity]`` device
+  array (same layout as the numpy reference: root at 1, leaves at
+  ``[capacity, 2*capacity)``).  ``set`` scatters leaf values and rebuilds
+  the internal levels with one pairwise reduction per level — the same
+  pairwise additions the reference's touched-path walk performs, so the
+  tree is bit-exact vs ``host.SumTree`` at equal dtype.  ``sample``
+  descends all targets in lockstep with a fixed trip count.
+- :class:`DeviceReplayShard` — a ``[capacity, ...]`` donated device ring
+  per pytree leaf.  Inserts are fixed-width masked scatters (lane padding
+  + out-of-bounds drop), so slot churn never changes an abstract
+  signature: every hot path is wrapped in devmon ``instrument_jit`` and
+  compiles exactly once.  Sampling is a stratified proportional draw under
+  the counter-based seeding contract (keys derived by ``fold_in`` on a
+  draw counter) returning device pytrees straight into the learner's
+  donated batch path; priority write-back accepts device arrays without
+  realizing them.
+
+The priority transform ``p -> max(p, 1e-6)**alpha`` is its own tiny jit
+(:attr:`DeviceReplayShard.priority_transform`) shared by insert and
+update — tests and the bench feed the *same compiled function* to the
+numpy reference, which is what makes the bit-exactness comparison exact
+rather than tolerance-based.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry import devmon
+from ..utils import nest
+from ._metrics import (
+    REPLAY_FRAMES,
+    REPLAY_OCCUPANCY,
+    REPLAY_PRIORITY_ROUNDS,
+    REPLAY_SAMPLE_SECONDS,
+)
+
+_INSTANCE_SEQ = itertools.count()
+
+
+def _pow2(n: int) -> int:
+    cap = 1
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _tree_from_leaves(leaves):
+    """Assemble the full ``[2*cap]`` tree from its ``[cap]`` leaf level by
+    pairwise level sums (index 0 stays zero, root lands at index 1)."""
+    levels = [leaves]
+    while levels[-1].shape[0] > 1:
+        cur = levels[-1]
+        levels.append(cur[0::2] + cur[1::2])
+    parts = [jnp.zeros((1,), leaves.dtype)] + levels[::-1]
+    return jnp.concatenate(parts)
+
+
+def _descend(tree, targets, capacity: int):
+    """Lockstep sum-tree descent: leaf index whose prefix-sum interval
+    contains each target.  ``capacity`` is static, so the trip count is
+    fixed at trace time."""
+    t = targets.astype(tree.dtype)
+    idx = jnp.ones(t.shape, jnp.int32)
+    for _ in range(capacity.bit_length() - 1):
+        left = tree[2 * idx]
+        go_right = t > left
+        t = jnp.where(go_right, t - left, t)
+        idx = 2 * idx + go_right.astype(jnp.int32)
+    return idx - capacity
+
+
+class DeviceSumTree:
+    """Sum-tree as a device array with jitted set/get/sample.
+
+    Maskable: lanes whose index is ``>= capacity`` (after padding) are
+    dropped by the scatter, so callers keep a fixed batch width and pad.
+    Duplicate indices within one ``set`` batch write in unspecified order —
+    callers pass distinct slots (ring inserts do by construction).
+    """
+
+    def __init__(self, capacity: int, dtype=jnp.float32, name: str = "replay_tree"):
+        self.capacity = _pow2(capacity)
+        self.dtype = jnp.dtype(dtype)
+        self.tree = jnp.zeros(2 * self.capacity, self.dtype)
+        cap = self.capacity
+        tag = f"{name}[{next(_INSTANCE_SEQ)}]"
+
+        def _set(tree, idx, value):
+            leaves = tree[cap:].at[idx].set(value.astype(tree.dtype), mode="drop")
+            return _tree_from_leaves(leaves)
+
+        def _get(tree, idx):
+            return tree[cap + idx]
+
+        def _sample(tree, targets):
+            return _descend(tree, targets, cap)
+
+        self._set = devmon.instrument_jit(
+            jax.jit(_set, donate_argnums=0), f"{tag}.set"
+        )
+        self._get = devmon.instrument_jit(jax.jit(_get), f"{tag}.get")
+        self._sample = devmon.instrument_jit(jax.jit(_sample), f"{tag}.sample")
+
+    def set(self, idx, value) -> None:
+        self.tree = self._set(self.tree, jnp.asarray(idx), jnp.asarray(value))
+
+    def total(self):
+        """Root of the tree as an un-realized device scalar."""
+        return self.tree[1]
+
+    def get(self, idx):
+        return self._get(self.tree, jnp.asarray(idx))
+
+    def sample(self, targets):
+        """Leaf indices for prefix-sum targets (device array in, device
+        array out; the descent never touches the host)."""
+        return self._sample(self.tree, jnp.asarray(targets))
+
+
+def _stack_rows(items: Sequence[Any]):
+    """Stack a list of item pytrees into one batch pytree.  Host (numpy)
+    leaves batch with np.stack — including borrowed read-only ingest views,
+    which this is the single copy of — so the ring insert pays exactly one
+    host->device transfer per leaf; device leaves stack on device."""
+    return nest.map_many(
+        lambda *xs: np.stack(xs)
+        if isinstance(xs[0], np.ndarray)
+        else jnp.stack(xs),
+        *items,
+    )
+
+
+def _pad_rows(batch, width: int, n: int):
+    """Pad the leading (lane) axis out to the latched insert width."""
+    if n == width:
+        return batch
+
+    def pad(x):
+        if isinstance(x, np.ndarray):
+            return np.concatenate(
+                [x, np.zeros((width - n,) + x.shape[1:], x.dtype)]
+            )
+        return jnp.concatenate(
+            [x, jnp.zeros((width - n,) + x.shape[1:], x.dtype)]
+        )
+
+    return nest.map(pad, batch)
+
+
+class DeviceReplayShard:
+    """One host's shard of the distributed device-resident replay store.
+
+    API-compatible with :class:`moolib_tpu.replay.host.ReplayBuffer`
+    (``add`` / ``sample`` / ``update_priorities`` / ``size``), except that
+    ``sample`` returns *device* arrays and ``update_priorities`` accepts
+    them — the learner's TD errors never visit the host.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        alpha: float = 0.6,
+        beta: float = 0.4,
+        seed: int = 0,
+        name: str = "replay_shard",
+        dtype=jnp.float32,
+    ):
+        self.capacity = int(capacity)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self._treecap = _pow2(self.capacity)
+        self.dtype = jnp.dtype(dtype)
+        self._tag = f"{name}[{next(_INSTANCE_SEQ)}]"
+        self.tree = jnp.zeros(2 * self._treecap, self.dtype)
+        self._store = None  # [capacity, ...] ring pytree, built on first add
+        self._next = 0  # host-side ring cursor (bookkeeping ints, no sync)
+        self._size = 0
+        self._maxp = jnp.ones((), self.dtype)  # running max RAW priority
+        self._base_key = jax.random.key(seed)
+        self._draws = 0  # fold_in counter: the seeding contract's epoch
+        self._ins_width: Optional[int] = None
+        self._upd_width: Optional[int] = None
+        self._sample_jits = {}
+        self._transform_jits = {}
+
+        def _default_fill(maxp, width: int):
+            return jnp.broadcast_to(maxp, (width,))
+
+        self._default_fill = devmon.instrument_jit(
+            jax.jit(_default_fill, static_argnums=1), f"{self._tag}.fill"
+        )
+
+    def priority_transform(self, p):
+        """The one alpha-pow ``max(p, 1e-6)**alpha`` used for every leaf
+        value that enters the tree (insert and update) — the bit-exactness
+        tests run the numpy reference through this same compiled fn.  One
+        instrumented jit per batch width, so fixed-width callers never
+        register a second signature on a devmon name."""
+        p = jnp.asarray(p)
+        width = int(p.shape[0])
+        fn = self._transform_jits.get(width)
+        if fn is None:
+            dt = self.dtype
+            alpha = self.alpha
+
+            def _transform(p):
+                return jnp.maximum(p.astype(dt), 1e-6) ** jnp.asarray(alpha, dt)
+
+            fn = self._transform_jits[width] = devmon.instrument_jit(
+                jax.jit(_transform), f"{self._tag}.transform[{width}]"
+            )
+        return fn(p)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def size(self) -> int:
+        return self._size
+
+    # -- insert ------------------------------------------------------------
+
+    def _build_insert(self, width: int):
+        capacity, treecap = self.capacity, self._treecap
+
+        def _insert(store, tree, maxp, batch, praw, p_alpha, start, count):
+            lanes = jnp.arange(width, dtype=jnp.int32)
+            valid = lanes < count
+            slots = (start + lanes) % capacity
+            # Out-of-bounds sentinel lanes are dropped by the scatter, so a
+            # short batch keeps the same abstract signature as a full one.
+            store_slots = jnp.where(valid, slots, capacity)
+            tree_slots = jnp.where(valid, slots, treecap)
+            store = nest.map_many(
+                lambda s, b: s.at[store_slots].set(
+                    b.astype(s.dtype), mode="drop"
+                ),
+                store,
+                batch,
+            )
+            leaves = tree[treecap:].at[tree_slots].set(
+                p_alpha.astype(tree.dtype), mode="drop"
+            )
+            tree = _tree_from_leaves(leaves)
+            maxp = jnp.maximum(
+                maxp, jnp.max(jnp.where(valid, praw.astype(maxp.dtype), 0))
+            )
+            return store, tree, maxp
+
+        return devmon.instrument_jit(
+            jax.jit(_insert, donate_argnums=(0, 1, 2)),
+            f"{self._tag}.insert",
+        )
+
+    def add(self, items: Sequence[Any], priorities=None):
+        """Insert a fixed-width batch of item pytrees; returns slot indices
+        (host ints — ring bookkeeping, not a device readback)."""
+        n = len(items)
+        if self._ins_width is None:
+            self._ins_width = n
+            self._insert = self._build_insert(n)
+        elif n > self._ins_width:
+            raise ValueError(
+                f"insert width grew {self._ins_width} -> {n}: the ring "
+                "insert is fixed-shape (pad or split the batch)"
+            )
+        width = self._ins_width
+        batch = _pad_rows(_stack_rows(items), width, n)
+        if self._store is None:
+            self._store = nest.map(
+                lambda b: jnp.zeros(
+                    (self.capacity,) + tuple(b.shape[1:]), b.dtype
+                ),
+                batch,
+            )
+        if priorities is None:
+            praw = self._default_fill(self._maxp, width)
+        else:
+            praw = np.zeros(width, np.float32)
+            praw[:n] = priorities
+        p_alpha = self.priority_transform(praw)
+        self._store, self.tree, self._maxp = self._insert(
+            self._store,
+            self.tree,
+            self._maxp,
+            batch,
+            praw,
+            p_alpha,
+            np.int32(self._next),
+            np.int32(n),
+        )
+        idxs = [(self._next + i) % self.capacity for i in range(n)]
+        self._next = (self._next + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+        REPLAY_FRAMES.inc(n, role="insert")
+        REPLAY_OCCUPANCY.set(self._size, shard=self._tag)
+        return idxs
+
+    # -- sample ------------------------------------------------------------
+
+    def _build_sample(self, batch_size: int):
+        treecap, beta = self._treecap, self.beta
+
+        def _sample(store, tree, key, size, total_div):
+            dt = tree.dtype
+            total = tree[1]
+            u = jax.random.uniform(key, (batch_size,), dt)
+            seg = total / batch_size
+            targets = (jnp.arange(batch_size, dtype=dt) + u) * seg
+            targets = jnp.minimum(targets, total * (1 - 1e-9))
+            idx = _descend(tree, targets, treecap)
+            idx = jnp.clip(idx, 0, jnp.maximum(size - 1, 0))
+            # Global correction: in the distributed draw, probs divide by
+            # the cohort-wide total and N is the cohort-wide size, so
+            # weights are globally consistent; 0 means "local".
+            eff_total = jnp.where(total_div > 0, total_div, total)
+            probs = tree[treecap + idx] / jnp.maximum(eff_total, 1e-12)
+            w = (size.astype(dt) * jnp.maximum(probs, 1e-12)) ** (-beta)
+            w = w / jnp.max(w)
+            batch = nest.map(lambda leaf: leaf[idx], store)
+            return batch, idx, w
+
+        return devmon.instrument_jit(jax.jit(_sample), f"{self._tag}.sample")
+
+    def sample(self, batch_size: int, size_override: int = 0, total_override: float = 0.0):
+        """(device batch pytree, device indices, device weights).
+
+        ``size_override``/``total_override`` are the cohort-wide N and
+        priority total for the distributed two-level draw; 0 keeps the
+        shard-local correction.
+        """
+        if self._size == 0 or self._store is None:
+            raise ValueError("replay shard is empty")
+        fn = self._sample_jits.get(batch_size)
+        if fn is None:
+            fn = self._sample_jits[batch_size] = self._build_sample(batch_size)
+        key = jax.random.fold_in(self._base_key, self._draws)
+        self._draws += 1
+        with REPLAY_SAMPLE_SECONDS.time():
+            batch, idx, w = fn(
+                self._store,
+                self.tree,
+                key,
+                np.int32(size_override if size_override else self._size),
+                np.float32(total_override),
+            )
+        REPLAY_FRAMES.inc(batch_size, role="sample")
+        return batch, idx, w
+
+    # -- priority write-back ------------------------------------------------
+
+    def _build_update(self, width: int):
+        treecap = self._treecap
+
+        def _update(tree, maxp, idx, praw, p_alpha, count):
+            lanes = jnp.arange(width, dtype=jnp.int32)
+            valid = lanes < count
+            tree_slots = jnp.where(valid, idx.astype(jnp.int32), treecap)
+            leaves = tree[treecap:].at[tree_slots].set(
+                p_alpha.astype(tree.dtype), mode="drop"
+            )
+            tree = _tree_from_leaves(leaves)
+            maxp = jnp.maximum(
+                maxp, jnp.max(jnp.where(valid, praw.astype(maxp.dtype), 0))
+            )
+            return tree, maxp
+
+        return devmon.instrument_jit(
+            jax.jit(_update, donate_argnums=(0, 1)), f"{self._tag}.update"
+        )
+
+    def update_priorities(self, indices, priorities) -> None:
+        """Write back new priorities (device or host arrays — device TD
+        errors are consumed without realizing them on host)."""
+        indices = jnp.asarray(indices)
+        n = int(indices.shape[0])
+        if self._upd_width is None:
+            self._upd_width = n
+            self._update = self._build_update(n)
+        elif n > self._upd_width:
+            raise ValueError(
+                f"priority-update width grew {self._upd_width} -> {n}: "
+                "fixed-shape contract (pad or split the batch)"
+            )
+        width = self._upd_width
+        praw = jnp.asarray(priorities, self.dtype)
+        if n < width:
+            indices = jnp.concatenate(
+                [indices, jnp.zeros(width - n, indices.dtype)]
+            )
+            praw = jnp.concatenate([praw, jnp.zeros(width - n, praw.dtype)])
+        p_alpha = self.priority_transform(praw)
+        self.tree, self._maxp = self._update(
+            self.tree, self._maxp, indices, praw, p_alpha, np.int32(n)
+        )
+        REPLAY_PRIORITY_ROUNDS.inc()
+
+    # -- cohort seams --------------------------------------------------------
+
+    def total(self):
+        """Priority-sum root as an un-realized device scalar."""
+        return self.tree[1]
+
+    def total_host(self) -> float:
+        """Realized priority total — the intentional host seam the
+        across-shard proportional allocation reads once per draw round
+        (amortized over a whole sampled batch, not per frame)."""
+        return float(self.tree[1])
+
+    def leaf_priorities(self):
+        """The ``[capacity]`` transformed-priority leaf level as a device
+        array (tests compare it against the numpy reference)."""
+        return self.tree[self._treecap : self._treecap + self.capacity]

@@ -1,16 +1,21 @@
-"""Distributed prioritized experience replay over RPC.
+"""Host-side prioritized replay: the bit-exactness reference and RPC store.
 
-Supports the R2D2 / recurrent-value-based agent family (BASELINE.json config
-list: "R2D2 / recurrent PPO with LSTM policy + prioritized replay RPC").
-The reference ships no replay buffer — actors would implement one over raw
-``Rpc.define`` — so this is framework-level capability the reference leaves
-to applications:
+This is the original ``moolib_tpu/replay.py`` (seed lineage), kept as the
+compat shim and as the numpy reference the device store
+(:mod:`moolib_tpu.replay.device`) is verified bit-exact against:
 
+- :class:`SumTree` — numpy sum-tree, O(log n) vectorized updates.  The
+  ``dtype`` parameter (default float64, the historical behavior) lets tests
+  run the reference in float32, the device store's dtype, so comparisons
+  are exact rather than tolerance-based.
 - :class:`ReplayBuffer` — in-memory prioritized buffer (proportional
-  sampling via a numpy sum-tree, O(log n) updates), thread-safe, pytree
-  items (numpy/jax leaves ride the RPC array path untouched).
-- :class:`ReplayServer` — exposes add/sample/update_priorities/size as RPC
-  functions on an ``Rpc`` peer.
+  sampling, PER importance weights), thread-safe, pytree items.
+- :class:`ReplayServer` — add/sample/update_priorities/size over RPC.
+  Handlers are registered ``inline=True``: arguments arrive as zero-copy
+  read-only views over the receive buffer (``deserialize(borrow=True)``),
+  and the store copies each payload exactly once into buffer-owned memory
+  instead of the old pickle-copy-then-store double copy.  Payload traffic
+  is counted on ``replay_bytes_total{direction}``.
 - :class:`ReplayClient` — call-through wrappers returning RPC futures.
 
 Sampling returns (batch, indices, importance weights) with the standard
@@ -24,22 +29,43 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .rpc import Rpc
-from .utils import nest
+from ..rpc import Rpc
+from ..utils import nest
+from ._metrics import REPLAY_BYTES
+
+
+def payload_bytes(tree: Any) -> int:
+    """Total array bytes in a pytree (non-array leaves count as zero)."""
+    total = 0
+    for leaf in nest.flatten(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def _own_copy(tree: Any) -> Any:
+    """Copy borrowed array views into owned memory (one copy, at the only
+    place the store retains data past the inline handler's return)."""
+    return nest.map(
+        lambda x: np.array(x, copy=True) if isinstance(x, np.ndarray) else x,
+        tree,
+    )
 
 
 class SumTree:
     """Binary indexed sum-tree over fixed capacity (power of two internally)."""
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, dtype=np.float64):
         self.capacity = 1
         while self.capacity < capacity:
             self.capacity *= 2
-        self.tree = np.zeros(2 * self.capacity, dtype=np.float64)
+        self.dtype = np.dtype(dtype)
+        self.tree = np.zeros(2 * self.capacity, dtype=self.dtype)
 
     def set(self, idx, value) -> None:
         idx = np.atleast_1d(np.asarray(idx, np.int64))
-        value = np.atleast_1d(np.asarray(value, np.float64))
+        value = np.atleast_1d(np.asarray(value, self.dtype))
         pos = idx + self.capacity
         self.tree[pos] = value
         # Walk the touched paths up, one vectorized level at a time.
@@ -59,7 +85,7 @@ class SumTree:
     def sample(self, targets: np.ndarray) -> np.ndarray:
         """Find leaf indices whose prefix-sum interval contains each target."""
         idx = np.ones(len(targets), dtype=np.int64)
-        t = np.asarray(targets, np.float64).copy()
+        t = np.asarray(targets, self.dtype).copy()
         while idx[0] < self.capacity:
             left = self.tree[2 * idx]
             go_right = t > left
@@ -131,22 +157,34 @@ class ReplayBuffer:
 
 
 class ReplayServer:
-    """Serve a ReplayBuffer to the cohort over RPC."""
+    """Serve a ReplayBuffer to the cohort over RPC.
+
+    All handlers run ``inline=True``: the add/update payloads arrive as
+    borrowed zero-copy views over the receive buffer, and ``_on_add`` copies
+    them exactly once into buffer-owned memory (the buffer outlives the
+    frame).  The handlers only take the buffer's own short-lived lock, so
+    they are safe on the transport's IO thread.
+    """
 
     def __init__(self, rpc: Rpc, name: str, buffer: ReplayBuffer):
         self._rpc = rpc
         self._buffer = buffer
         self._name = name
-        rpc.define(f"{name}.add", self._on_add)
-        rpc.define(f"{name}.sample", self._on_sample)
-        rpc.define(f"{name}.update_priorities", self._on_update)
+        rpc.define(f"{name}.add", self._on_add, inline=True)
+        rpc.define(f"{name}.sample", self._on_sample, inline=True)
+        rpc.define(f"{name}.update_priorities", self._on_update, inline=True)
         rpc.define(f"{name}.size", self._buffer.size)
 
     def _on_add(self, items, priorities=None):
+        REPLAY_BYTES.inc(payload_bytes(items), direction="add_in")
+        items = [_own_copy(it) for it in items]
+        if priorities is not None:
+            priorities = np.array(priorities, copy=True)
         return self._buffer.add(items, priorities)
 
     def _on_sample(self, batch_size):
         batch, idxs, weights = self._buffer.sample(batch_size)
+        REPLAY_BYTES.inc(payload_bytes(batch), direction="sample_out")
         return {"batch": batch, "indices": idxs, "weights": weights}
 
     def _on_update(self, indices, priorities):

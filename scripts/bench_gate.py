@@ -14,9 +14,9 @@ record under per-metric tolerance rules:
   benchmarks enter the record deliberately, not by gate accident.
 
 Rows are keyed the same way ``fold_capture`` merges them (agent rows by
-(metric, rollout, scale), serve_qps rows by (metric, engine-arm, target),
-allreduce rows by (banner, elems)), so the gate sees exactly the rows a
-fold would replace.  Rows only in the capture are informational; rows only
+(metric, rollout, scale), r2d2 replay rows by (metric, arm), serve_qps
+rows by (metric, engine-arm, target), allreduce rows by (banner, elems)),
+so the gate sees exactly the rows a fold would replace.  Rows only in the capture are informational; rows only
 in the committed record are skipped (a smoke run measures a subset).
 
 Usage (ci.sh runs the --smoke forms before each fold_capture --local)::
@@ -173,8 +173,25 @@ def parse_step_overlap_rows(lines: List[str]) -> Dict[Tuple, Dict[str, Any]]:
     return out
 
 
+def parse_r2d2_rows(lines: List[str]) -> Dict[Tuple, Dict[str, Any]]:
+    """r2d2_learner rows keyed (metric, arm) — the way merge_r2d2_rows
+    keys them; gated field: the per-arm replay-plane SPS (throughput).
+    The r2d2_replay_ab summary row is provenance (speedups, bit-exactness,
+    ingest accounting), not a gated measurement."""
+    out: Dict[Tuple, Dict[str, Any]] = {}
+    for row in _json_rows(lines):
+        if row.get("metric") != "r2d2_learner_sps":
+            continue
+        key = (row.get("metric"), row.get("arm"))
+        v = row.get("value")
+        if isinstance(v, (int, float)) and v > 0:
+            out[key] = {"throughput": {"value": float(v)}, "latency": {}}
+    return out
+
+
 SECTION_RULES = {
     "agent_small": parse_agent_rows,
+    "r2d2_learner": parse_r2d2_rows,
     "step_overlap": parse_step_overlap_rows,
     "serve_qps": parse_qps_rows,
     "allreduce_rpc": parse_allreduce_rows,
@@ -212,23 +229,31 @@ def capture_from_logs(paths: List[str]) -> Dict[str, Any]:
             raise GateError(f"log not found: {path}")
         overlap = fold_capture.parse_step_overlap(path)
         agent = None if overlap else fold_capture.parse_agent_lines(path)
-        qps = None if (overlap or agent) else fold_capture.parse_serve_qps(path)
+        r2d2 = (
+            None if (overlap or agent) else fold_capture.parse_r2d2_local(path)
+        )
+        qps = (
+            None if (overlap or agent or r2d2)
+            else fold_capture.parse_serve_qps(path)
+        )
         allr = (
-            None if (overlap or agent or qps)
+            None if (overlap or agent or r2d2 or qps)
             else fold_capture.parse_allreduce(path)
         )
         if overlap:
             section, lines = "step_overlap", overlap
         elif agent:
             section, lines = "agent_small", agent
+        elif r2d2:
+            section, lines = "r2d2_learner", r2d2
         elif qps:
             section, lines = "serve_qps", qps
         elif allr:
             section, lines = "allreduce_rpc", allr
         else:
             raise GateError(
-                f"no step_overlap, agent, serve_qps, or allreduce rows "
-                f"found in {path}"
+                f"no step_overlap, agent, r2d2, serve_qps, or allreduce "
+                f"rows found in {path}"
             )
         sec = data.setdefault(section, {"stdout": []})
         sec["stdout"] = list(sec["stdout"]) + lines

@@ -108,6 +108,39 @@ python -m pytest tests/test_rollout.py -q || fail=1
 step "zero-crossing actor plane (jitted on-device envs: backend bit-exactness, scan==per-step, Sebulba handoff)"
 python -m pytest tests/test_jax_envs.py -q || fail=1
 
+step "replay data plane tests (host store + RPC shim, device shard bit-exactness, zero recompiles, cohort draw, write-once ingest)"
+python -m pytest tests/test_replay.py tests/test_replay_device.py -q || fail=1
+
+step "replay 2-process smoke (memfd-multicast ingest + cohort sampling across a real process boundary)"
+# The parent multicasts >1 MB trajectory batches to an in-process shard
+# AND a real child-process shard: the publish must take the write-once
+# memfd path (bytes counted once per publish, not per consumer), stripes
+# must partition, the two-level draw must serve batches from both shards,
+# and write-back must move both totals (docs/DESIGN.md §4d).
+# MOOLIB_LOCKGRAPH=1: the inline ingest handlers run on the transport IO
+# thread against drain/sample on the caller's thread — an observed ABBA
+# lock cycle in either process fails at teardown.
+MOOLIB_LOCKGRAPH=1 python scripts/replay_smoke.py --smoke || fail=1
+
+step "r2d2 replay A/B (host vs host-RPC vs device store through the full learner cycle; folds into BENCH_LOCAL.json)"
+# One invocation, shared config: --check fails unless every arm produces
+# throughput, device priorities are bit-exact vs the numpy SumTree run
+# through the shard's own compiled transform, and ingest is write-once.
+# Fresh rows gate against the committed r2d2_learner section BEFORE the
+# fold — same discipline as the agent smoke above.
+r2d2_log="${TMPDIR:-/tmp}/moolib_ci_r2d2_ab.log"
+MOOLIB_ALLOW_CPU=1 python benchmarks/r2d2_bench.py --check > "$r2d2_log" 2>&1
+r2d2_rc=$?
+cat "$r2d2_log"
+if [ "$r2d2_rc" = 0 ]; then
+  python scripts/bench_gate.py --smoke --log "$r2d2_log" \
+    --throughput-floor 0.5 --latency-ceiling 3.0 \
+    --allow-new-section all || fail=1
+  python benchmarks/fold_capture.py --local "$r2d2_log" || fail=1
+else
+  fail=1
+fi
+
 step "agent smoke (whole-agent SPS, all three rollout planes; folds the agent rows into BENCH_LOCAL.json)"
 # Smoke gate for the actor data planes (docs/DESIGN.md "Actor data plane" +
 # §4c): every plane must finish with steady_sps > 0, the jax (Anakin) arm

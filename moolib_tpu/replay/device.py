@@ -32,6 +32,7 @@ rather than tolerance-based.
 from __future__ import annotations
 
 import itertools
+import threading
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -167,6 +168,13 @@ class DeviceReplayShard:
     (``add`` / ``sample`` / ``update_priorities`` / ``size``), except that
     ``sample`` returns *device* arrays and ``update_priorities`` accepts
     them — the learner's TD errors never visit the host.
+
+    Thread-safe: every mutation donates ``self.tree`` (and the store) into
+    a jit, so a reentrant mutex serializes add/sample/update and the
+    realized reads — :class:`~moolib_tpu.replay.ingest.ReplayShardService`
+    calls in from the Rpc worker pool *and* the transport IO thread
+    (inline priority write-back), and a use-after-donate between them
+    would corrupt the sum-tree.
     """
 
     def __init__(
@@ -195,6 +203,7 @@ class DeviceReplayShard:
         self._upd_width: Optional[int] = None
         self._sample_jits = {}
         self._transform_jits = {}
+        self._lock = threading.RLock()
 
         def _default_fill(maxp, width: int):
             return jnp.broadcast_to(maxp, (width,))
@@ -264,46 +273,53 @@ class DeviceReplayShard:
             f"{self._tag}.insert",
         )
 
+    @property
+    def insert_width(self) -> Optional[int]:
+        """The latched fixed insert width (None until the first ``add``) —
+        ingest callers split larger stripes to this before inserting."""
+        return self._ins_width
+
     def add(self, items: Sequence[Any], priorities=None):
         """Insert a fixed-width batch of item pytrees; returns slot indices
         (host ints — ring bookkeeping, not a device readback)."""
-        n = len(items)
-        if self._ins_width is None:
-            self._ins_width = n
-            self._insert = self._build_insert(n)
-        elif n > self._ins_width:
-            raise ValueError(
-                f"insert width grew {self._ins_width} -> {n}: the ring "
-                "insert is fixed-shape (pad or split the batch)"
-            )
-        width = self._ins_width
-        batch = _pad_rows(_stack_rows(items), width, n)
-        if self._store is None:
-            self._store = nest.map(
-                lambda b: jnp.zeros(
-                    (self.capacity,) + tuple(b.shape[1:]), b.dtype
-                ),
+        with self._lock:
+            n = len(items)
+            if self._ins_width is None:
+                self._ins_width = n
+                self._insert = self._build_insert(n)
+            elif n > self._ins_width:
+                raise ValueError(
+                    f"insert width grew {self._ins_width} -> {n}: the ring "
+                    "insert is fixed-shape (pad or split the batch)"
+                )
+            width = self._ins_width
+            batch = _pad_rows(_stack_rows(items), width, n)
+            if self._store is None:
+                self._store = nest.map(
+                    lambda b: jnp.zeros(
+                        (self.capacity,) + tuple(b.shape[1:]), b.dtype
+                    ),
+                    batch,
+                )
+            if priorities is None:
+                praw = self._default_fill(self._maxp, width)
+            else:
+                praw = np.zeros(width, np.float32)
+                praw[:n] = priorities
+            p_alpha = self.priority_transform(praw)
+            self._store, self.tree, self._maxp = self._insert(
+                self._store,
+                self.tree,
+                self._maxp,
                 batch,
+                praw,
+                p_alpha,
+                np.int32(self._next),
+                np.int32(n),
             )
-        if priorities is None:
-            praw = self._default_fill(self._maxp, width)
-        else:
-            praw = np.zeros(width, np.float32)
-            praw[:n] = priorities
-        p_alpha = self.priority_transform(praw)
-        self._store, self.tree, self._maxp = self._insert(
-            self._store,
-            self.tree,
-            self._maxp,
-            batch,
-            praw,
-            p_alpha,
-            np.int32(self._next),
-            np.int32(n),
-        )
-        idxs = [(self._next + i) % self.capacity for i in range(n)]
-        self._next = (self._next + n) % self.capacity
-        self._size = min(self._size + n, self.capacity)
+            idxs = [(self._next + i) % self.capacity for i in range(n)]
+            self._next = (self._next + n) % self.capacity
+            self._size = min(self._size + n, self.capacity)
         REPLAY_FRAMES.inc(n, role="insert")
         REPLAY_OCCUPANCY.set(self._size, shard=self._tag)
         return idxs
@@ -313,21 +329,27 @@ class DeviceReplayShard:
     def _build_sample(self, batch_size: int):
         treecap, beta = self._treecap, self.beta
 
-        def _sample(store, tree, key, size, total_div):
+        def _sample(store, tree, key, size, n_div, total_div):
             dt = tree.dtype
             total = tree[1]
             u = jax.random.uniform(key, (batch_size,), dt)
             seg = total / batch_size
             targets = (jnp.arange(batch_size, dtype=dt) + u) * seg
-            targets = jnp.minimum(targets, total * (1 - 1e-9))
+            # Largest representable value strictly below total in the
+            # tree's own dtype (1 - 1e-9 rounds to exactly 1.0 in f32).
+            targets = jnp.minimum(targets, total * (1 - jnp.finfo(dt).epsneg))
             idx = _descend(tree, targets, treecap)
+            # The clip guards never-written zero-priority slots, so it is
+            # always against the LOCAL occupancy — the ring only holds
+            # ``size`` items regardless of the cohort-wide count.
             idx = jnp.clip(idx, 0, jnp.maximum(size - 1, 0))
             # Global correction: in the distributed draw, probs divide by
             # the cohort-wide total and N is the cohort-wide size, so
             # weights are globally consistent; 0 means "local".
             eff_total = jnp.where(total_div > 0, total_div, total)
+            eff_n = jnp.where(n_div > 0, n_div, size)
             probs = tree[treecap + idx] / jnp.maximum(eff_total, 1e-12)
-            w = (size.astype(dt) * jnp.maximum(probs, 1e-12)) ** (-beta)
+            w = (eff_n.astype(dt) * jnp.maximum(probs, 1e-12)) ** (-beta)
             w = w / jnp.max(w)
             batch = nest.map(lambda leaf: leaf[idx], store)
             return batch, idx, w
@@ -338,24 +360,29 @@ class DeviceReplayShard:
         """(device batch pytree, device indices, device weights).
 
         ``size_override``/``total_override`` are the cohort-wide N and
-        priority total for the distributed two-level draw; 0 keeps the
-        shard-local correction.
+        priority total for the distributed two-level draw (they only
+        rescale the importance weights — indices always stay within the
+        local ring); 0 keeps the shard-local correction.
         """
-        if self._size == 0 or self._store is None:
-            raise ValueError("replay shard is empty")
-        fn = self._sample_jits.get(batch_size)
-        if fn is None:
-            fn = self._sample_jits[batch_size] = self._build_sample(batch_size)
-        key = jax.random.fold_in(self._base_key, self._draws)
-        self._draws += 1
-        with REPLAY_SAMPLE_SECONDS.time():
-            batch, idx, w = fn(
-                self._store,
-                self.tree,
-                key,
-                np.int32(size_override if size_override else self._size),
-                np.float32(total_override),
-            )
+        with self._lock:
+            if self._size == 0 or self._store is None:
+                raise ValueError("replay shard is empty")
+            fn = self._sample_jits.get(batch_size)
+            if fn is None:
+                fn = self._sample_jits[batch_size] = self._build_sample(
+                    batch_size
+                )
+            key = jax.random.fold_in(self._base_key, self._draws)
+            self._draws += 1
+            with REPLAY_SAMPLE_SECONDS.time():
+                batch, idx, w = fn(
+                    self._store,
+                    self.tree,
+                    key,
+                    np.int32(self._size),
+                    np.int32(size_override),
+                    np.float32(total_override),
+                )
         REPLAY_FRAMES.inc(batch_size, role="sample")
         return batch, idx, w
 
@@ -368,6 +395,16 @@ class DeviceReplayShard:
             lanes = jnp.arange(width, dtype=jnp.int32)
             valid = lanes < count
             tree_slots = jnp.where(valid, idx.astype(jnp.int32), treecap)
+            # Stratified draws return duplicate indices routinely; the
+            # scatter's duplicate order is unspecified in JAX, so mask all
+            # but the LAST occurrence of each slot — the numpy reference's
+            # ``tree[pos] = value`` is deterministically last-wins.
+            dup_later = (tree_slots[None, :] == tree_slots[:, None]) & (
+                lanes[None, :] > lanes[:, None]
+            )
+            tree_slots = jnp.where(
+                jnp.any(dup_later, axis=1), treecap, tree_slots
+            )
             leaves = tree[treecap:].at[tree_slots].set(
                 p_alpha.astype(tree.dtype), mode="drop"
             )
@@ -383,43 +420,51 @@ class DeviceReplayShard:
 
     def update_priorities(self, indices, priorities) -> None:
         """Write back new priorities (device or host arrays — device TD
-        errors are consumed without realizing them on host)."""
-        indices = jnp.asarray(indices)
-        n = int(indices.shape[0])
-        if self._upd_width is None:
-            self._upd_width = n
-            self._update = self._build_update(n)
-        elif n > self._upd_width:
-            raise ValueError(
-                f"priority-update width grew {self._upd_width} -> {n}: "
-                "fixed-shape contract (pad or split the batch)"
+        errors are consumed without realizing them on host).  Duplicate
+        indices resolve last-wins, matching the numpy reference."""
+        with self._lock:
+            indices = jnp.asarray(indices)
+            n = int(indices.shape[0])
+            if self._upd_width is None:
+                self._upd_width = n
+                self._update = self._build_update(n)
+            elif n > self._upd_width:
+                raise ValueError(
+                    f"priority-update width grew {self._upd_width} -> {n}: "
+                    "fixed-shape contract (pad or split the batch)"
+                )
+            width = self._upd_width
+            praw = jnp.asarray(priorities, self.dtype)
+            if n < width:
+                indices = jnp.concatenate(
+                    [indices, jnp.zeros(width - n, indices.dtype)]
+                )
+                praw = jnp.concatenate(
+                    [praw, jnp.zeros(width - n, praw.dtype)]
+                )
+            p_alpha = self.priority_transform(praw)
+            self.tree, self._maxp = self._update(
+                self.tree, self._maxp, indices, praw, p_alpha, np.int32(n)
             )
-        width = self._upd_width
-        praw = jnp.asarray(priorities, self.dtype)
-        if n < width:
-            indices = jnp.concatenate(
-                [indices, jnp.zeros(width - n, indices.dtype)]
-            )
-            praw = jnp.concatenate([praw, jnp.zeros(width - n, praw.dtype)])
-        p_alpha = self.priority_transform(praw)
-        self.tree, self._maxp = self._update(
-            self.tree, self._maxp, indices, praw, p_alpha, np.int32(n)
-        )
         REPLAY_PRIORITY_ROUNDS.inc()
 
     # -- cohort seams --------------------------------------------------------
 
     def total(self):
         """Priority-sum root as an un-realized device scalar."""
-        return self.tree[1]
+        with self._lock:
+            return self.tree[1]
 
     def total_host(self) -> float:
         """Realized priority total — the intentional host seam the
         across-shard proportional allocation reads once per draw round
         (amortized over a whole sampled batch, not per frame)."""
-        return float(self.tree[1])
+        with self._lock:
+            total = self.tree[1]
+        return float(total)
 
     def leaf_priorities(self):
         """The ``[capacity]`` transformed-priority leaf level as a device
         array (tests compare it against the numpy reference)."""
-        return self.tree[self._treecap : self._treecap + self.capacity]
+        with self._lock:
+            return self.tree[self._treecap : self._treecap + self.capacity]

@@ -65,6 +65,13 @@ class ReplayShardService:
     (size + priority total for the across-shard draw), ``<name>.dsample``
     (cohort-corrected sample), ``<name>.update`` (priority write-back),
     ``<name>.size``.
+
+    Handlers run on two kinds of thread — ``stats``/``dsample``/``size``
+    on the Rpc worker pool (each drains pending stripes first), the inline
+    ``ingest``/``update`` on the transport IO thread — and may overlap
+    freely: the service lock only guards the pending-stripe queue, while
+    the :class:`~moolib_tpu.replay.device.DeviceReplayShard` serializes
+    its own donated add/sample/update under its per-shard mutex.
     """
 
     def __init__(
@@ -111,16 +118,33 @@ class ReplayShardService:
         return len(stripe)
 
     def drain(self) -> int:
-        """Insert queued stripes into the device ring (call from the shard
-        owner's thread — this is where the single host->device copy per
-        trajectory happens).  Returns the number of items inserted."""
+        """Insert queued stripes into the device ring — this is where the
+        single host->device copy per trajectory happens.  Safe from any
+        thread (the shard's own mutex serializes the donated inserts
+        against concurrent sample/update).  Returns the number of items
+        inserted.
+
+        The ring insert is fixed-shape: the shard latches its insert width
+        on the first ``add`` and pads shorter batches, so stripes wider
+        than the latched width (publishers with varying batch sizes, a
+        first partial publish) are SPLIT into latched-width chunks here
+        rather than surfacing a width error inside an RPC handler."""
         with self._lock:
             pending, self._pending = self._pending, []
         inserted = 0
         for stripe, prios, _owner in pending:
-            if stripe:
-                self._shard.add(stripe, prios)
-                inserted += len(stripe)
+            if not stripe:
+                continue
+            width = getattr(self._shard, "insert_width", None)
+            if width is None:
+                width = len(stripe)  # first insert latches the shard width
+            for off in range(0, len(stripe), width):
+                chunk = stripe[off : off + width]
+                self._shard.add(
+                    chunk,
+                    None if prios is None else prios[off : off + width],
+                )
+                inserted += len(chunk)
         # _owner mappings drop here: pages were consumed by device_put.
         return inserted
 

@@ -2,6 +2,7 @@
 fixed-shape (zero-recompile) contract, donation safety, the two-level
 cohort draw, and the write-once memfd ingest invariant."""
 
+import threading
 import time
 
 import numpy as np
@@ -145,6 +146,82 @@ def test_insert_width_growth_is_an_error():
         shard.add([{"x": np.float32(i)} for i in range(3)])
 
 
+def test_drain_splits_stripes_wider_than_latched_width():
+    """Publishers with varying batch sizes must not blow up the fixed-shape
+    insert: drain() splits stripes wider than the latched width into
+    latched-width chunks, priorities sliced in lockstep."""
+    r = Rpc()
+    try:
+        shard = DeviceReplayShard(64, alpha=1.0, name="t_split")
+        svc = ReplayShardService(r, "replay_split", shard)
+        # First (small, partial) publish latches the insert width at 4.
+        svc._on_ingest(
+            [{"x": np.float32(i)} for i in range(4)],
+            np.full(4, 2.0, np.float32),
+        )
+        assert svc.drain() == 4
+        assert shard.insert_width == 4
+        # A wider stripe arrives later: split, not a ValueError inside an
+        # RPC handler.
+        svc._on_ingest(
+            [{"x": np.float32(10 + i)} for i in range(11)],
+            (np.arange(11) + 1.0).astype(np.float32),
+        )
+        svc._on_ingest([{"x": np.float32(30)}], np.full(1, 5.0, np.float32))
+        assert svc.drain() == 12
+        assert len(shard) == 16
+        # Priorities landed aligned with their items (alpha=1 keeps the
+        # leaf level equal to the raw clamped priorities).
+        leaves = np.asarray(shard.leaf_priorities())[:16]
+        expect = np.concatenate(
+            [np.full(4, 2.0), np.arange(11) + 1.0, [5.0]]
+        ).astype(np.float32)
+        assert np.array_equal(leaves, expect)
+    finally:
+        r.close()
+
+
+def test_update_priorities_duplicate_indices_last_wins_bitexact():
+    """Stratified draws return duplicate indices routinely; the write-back
+    must resolve them deterministically last-wins, exactly like the numpy
+    reference's sequential ``tree[pos] = value``."""
+    shard = DeviceReplayShard(32, seed=9, name="t_dup")
+    ref = SumTree(32, dtype=np.float32)
+
+    def tf(p):
+        return np.asarray(shard.priority_transform(np.asarray(p, np.float32)))
+
+    prios0 = np.ones(8, np.float32)
+    idxs = shard.add([{"x": np.float32(i)} for i in range(8)], prios0)
+    ref.set(np.asarray(idxs), tf(prios0))
+    dup = np.asarray([3, 5, 3, 3, 7, 5, 0, 3], np.int32)
+    prios = np.asarray([0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8], np.float32)
+    shard.update_priorities(dup, prios)
+    ref.set(dup, tf(prios))  # numpy fancy assignment: last occurrence wins
+    assert np.array_equal(np.asarray(shard.tree), ref.tree)
+    # Slot 3 took the LAST of its four writes, not an arbitrary one.
+    assert np.asarray(shard.leaf_priorities())[3] == tf(prios)[7]
+
+
+def test_cohort_overrides_never_sample_outside_local_ring():
+    """The cohort-wide N only rescales importance weights: descended
+    indices clip against the LOCAL occupancy, so a big cohort never lets a
+    shard return never-written zero-priority slots (which would flatten
+    every other weight after max-normalization)."""
+    shard = DeviceReplayShard(16, seed=3, name="t_clip")
+    shard.add(
+        [{"x": np.float32(i)} for i in range(6)], np.ones(6, np.float32)
+    )
+    for _ in range(10):
+        batch, idx, w = shard.sample(8, size_override=4096, total_override=512.0)
+        idx, w = np.asarray(idx), np.asarray(w)
+        assert ((0 <= idx) & (idx < 6)).all()
+        # Uniform priorities -> uniform weights; a zero-priority row would
+        # collapse everything else toward 0 after w / max(w).
+        assert w.max() == pytest.approx(1.0)
+        assert w.min() == pytest.approx(1.0)
+
+
 # ----------------------------------------------------------- donation safety
 
 
@@ -170,6 +247,59 @@ def test_donation_safe_insert_sample_roundtrip():
     # The donated tree handle the shard holds stays the live one: the
     # total reflects the written spike (1e6 ** alpha with alpha=0.6).
     assert shard.total_host() == pytest.approx(1e6**0.6, rel=0.01)
+
+
+def test_concurrent_add_sample_update_is_serialized():
+    """The shard service drives add (drain on the Rpc worker pool), sample,
+    and the inline priority write-back (transport IO thread) concurrently;
+    the per-shard mutex must serialize the donated mutations.  Hammer the
+    three entry points from threads: no exceptions, consistent ring
+    bookkeeping, and a sum-tree whose root still equals its leaf sum."""
+    shard = DeviceReplayShard(64, seed=4, name="t_mt")
+    shard.add(
+        [{"x": np.zeros(4, np.float32)} for _ in range(8)],
+        np.ones(8, np.float32),
+    )
+    errs = []
+    stop = threading.Event()
+
+    def adder():
+        rng = np.random.default_rng(4)
+        try:
+            while not stop.is_set():
+                shard.add(
+                    [{"x": np.zeros(4, np.float32)} for _ in range(8)],
+                    (rng.random(8) + 0.1).astype(np.float32),
+                )
+        except Exception as e:  # noqa: BLE001 — the assertion payload
+            errs.append(e)
+
+    def sampler():
+        try:
+            while not stop.is_set():
+                _, idx, w = shard.sample(8)
+                shard.update_priorities(
+                    idx, np.asarray(w).astype(np.float32) + 0.5
+                )
+                shard.total_host()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=adder),
+        threading.Thread(target=sampler),
+        threading.Thread(target=sampler),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not errs, errs
+    assert len(shard) == 64
+    leaves = np.asarray(shard.leaf_priorities())
+    assert shard.total_host() == pytest.approx(float(leaves.sum()), rel=1e-4)
 
 
 # ------------------------------------------------------ two-level cohort draw
